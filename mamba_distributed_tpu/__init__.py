@@ -1,0 +1,34 @@
+"""mamba_distributed_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework with
+the capabilities of pie33000/mamba-distributed.
+
+Subpackages:
+  config    — dataclass configs + the five BASELINE presets
+  models    — Mamba-1 / Mamba-2 / hybrid flax models
+  ops       — TPU-native kernels (conv1d, selective scan, SSD, norms)
+  parallel  — mesh, sharding rules, sequence parallelism
+  data      — token-shard pipeline
+  training  — optimizer, train step, trainer loop, checkpointing
+  eval      — HellaSwag harness
+  inference — recurrent O(1) decode + sampling
+"""
+
+__version__ = "0.1.0"
+
+from mamba_distributed_tpu.config import (
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+    get_preset,
+    PRESETS,
+)
+
+__all__ = [
+    "DataConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "TrainConfig",
+    "get_preset",
+    "PRESETS",
+    "__version__",
+]

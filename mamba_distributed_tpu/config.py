@@ -1,0 +1,319 @@
+"""Configuration system for the TPU-native Mamba framework.
+
+The reference (pie33000/mamba-distributed) has no config system: every
+hyperparameter is a hard-coded constant (train.py:43-53,75,89-94,114;
+dataloader.py:23; eval.py:14).  Here everything becomes a typed dataclass
+field, with named presets for the five BASELINE.json configurations.
+
+Model defaults mirror the semantics of ``mamba_ssm.models.config_mamba.
+MambaConfig`` (mamba-ssm 2.2.2) plus the mixer defaults in
+``modules/mamba_simple.py`` (Mamba-1) and ``modules/mamba2.py`` (Mamba-2),
+which is what ``MambaConfig(d_model=768, vocab_size=50304)`` at
+reference train.py:75 actually builds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture config (reference: mamba_ssm MambaConfig + mixer defaults)."""
+
+    d_model: int = 768
+    n_layer: int = 64
+    vocab_size: int = 50304
+    # mamba_ssm MambaConfig.pad_vocab_size_multiple=8; 50304 is already padded.
+    pad_vocab_size_multiple: int = 8
+    # "mamba1" -> selective-scan mixer (what the reference's default ssm_cfg
+    # builds, see SURVEY.md section 2.4); "mamba2" -> SSD mixer (the headline).
+    ssm_layer: str = "mamba2"
+    # 0 => no MLP between mixers (pure mixer stack, the reference default).
+    d_intermediate: int = 0
+    rms_norm: bool = True
+    residual_in_fp32: bool = True
+    tie_embeddings: bool = True
+    norm_eps: float = 1e-5
+
+    # --- shared mixer knobs (mamba_simple.py / mamba2.py defaults) ---
+    d_state: int = 0  # 0 => auto: 16 for mamba1, 128 for mamba2
+    d_conv: int = 4
+    expand: int = 2
+    conv_bias: bool = True
+    proj_bias: bool = False
+    dt_min: float = 0.001
+    dt_max: float = 0.1
+    dt_init_floor: float = 1e-4
+
+    # --- mamba1-only ---
+    dt_rank: int = 0  # 0 => auto: ceil(d_model / 16)
+    dt_init: str = "random"  # "random" | "constant"
+    dt_scale: float = 1.0
+
+    # --- mamba2-only ---
+    headdim: int = 64
+    ngroups: int = 1
+    chunk_size: int = 256
+    a_init_min: float = 1.0
+    a_init_max: float = 16.0
+    d_has_hdim: bool = False
+
+    # --- hybrid (Jamba-style) attention layers; empty => pure SSM stack ---
+    attn_layer_idx: tuple[int, ...] = ()
+    attn_num_heads: int = 0  # 0 => auto: d_model // 64
+    attn_num_kv_heads: int = 0  # 0 => same as attn_num_heads (MHA)
+    attn_rotary_dim: int = 0  # 0 => full head dim
+    rope_theta: float = 10000.0
+
+    # --- precision policy (reference: bf16 autocast + fp32 master weights,
+    # train.py:72,142,211) ---
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    # --- init ---
+    initializer_range: float = 0.02  # embedding init std (mamba_ssm _init_weights)
+    rescale_prenorm_residual: bool = True
+
+    # --- memory ---
+    remat: bool = True  # per-block activation checkpointing
+
+    @property
+    def vocab_size_padded(self) -> int:
+        m = self.pad_vocab_size_multiple
+        return ((self.vocab_size + m - 1) // m) * m
+
+    @property
+    def d_inner(self) -> int:
+        return self.expand * self.d_model
+
+    @property
+    def effective_d_state(self) -> int:
+        if self.d_state:
+            return self.d_state
+        return 128 if self.ssm_layer == "mamba2" else 16
+
+    @property
+    def effective_dt_rank(self) -> int:
+        return self.dt_rank or math.ceil(self.d_model / 16)
+
+    @property
+    def nheads(self) -> int:
+        assert self.d_inner % self.headdim == 0
+        return self.d_inner // self.headdim
+
+    @property
+    def effective_attn_num_heads(self) -> int:
+        return self.attn_num_heads or self.d_model // 64
+
+    @property
+    def effective_attn_num_kv_heads(self) -> int:
+        return self.attn_num_kv_heads or self.effective_attn_num_heads
+
+    def num_params(self) -> int:
+        """Analytic parameter count (used for MFU and sanity checks)."""
+        d, v = self.d_model, self.vocab_size_padded
+        di, ds = self.d_inner, self.effective_d_state
+        n = 0
+        n += v * d  # embedding (tied head adds nothing)
+        if not self.tie_embeddings:
+            n += v * d
+        for i in range(self.n_layer):
+            n += d  # pre-norm scale
+            if i in self.attn_layer_idx:
+                nh = self.effective_attn_num_heads
+                nkv = self.effective_attn_num_kv_heads
+                hd = d // nh
+                n += d * (nh * hd) + 2 * d * (nkv * hd) + (nh * hd) * d
+            elif self.ssm_layer == "mamba1":
+                dtr = self.effective_dt_rank
+                n += d * 2 * di  # in_proj
+                n += di * self.d_conv + (di if self.conv_bias else 0)
+                n += di * (dtr + 2 * ds)  # x_proj
+                n += dtr * di + di  # dt_proj (+bias always)
+                n += di * ds  # A_log
+                n += di  # D
+                n += di * d  # out_proj
+            else:  # mamba2
+                g, nh = self.ngroups, self.nheads
+                d_in_proj = 2 * di + 2 * g * ds + nh
+                conv_dim = di + 2 * g * ds
+                n += d * d_in_proj
+                n += conv_dim * self.d_conv + (conv_dim if self.conv_bias else 0)
+                n += nh  # dt_bias
+                n += nh  # A_log
+                n += di if self.d_has_hdim else nh  # D
+                n += di  # gated norm scale
+                n += di * d  # out_proj
+            if self.d_intermediate > 0:
+                n += d  # second norm
+                n += d * self.d_intermediate * 2 + self.d_intermediate * d  # gated MLP
+        n += d  # final norm
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. Axis sizes of 1 collapse that axis.
+
+    data  - pure data parallel (gradients psum'd, params replicated)
+    fsdp  - data parallel + param/optimizer-state sharding (ZeRO-3 style)
+    seq   - sequence/context parallelism (SSD chunk-state passing, ring attn)
+    tensor- tensor parallelism over d_inner/heads
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.seq * self.tensor
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", "fsdp", "seq", "tensor")
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.seq, self.tensor)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Token-shard data pipeline (reference: dataloader.py)."""
+
+    data_dir: str = "edu_fineweb10B"  # reference dataloader.py:23
+    # If True and data_dir is missing, generate deterministic synthetic shards
+    # (the real 10B-token corpus is "bring your own data", reference README).
+    allow_synthetic: bool = True
+    synthetic_tokens_per_shard: int = 2_097_152
+    synthetic_num_shards: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training loop config (reference: train.py:43-53,89-110,114,133)."""
+
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+
+    total_batch_size: int = 524288  # tokens/step (train.py:43)
+    micro_batch_size: int = 32  # B (train.py:44)
+    seq_len: int = 1024  # T (train.py:45)
+
+    max_lr: float = 6e-4
+    min_lr_ratio: float = 0.1
+    warmup_steps: int = 715
+    max_steps: int = 19073
+    weight_decay: float = 0.1
+    adam_b1: float = 0.9
+    adam_b2: float = 0.95
+    adam_eps: float = 1e-8
+    grad_clip: float = 1.0
+
+    seed: int = 1337  # train.py:37
+
+    val_every: int = 250  # train.py:133
+    val_steps: int = 20  # train.py:138
+    sample_every: int = 250  # train.py:166
+    checkpoint_every: int = 1000  # train.py:152
+    log_dir: str = "log"
+
+    # FSDP / remat
+    shard_params: bool = False  # shard params+opt state over the fsdp axis
+    remat: bool = True  # per-block activation checkpointing
+
+    @property
+    def grad_accum_steps(self) -> int:
+        denom = self.micro_batch_size * self.seq_len * self.data_parallel_size
+        assert self.total_batch_size % denom == 0, (
+            "make sure total_batch_size is divisible by B * T * dp_size"
+        )
+        return self.total_batch_size // denom
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.mesh.data * self.mesh.fsdp
+
+
+def _mk(model: Mapping[str, Any], train: Mapping[str, Any]) -> TrainConfig:
+    mesh = train.pop("mesh", {})
+    data = train.pop("data", {})
+    return TrainConfig(
+        model=ModelConfig(**dict(model)),
+        mesh=MeshConfig(**dict(mesh)),
+        data=DataConfig(**dict(data)),
+        **dict(train),
+    )
+
+
+# The five BASELINE.json configurations.
+PRESETS: dict[str, TrainConfig] = {
+    # 1. repo default: Mamba-2 280M, seq 1024, single chip
+    "mamba2-280m": _mk(
+        dict(d_model=768, n_layer=64, ssm_layer="mamba2"),
+        dict(),
+    ),
+    # reference train.py:75 as-written actually builds Mamba-1 (SURVEY 2.4)
+    "mamba1-280m": _mk(
+        dict(d_model=768, n_layer=64, ssm_layer="mamba1"),
+        dict(),
+    ),
+    # 2. 280M data-parallel over 8 chips (DDP -> pjit drop-in)
+    "mamba2-280m-dp8": _mk(
+        dict(d_model=768, n_layer=64, ssm_layer="mamba2"),
+        dict(mesh=dict(data=8)),
+    ),
+    # 3. 1.3B FSDP on 16 chips (param + optimizer-state sharding)
+    "mamba2-1.3b-fsdp16": _mk(
+        dict(d_model=2048, n_layer=48, ssm_layer="mamba2"),
+        dict(
+            mesh=dict(fsdp=16),
+            shard_params=True,
+            micro_batch_size=8,
+            total_batch_size=1048576,
+        ),
+    ),
+    # 4. 2.8B long-context: seq 8192, sequence-parallel over 32 chips
+    "mamba2-2.8b-sp32": _mk(
+        dict(d_model=2560, n_layer=64, ssm_layer="mamba2"),
+        dict(
+            mesh=dict(fsdp=8, seq=4),
+            shard_params=True,
+            seq_len=8192,
+            micro_batch_size=8,
+            total_batch_size=2097152,
+        ),
+    ),
+    # 5. Jamba-style hybrid 7B (attention every 8th layer) on 64 chips
+    "hybrid-7b": _mk(
+        dict(
+            d_model=4096,
+            n_layer=32,
+            ssm_layer="mamba2",
+            d_intermediate=14336,
+            attn_layer_idx=tuple(range(3, 32, 8)),
+            attn_num_heads=32,
+            attn_num_kv_heads=8,
+        ),
+        dict(
+            mesh=dict(fsdp=16, seq=4),
+            shard_params=True,
+            seq_len=4096,
+            micro_batch_size=4,
+            total_batch_size=4194304,
+        ),
+    ),
+}
+
+
+def get_preset(name: str, **overrides: Any) -> TrainConfig:
+    cfg = PRESETS[name]
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg

@@ -1,0 +1,35 @@
+"""TPU-native ops: the in-tree equivalents of the reference's CUDA/Triton
+kernel dependencies (SURVEY.md section 2.2)."""
+
+from mamba_distributed_tpu.ops.conv import causal_conv1d, causal_conv1d_update
+from mamba_distributed_tpu.ops.norm import add_rms_norm, rms_norm, rms_norm_gated
+from mamba_distributed_tpu.ops.scan import (
+    selective_scan,
+    selective_scan_seq,
+    selective_state_update,
+)
+from mamba_distributed_tpu.ops.ssd import (
+    chunk_local,
+    segsum,
+    ssd_chunked,
+    ssd_seq,
+    ssd_state_update,
+    state_passing,
+)
+
+__all__ = [
+    "causal_conv1d",
+    "causal_conv1d_update",
+    "add_rms_norm",
+    "rms_norm",
+    "rms_norm_gated",
+    "selective_scan",
+    "selective_scan_seq",
+    "selective_state_update",
+    "chunk_local",
+    "segsum",
+    "ssd_chunked",
+    "ssd_seq",
+    "ssd_state_update",
+    "state_passing",
+]

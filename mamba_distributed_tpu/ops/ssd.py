@@ -1,0 +1,298 @@
+"""SSD chunked scan (Mamba-2 "state-space duality"), TPU-native.
+
+Equivalent of the reference dependency's Triton SSD kernels
+(``mamba_ssm/ops/triton/ssd_combined.py``, ``ssd_chunk_scan.py``,
+``ssd_chunk_state.py``, ``ssd_state_passing.py``, ``ssd_bmm.py`` in
+mamba-ssm 2.2.2, pinned at reference requirements.txt:2).
+
+The algorithm is re-derived for the MXU rather than translated: the sequence
+is split into chunks of length L; within a chunk the recurrence is expressed
+as batched (L x N) @ (N x L) and (L x L) @ (L x P) matmuls (pure MXU work),
+while the tiny per-chunk states (H, P, N) flow through an associative scan
+over chunks.  The same per-chunk state decomposition is what sequence
+parallelism rides on (each device computes its local chunk states; only the
+(H, P, N) boundary states cross devices — see parallel/seq_parallel.py and
+SURVEY.md section 5).
+
+Recurrence (per batch, head h, state n, head-channel p):
+    h_t = exp(dt_t * A_h) * h_{t-1} + dt_t * B_t  x_t^T        (outer product)
+    y_t = C_t . h_t + D_h * x_t
+
+Shapes (group g broadcasts over the heads it owns, heads-per-group = H/G):
+    x  (b, t, h, p)      dt (b, t, h)   [already bias-added + softplus-ed]
+    A  (h,) negative     B, C (b, t, g, n)
+    D  (h,) or (h, p)    initial_state (b, h, p, n)
+
+Decay math runs in fp32 (differences of cumulative log-decays stay <= 0, so
+exp() never overflows); the big matmuls run in the compute dtype with fp32
+accumulation (``preferred_element_type``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segsum(x: jax.Array) -> jax.Array:
+    """Segment-sum: out[..., i, j] = sum_{k in (j, i]} x[..., k] for i >= j.
+
+    Returns -inf above the diagonal so that exp(segsum) is the lower-
+    triangular decay matrix with ones on the diagonal.
+    """
+    l = x.shape[-1]
+    cs = jnp.cumsum(x, axis=-1)
+    d = cs[..., :, None] - cs[..., None, :]
+    mask = jnp.tril(jnp.ones((l, l), dtype=bool))
+    return jnp.where(mask, d, -jnp.inf)
+
+
+def _expand_groups(BC: jax.Array, nheads: int) -> jax.Array:
+    """(b, t, g, n) -> (b, t, h, n) by repeating each group over its heads."""
+    g = BC.shape[2]
+    if g == nheads:
+        return BC
+    assert nheads % g == 0
+    return jnp.repeat(BC, nheads // g, axis=2)
+
+
+def ssd_seq(
+    x: jax.Array,
+    dt: jax.Array,
+    A: jax.Array,
+    B: jax.Array,
+    C: jax.Array,
+    D: jax.Array | None = None,
+    initial_state: jax.Array | None = None,
+    return_final_state: bool = False,
+):
+    """Oracle: sequential scan over time (fp32 throughout)."""
+    b, t, h, p = x.shape
+    n = B.shape[-1]
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    Af = A.astype(jnp.float32)
+    Bf = _expand_groups(B, h).astype(jnp.float32)
+    Cf = _expand_groups(C, h).astype(jnp.float32)
+
+    s0 = (
+        jnp.zeros((b, h, p, n), jnp.float32)
+        if initial_state is None
+        else initial_state.astype(jnp.float32)
+    )
+
+    def step(s, inputs):
+        x_t, dt_t, B_t, C_t = inputs  # (b,h,p) (b,h) (b,h,n) (b,h,n)
+        decay = jnp.exp(dt_t * Af[None])  # (b, h)
+        s = s * decay[:, :, None, None] + jnp.einsum(
+            "bhp,bhn,bh->bhpn", x_t, B_t, dt_t
+        )
+        y_t = jnp.einsum("bhpn,bhn->bhp", s, C_t)
+        return s, y_t
+
+    xs = (
+        jnp.moveaxis(xf, 1, 0),
+        jnp.moveaxis(dtf, 1, 0),
+        jnp.moveaxis(Bf, 1, 0),
+        jnp.moveaxis(Cf, 1, 0),
+    )
+    s_last, ys = jax.lax.scan(step, s0, xs)
+    y = jnp.moveaxis(ys, 0, 1)
+    if D is not None:
+        Df = D.astype(jnp.float32)
+        y = y + xf * (Df[None, None, :, :] if Df.ndim == 2 else Df[None, None, :, None])
+    y = y.astype(x.dtype)
+    if return_final_state:
+        return y, s_last
+    return y
+
+
+def chunk_local(
+    x: jax.Array,
+    dt: jax.Array,
+    A: jax.Array,
+    B: jax.Array,
+    C: jax.Array,
+    chunk_size: int,
+    compute_dtype=jnp.bfloat16,
+):
+    """Per-chunk compute: diagonal-block outputs + chunk summaries.
+
+    This is the device-local portion of SSD — everything except the
+    inter-chunk state recurrence.  Sequence parallelism calls this on the
+    local shard and runs the state recurrence across devices.
+
+    Returns:
+      y_diag       (b, nc, l, h, p) intra-chunk contribution
+      states       (b, nc, h, p, n) per-chunk final state contribution
+      chunk_decay  (b, nc, h)       exp(sum of dt*A over the chunk)
+      c_decayed    (b, nc, l, h, n) C * exp(cumsum dt*A) for the off-diag term
+    """
+    b, t, h, p = x.shape
+    n = B.shape[-1]
+    l = chunk_size
+    assert t % l == 0, (t, l)
+    nc = t // l
+
+    dtf = dt.astype(jnp.float32)
+    Af = A.astype(jnp.float32)
+    Bh = _expand_groups(B, h)
+    Ch = _expand_groups(C, h)
+
+    xc = x.reshape(b, nc, l, h, p)
+    dtc = dtf.reshape(b, nc, l, h)
+    Bc = Bh.reshape(b, nc, l, h, n)
+    Cc = Ch.reshape(b, nc, l, h, n)
+
+    dA = dtc * Af  # (b, nc, l, h), <= 0
+    dA_cum = jnp.cumsum(dA, axis=2)  # inclusive cumsum within chunk
+
+    # --- intra-chunk (diagonal blocks): batched MXU matmuls ---
+    # G[i, j] = <C_i, B_j>  -> (b, nc, h, l, l)
+    G = jnp.einsum(
+        "bclhn,bcshn->bchls",
+        Cc.astype(compute_dtype),
+        Bc.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    L_mat = jnp.exp(segsum(jnp.moveaxis(dA, 2, -1)))  # (b, nc, h, l, l)
+    M = G * L_mat
+    xdt = xc.astype(jnp.float32) * dtc[..., None]  # (b, nc, l, h, p)
+    y_diag = jnp.einsum(
+        "bchls,bcshp->bclhp",
+        M.astype(compute_dtype),
+        xdt.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+    # --- per-chunk state summaries ---
+    decay_states = jnp.exp(dA_cum[:, :, -1:, :] - dA_cum)  # (b, nc, l, h)
+    states = jnp.einsum(
+        "bclhn,bclhp->bchpn",
+        (Bc.astype(jnp.float32) * (decay_states * dtc)[..., None]).astype(
+            compute_dtype
+        ),
+        xc.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    chunk_decay = jnp.exp(dA_cum[:, :, -1, :])  # (b, nc, h)
+    c_decayed = Cc.astype(jnp.float32) * jnp.exp(dA_cum)[..., None]
+    return y_diag, states, chunk_decay, c_decayed
+
+
+def state_passing(
+    states: jax.Array,
+    chunk_decay: jax.Array,
+    initial_state: jax.Array | None = None,
+):
+    """Inter-chunk state recurrence via associative scan.
+
+    states (b, nc, h, p, n); chunk_decay (b, nc, h).
+    Returns (prev_states (b, nc, h, p, n) — the state *entering* each chunk —
+    and final_state (b, h, p, n)).
+    """
+    b, nc, h, p, n = states.shape
+    decay = chunk_decay[..., None, None]  # (b, nc, h, 1, 1)
+
+    def combine(left, right):
+        a_l, s_l = left
+        a_r, s_r = right
+        # a stays (b, nc, h, 1, 1); broadcasting happens only against states
+        return a_l * a_r, s_l * a_r + s_r
+
+    a_cum, s_cum = jax.lax.associative_scan(combine, (decay, states), axis=1)
+    # s_cum[c] = state *after* chunk c assuming zero initial state.
+    if initial_state is not None:
+        s0 = initial_state.astype(states.dtype)[:, None]
+        s_cum = s_cum + a_cum * s0
+    final_state = s_cum[:, -1]
+    # state entering chunk c = s_cum[c-1]; chunk 0 gets the initial state.
+    s0_in = (
+        jnp.zeros((b, 1, h, p, n), states.dtype)
+        if initial_state is None
+        else initial_state.astype(states.dtype)[:, None]
+    )
+    prev_states = jnp.concatenate([s0_in, s_cum[:, :-1]], axis=1)
+    return prev_states, final_state
+
+
+def ssd_chunked(
+    x: jax.Array,
+    dt: jax.Array,
+    A: jax.Array,
+    B: jax.Array,
+    C: jax.Array,
+    chunk_size: int = 256,
+    D: jax.Array | None = None,
+    initial_state: jax.Array | None = None,
+    return_final_state: bool = False,
+    compute_dtype=jnp.bfloat16,
+):
+    """Full chunked SSD forward (single device).
+
+    Wall-to-wall: chunk_local -> state_passing -> off-diagonal correction.
+    Autodiff-friendly; the backward pass is XLA-derived from the same matmul
+    graph (all matmuls, so it stays on the MXU).
+    """
+    from mamba_distributed_tpu.ops.scan import _divisor_chunk
+
+    b, t, h, p = x.shape
+    l = _divisor_chunk(t, chunk_size)
+
+    y_diag, states, chunk_decay, c_decayed = chunk_local(
+        x, dt, A, B, C, l, compute_dtype
+    )
+    prev_states, final_state = state_passing(states, chunk_decay, initial_state)
+    # off-diagonal: contribution of earlier chunks through the carried state
+    y_off = jnp.einsum(
+        "bclhn,bchpn->bclhp",
+        c_decayed.astype(compute_dtype),
+        prev_states.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    y = (y_diag + y_off).reshape(b, t, h, p)
+    if D is not None:
+        Df = D.astype(jnp.float32)
+        y = y + x.astype(jnp.float32) * (
+            Df[None, None, :, :] if Df.ndim == 2 else Df[None, None, :, None]
+        )
+    y = y.astype(x.dtype)
+    if return_final_state:
+        return y, final_state
+    return y
+
+
+def ssd_state_update(
+    ssm_state: jax.Array,
+    x_t: jax.Array,
+    dt_t: jax.Array,
+    A: jax.Array,
+    B_t: jax.Array,
+    C_t: jax.Array,
+    D: jax.Array | None = None,
+    dt_bias: jax.Array | None = None,
+    dt_softplus: bool = True,
+):
+    """O(1)-per-token recurrent step for decode (Mamba-2 shapes).
+
+    Equivalent of ``selective_state_update`` applied to the multi-head SSD
+    state.  ssm_state (b, h, p, n); x_t (b, h, p); dt_t (b, h);
+    B_t/C_t (b, g, n).  Returns (y_t (b, h, p), new_state).
+    """
+    b, h, p, n = ssm_state.shape
+    sf = ssm_state.astype(jnp.float32)
+    xf = x_t.astype(jnp.float32)
+    dtf = dt_t.astype(jnp.float32)
+    if dt_bias is not None:
+        dtf = dtf + dt_bias.astype(jnp.float32)
+    if dt_softplus:
+        dtf = jax.nn.softplus(dtf)
+    Bh = _expand_groups(B_t[:, None], h)[:, 0].astype(jnp.float32)  # (b, h, n)
+    Ch = _expand_groups(C_t[:, None], h)[:, 0].astype(jnp.float32)
+    decay = jnp.exp(dtf * A.astype(jnp.float32)[None])  # (b, h)
+    s = sf * decay[:, :, None, None] + jnp.einsum("bhp,bhn,bh->bhpn", xf, Bh, dtf)
+    y = jnp.einsum("bhpn,bhn->bhp", s, Ch)
+    if D is not None:
+        Df = D.astype(jnp.float32)
+        y = y + xf * (Df[None] if Df.ndim == 2 else Df[None, :, None])
+    return y.astype(x_t.dtype), s

@@ -1,0 +1,322 @@
+"""Kernel unit tests: production ops vs pure-JAX oracles (SURVEY.md section 4).
+
+Tolerances follow the survey's test plan: ~1e-5 in fp32, ~1e-2 in bf16, for
+both forward values and gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu import ops
+
+
+def _rand(key, shape, dtype=jnp.float32, scale=1.0):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+# ---------------------------------------------------------------- conv1d
+
+
+class TestCausalConv1d:
+    def test_matches_numpy_reference(self, rng):
+        b, t, d, w = 2, 17, 8, 4
+        k1, k2, k3 = jax.random.split(rng, 3)
+        x = _rand(k1, (b, t, d))
+        weight = _rand(k2, (d, w))
+        bias = _rand(k3, (d,))
+        y = ops.causal_conv1d(x, weight, bias, activation=None)
+
+        xn, wn, bn = np.asarray(x), np.asarray(weight), np.asarray(bias)
+        xp = np.concatenate([np.zeros((b, w - 1, d)), xn], axis=1)
+        expected = np.zeros((b, t, d))
+        for i in range(t):
+            # output i depends on inputs i-w+1 .. i
+            window = xp[:, i : i + w, :]  # (b, w, d)
+            expected[:, i, :] = np.einsum("bwd,dw->bd", window, wn) + bn
+        np.testing.assert_allclose(np.asarray(y), expected, atol=1e-5)
+
+    def test_causality(self, rng):
+        b, t, d, w = 1, 12, 4, 4
+        k1, k2 = jax.random.split(rng)
+        x = _rand(k1, (b, t, d))
+        weight = _rand(k2, (d, w))
+        y1 = ops.causal_conv1d(x, weight, activation=None)
+        # perturb the future: outputs at earlier positions must not change
+        x2 = x.at[:, 7:, :].set(99.0)
+        y2 = ops.causal_conv1d(x2, weight, activation=None)
+        np.testing.assert_allclose(np.asarray(y1[:, :7]), np.asarray(y2[:, :7]), atol=1e-6)
+
+    def test_initial_state_splices_sequences(self, rng):
+        """Running [x1; x2] at once == running x1 then x2 with carried state."""
+        b, t, d, w = 2, 16, 6, 4
+        k1, k2, k3 = jax.random.split(rng, 3)
+        x = _rand(k1, (b, t, d))
+        weight = _rand(k2, (d, w))
+        bias = _rand(k3, (d,))
+        y_full = ops.causal_conv1d(x, weight, bias)
+        y1, state = ops.causal_conv1d(
+            x[:, : t // 2], weight, bias, return_final_state=True
+        )
+        y2 = ops.causal_conv1d(x[:, t // 2 :], weight, bias, initial_state=state)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([y1, y2], axis=1)),
+            np.asarray(y_full),
+            atol=1e-5,
+        )
+
+    def test_update_matches_full(self, rng):
+        b, t, d, w = 2, 10, 6, 4
+        k1, k2, k3 = jax.random.split(rng, 3)
+        x = _rand(k1, (b, t, d))
+        weight = _rand(k2, (d, w))
+        bias = _rand(k3, (d,))
+        y_full = ops.causal_conv1d(x, weight, bias)
+        state = jnp.zeros((b, w - 1, d))
+        ys = []
+        for i in range(t):
+            y_t, state = ops.causal_conv1d_update(x[:, i], state, weight, bias)
+            ys.append(y_t)
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(ys, axis=1)), np.asarray(y_full), atol=1e-5
+        )
+
+
+# ---------------------------------------------------------------- norms
+
+
+class TestNorms:
+    def test_rms_norm_basic(self, rng):
+        x = _rand(rng, (3, 5, 16))
+        w = jnp.ones((16,))
+        y = ops.rms_norm(x, w)
+        xn = np.asarray(x, np.float64)
+        expected = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), expected, atol=1e-5)
+
+    def test_add_rms_norm_residual_fp32(self, rng):
+        k1, k2 = jax.random.split(rng)
+        x = _rand(k1, (2, 4, 8), jnp.bfloat16)
+        res = _rand(k2, (2, 4, 8))
+        w = jnp.ones((8,))
+        y, new_res = ops.add_rms_norm(x, res, w)
+        assert new_res.dtype == jnp.float32
+        assert y.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(new_res),
+            np.asarray(x.astype(jnp.float32) + res),
+            atol=1e-6,
+        )
+
+    def test_rms_norm_gated(self, rng):
+        k1, k2 = jax.random.split(rng)
+        x = _rand(k1, (2, 3, 8))
+        z = _rand(k2, (2, 3, 8))
+        w = jnp.full((8,), 2.0)
+        y = ops.rms_norm_gated(x, z, w)
+        xz = np.asarray(x) * (np.asarray(z) / (1 + np.exp(-np.asarray(z))))
+        expected = xz / np.sqrt((xz**2).mean(-1, keepdims=True) + 1e-5) * 2.0
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-4, atol=1e-5)
+
+    def test_grouped_norm_matches_numpy(self, rng):
+        k1, k2 = jax.random.split(rng)
+        x = _rand(k1, (2, 3, 8))
+        z = _rand(k2, (2, 3, 8))
+        w = _rand(jax.random.PRNGKey(3), (8,))
+        y = ops.rms_norm_gated(x, z, w, group_size=4)
+        xz = np.asarray(x) * (np.asarray(z) / (1 + np.exp(-np.asarray(z))))
+        xg = xz.reshape(2, 3, 2, 4)  # contiguous groups of 4
+        normed = xg / np.sqrt((xg**2).mean(-1, keepdims=True) + 1e-5)
+        expected = normed.reshape(2, 3, 8) * np.asarray(w)
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- selective scan
+
+
+class TestSelectiveScan:
+    def _inputs(self, rng, b=2, t=64, d=8, n=4):
+        keys = jax.random.split(rng, 6)
+        u = _rand(keys[0], (b, t, d))
+        delta = _rand(keys[1], (b, t, d), scale=0.5)
+        A = -jnp.exp(_rand(keys[2], (d, n), scale=0.5))
+        B = _rand(keys[3], (b, t, n))
+        C = _rand(keys[4], (b, t, n))
+        D = _rand(keys[5], (d,))
+        return u, delta, A, B, C, D
+
+    def test_chunked_matches_seq(self, rng):
+        u, delta, A, B, C, D = self._inputs(rng)
+        z = _rand(jax.random.PRNGKey(7), u.shape)
+        y_ref = ops.selective_scan_seq(u, delta, A, B, C, D, z=z, delta_softplus=True)
+        y = ops.selective_scan(
+            u, delta, A, B, C, D, z=z, delta_softplus=True, chunk_size=16
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-4)
+
+    def test_odd_length_and_chunk(self, rng):
+        u, delta, A, B, C, D = self._inputs(rng, t=37)
+        y_ref = ops.selective_scan_seq(u, delta, A, B, C, D, delta_softplus=True)
+        y = ops.selective_scan(u, delta, A, B, C, D, delta_softplus=True, chunk_size=8)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-4)
+
+    def test_gradients_match(self, rng):
+        u, delta, A, B, C, D = self._inputs(rng, t=32, d=4, n=2)
+
+        def loss_seq(args):
+            return jnp.sum(
+                ops.selective_scan_seq(*args, delta_softplus=True) ** 2
+            )
+
+        def loss_chunk(args):
+            return jnp.sum(
+                ops.selective_scan(*args, delta_softplus=True, chunk_size=8) ** 2
+            )
+
+        args = (u, delta, A, B, C, D)
+        g_ref = jax.grad(loss_seq)(args)
+        g = jax.grad(loss_chunk)(args)
+        for a, b_ in zip(g_ref, g):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-3, rtol=1e-3)
+
+    def test_final_state_and_splicing(self, rng):
+        u, delta, A, B, C, D = self._inputs(rng, t=32)
+        y_full, h_full = ops.selective_scan_seq(
+            u, delta, A, B, C, D, delta_softplus=True, return_final_state=True
+        )
+        half = 16
+        y1, h1 = ops.selective_scan(
+            u[:, :half], delta[:, :half], A, B[:, :half], C[:, :half], D,
+            delta_softplus=True, return_final_state=True, chunk_size=8,
+        )
+        y2, h2 = ops.selective_scan(
+            u[:, half:], delta[:, half:], A, B[:, half:], C[:, half:], D,
+            delta_softplus=True, initial_state=h1, return_final_state=True,
+            chunk_size=8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([y1, y2], 1)), np.asarray(y_full), atol=1e-4
+        )
+        np.testing.assert_allclose(np.asarray(h2), np.asarray(h_full), atol=1e-4)
+
+    def test_state_update_matches_scan(self, rng):
+        u, delta, A, B, C, D = self._inputs(rng, b=1, t=8)
+        y_ref, h_ref = ops.selective_scan_seq(
+            u, delta, A, B, C, D, delta_softplus=True, return_final_state=True
+        )
+        h = jnp.zeros_like(h_ref)
+        ys = []
+        for i in range(u.shape[1]):
+            y_t, h = ops.selective_state_update(
+                h, u[:, i], delta[:, i], A, B[:, i], C[:, i], D, dt_softplus=True
+            )
+            ys.append(y_t)
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(ys, 1)), np.asarray(y_ref), atol=1e-4
+        )
+        np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref), atol=1e-4)
+
+
+# ---------------------------------------------------------------- SSD
+
+
+class TestSSD:
+    def _inputs(self, rng, b=2, t=64, h=4, p=8, g=2, n=16):
+        keys = jax.random.split(rng, 6)
+        x = _rand(keys[0], (b, t, h, p))
+        dt = jax.nn.softplus(_rand(keys[1], (b, t, h)))
+        A = -jnp.exp(_rand(keys[2], (h,), scale=0.5))
+        B = _rand(keys[3], (b, t, g, n))
+        C = _rand(keys[4], (b, t, g, n))
+        D = _rand(keys[5], (h,))
+        return x, dt, A, B, C, D
+
+    def test_segsum(self):
+        x = jnp.array([[1.0, 2.0, 3.0]])
+        s = ops.segsum(x)[0]
+        # s[i, j] = sum over (j, i]
+        np.testing.assert_allclose(np.diag(np.asarray(s)), 0.0, atol=1e-6)
+        assert np.isneginf(np.asarray(s)[0, 1])
+        np.testing.assert_allclose(float(s[2, 0]), 5.0, atol=1e-6)  # 2+3
+        np.testing.assert_allclose(float(s[1, 0]), 2.0, atol=1e-6)
+
+    def test_chunked_matches_seq_fp32(self, rng):
+        x, dt, A, B, C, D = self._inputs(rng)
+        y_ref = ops.ssd_seq(x, dt, A, B, C, D)
+        y = ops.ssd_chunked(x, dt, A, B, C, chunk_size=16, D=D, compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-4)
+
+    def test_chunked_bf16_close(self, rng):
+        x, dt, A, B, C, D = self._inputs(rng)
+        y_ref = ops.ssd_seq(x, dt, A, B, C, D)
+        y = ops.ssd_chunked(
+            x.astype(jnp.bfloat16), dt, A, B, C, chunk_size=16, D=D,
+            compute_dtype=jnp.bfloat16,
+        )
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), np.asarray(y_ref), atol=0.15, rtol=0.1
+        )
+
+    def test_chunk_size_invariance(self, rng):
+        x, dt, A, B, C, D = self._inputs(rng, t=48)
+        y16 = ops.ssd_chunked(x, dt, A, B, C, chunk_size=16, D=D, compute_dtype=jnp.float32)
+        y48 = ops.ssd_chunked(x, dt, A, B, C, chunk_size=48, D=D, compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(y16), np.asarray(y48), atol=1e-4)
+
+    def test_gradients_match_seq(self, rng):
+        x, dt, A, B, C, D = self._inputs(rng, b=1, t=32, h=2, p=4, g=1, n=8)
+
+        def loss_ref(args):
+            return jnp.sum(ops.ssd_seq(*args) ** 2)
+
+        def loss_chunk(args):
+            x_, dt_, A_, B_, C_, D_ = args
+            return jnp.sum(
+                ops.ssd_chunked(
+                    x_, dt_, A_, B_, C_, chunk_size=8, D=D_,
+                    compute_dtype=jnp.float32,
+                )
+                ** 2
+            )
+
+        args = (x, dt, A, B, C, D)
+        g_ref = jax.grad(loss_ref)(args)
+        g = jax.grad(loss_chunk)(args)
+        for a, b_ in zip(g_ref, g):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-3, rtol=5e-3
+            )
+
+    def test_initial_state_and_final_state(self, rng):
+        x, dt, A, B, C, D = self._inputs(rng, t=32)
+        y_full, s_full = ops.ssd_seq(x, dt, A, B, C, D, return_final_state=True)
+        half = 16
+        y1, s1 = ops.ssd_chunked(
+            x[:, :half], dt[:, :half], A, B[:, :half], C[:, :half],
+            chunk_size=8, D=D, return_final_state=True, compute_dtype=jnp.float32,
+        )
+        y2, s2 = ops.ssd_chunked(
+            x[:, half:], dt[:, half:], A, B[:, half:], C[:, half:],
+            chunk_size=8, D=D, initial_state=s1, return_final_state=True,
+            compute_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([y1, y2], 1)), np.asarray(y_full), atol=1e-4
+        )
+        np.testing.assert_allclose(np.asarray(s2), np.asarray(s_full), atol=1e-4)
+
+    def test_state_update_matches_scan(self, rng):
+        x, dt, A, B, C, D = self._inputs(rng, b=1, t=8, h=2, p=4, g=1, n=8)
+        y_ref, s_ref = ops.ssd_seq(x, dt, A, B, C, D, return_final_state=True)
+        s = jnp.zeros_like(s_ref)
+        ys = []
+        for i in range(x.shape[1]):
+            y_t, s = ops.ssd_state_update(
+                s, x[:, i], dt[:, i], A, B[:, i], C[:, i], D, dt_softplus=False
+            )
+            ys.append(y_t)
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(ys, 1)), np.asarray(y_ref), atol=1e-4
+        )
+        np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref), atol=1e-4)
