@@ -1,0 +1,27 @@
+"""Model stack: Mamba-1 / Mamba-2 mixers, attention, full LM.
+
+TPU-native functional models: parameters are plain pytrees (nested dicts of
+jnp arrays), built by explicit ``init_*`` functions and consumed by pure
+``apply`` functions — no module framework in the hot path, which keeps
+scan-over-layers, remat, and pjit sharding annotations fully explicit.
+"""
+
+from mamba_distributed_tpu.models.lm import (
+    init_lm_params,
+    lm_forward,
+    lm_loss,
+    count_params,
+)
+from mamba_distributed_tpu.models.mamba1 import init_mamba1_params, mamba1_mixer
+from mamba_distributed_tpu.models.mamba2 import init_mamba2_params, mamba2_mixer
+
+__all__ = [
+    "init_lm_params",
+    "lm_forward",
+    "lm_loss",
+    "count_params",
+    "init_mamba1_params",
+    "mamba1_mixer",
+    "init_mamba2_params",
+    "mamba2_mixer",
+]

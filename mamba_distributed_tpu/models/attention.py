@@ -1,0 +1,168 @@
+"""Causal self-attention with RoPE (hybrid Jamba-style layers).
+
+Functional equivalent of ``mamba_ssm.modules.mha.MHA`` as used by hybrid
+configs via ``attn_layer_idx``/``attn_cfg`` (mamba-ssm 2.2.2; the reference
+never enables it — SURVEY.md §2.3 — but BASELINE.json config 5 requires it).
+
+GQA layout: packed qkv projection, ``num_heads`` query heads sharing
+``num_kv_heads`` KV heads; rotary embedding on the leading ``rotary_dim``
+of each head.  Under sequence parallelism the score/value contraction runs
+as ring attention over the mesh's ``seq`` axis (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.models.common import init_linear, linear
+
+
+def _attn_dims(cfg: ModelConfig):
+    nh = cfg.effective_attn_num_heads
+    nkv = cfg.effective_attn_num_kv_heads
+    hd = cfg.d_model // nh
+    rot = cfg.attn_rotary_dim or hd
+    return nh, nkv, hd, rot
+
+
+def init_attention_params(key: jax.Array, cfg: ModelConfig) -> dict:
+    nh, nkv, hd, _ = _attn_dims(cfg)
+    k_qkv, k_out = jax.random.split(key)
+    params = {
+        "wqkv": init_linear(k_qkv, cfg.d_model, (nh + 2 * nkv) * hd, cfg.proj_bias),
+        "out_proj": init_linear(k_out, nh * hd, cfg.d_model, cfg.proj_bias),
+    }
+    if cfg.rescale_prenorm_residual:
+        n_residuals = 2 if cfg.d_intermediate > 0 else 1
+        params["out_proj"]["kernel"] = params["out_proj"]["kernel"] / math.sqrt(
+            n_residuals * cfg.n_layer
+        )
+    return params
+
+
+def rope_angles(positions: jax.Array, rotary_dim: int, theta: float) -> jax.Array:
+    """(t,) int positions -> (t, rotary_dim/2) angles."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
+    )
+    return positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate the leading ``2*angles.shape[-1]`` channels of each head.
+
+    x (b, t, h, hd); angles (t, rot/2).  Interleaved (GPT-NeoX "rotate
+    half") convention on the rotary slice; the tail passes through.
+    """
+    rot = 2 * angles.shape[-1]
+    xr, x_pass = x[..., :rot], x[..., rot:]
+    xf = xr.astype(jnp.float32).reshape(*xr.shape[:-1], rot // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    o1 = x1 * cos - x2 * sin
+    o2 = x1 * sin + x2 * cos
+    out = jnp.stack([o1, o2], axis=-1).reshape(xr.shape).astype(x.dtype)
+    return jnp.concatenate([out, x_pass], axis=-1) if x_pass.size else out
+
+
+def _split_qkv(qkv: jax.Array, cfg: ModelConfig):
+    nh, nkv, hd, _ = _attn_dims(cfg)
+    b, t, _ = qkv.shape
+    q = qkv[..., : nh * hd].reshape(b, t, nh, hd)
+    k = qkv[..., nh * hd : (nh + nkv) * hd].reshape(b, t, nkv, hd)
+    v = qkv[..., (nh + nkv) * hd :].reshape(b, t, nkv, hd)
+    return q, k, v
+
+
+def _sdpa_causal(q, k, v, offset: int = 0):
+    """Causal softmax(QK^T/sqrt(d))V with GQA broadcast, fp32 softmax.
+
+    q (b, tq, nh, hd); k/v (b, tk, nkv, hd); ``offset`` = absolute position
+    of q[0] minus that of k[0] (for decode with cache).
+    """
+    b, tq, nh, hd = q.shape
+    nkv = k.shape[2]
+    rep = nh // nkv
+    qh = q.reshape(b, tq, nkv, rep, hd)
+    scores = jnp.einsum(
+        "bqgrh,bkgh->bgrqk", qh, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)
+    qpos = jnp.arange(tq)[:, None] + offset
+    kpos = jnp.arange(k.shape[1])[None, :]
+    scores = jnp.where(qpos >= kpos, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrqk,bkgh->bqgrh", w, v, preferred_element_type=jnp.float32)
+    return out.reshape(b, tq, nh, hd).astype(q.dtype)
+
+
+def attention_mixer(
+    params: dict,
+    cfg: ModelConfig,
+    u: jax.Array,
+    initial_state=None,
+    return_final_state: bool = False,
+    seq_ctx=None,
+):
+    """Full-sequence causal attention.  u (b, t, d) -> (b, t, d).
+
+    The decode "state" is the (k_cache, v_cache, length) triple; for the
+    full-sequence path with ``return_final_state`` the caches hold the whole
+    sequence (used by prefill).
+    """
+    nh, nkv, hd, rot = _attn_dims(cfg)
+    b, t, _ = u.shape
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+
+    qkv = linear(params["wqkv"], u, compute_dtype)
+    q, k, v = _split_qkv(qkv, cfg)
+    angles = rope_angles(jnp.arange(t), rot, cfg.rope_theta)
+    q = apply_rope(q, angles)
+    k = apply_rope(k, angles)
+
+    if seq_ctx is not None:
+        from mamba_distributed_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(seq_ctx, q, k, v)
+    else:
+        out = _sdpa_causal(q, k, v)
+    y = linear(params["out_proj"], out.reshape(b, t, nh * hd), compute_dtype)
+    if return_final_state:
+        return y, (k, v, jnp.array(t, jnp.int32))
+    return y
+
+
+def init_attention_state(cfg: ModelConfig, batch: int, max_len: int,
+                         dtype=jnp.bfloat16):
+    nh, nkv, hd, _ = _attn_dims(cfg)
+    k = jnp.zeros((batch, max_len, nkv, hd), dtype)
+    v = jnp.zeros((batch, max_len, nkv, hd), dtype)
+    return k, v, jnp.array(0, jnp.int32)
+
+
+def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array, state):
+    """Single-token decode with a fixed-capacity KV cache.
+
+    u_t (b, d); state = (k_cache (b, L, nkv, hd), v_cache, length).
+    """
+    nh, nkv, hd, rot = _attn_dims(cfg)
+    b, _ = u_t.shape
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    k_cache, v_cache, length = state
+
+    qkv = linear(params["wqkv"], u_t[:, None, :], compute_dtype)
+    q, k, v = _split_qkv(qkv, cfg)
+    angles = rope_angles(length[None], rot, cfg.rope_theta)
+    q = apply_rope(q, angles)
+    k = apply_rope(k, angles)
+
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), length, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), length, axis=1)
+    # mask out cache slots beyond the current length via the causal offset
+    out = _sdpa_causal(q, k_cache, v_cache, offset=length)
+    y = linear(params["out_proj"], out.reshape(b, nh * hd), compute_dtype)
+    return y, (k_cache, v_cache, length + 1)

@@ -1,0 +1,76 @@
+"""Shared init + linear helpers for the functional model stack.
+
+Initialization matches the *distributions* used by the reference's model
+builder (``mamba_ssm.models.mixer_seq_simple._init_weights`` and the mixer
+constructors in mamba-ssm 2.2.2, the package pinned at reference
+requirements.txt:2):
+
+  * Linear weights: kaiming-uniform(a=sqrt(5)) == U(-1/sqrt(fan_in), +1/sqrt(fan_in))
+  * Linear biases: zeros (except dt/conv, which have special inits)
+  * Embedding: N(0, initializer_range=0.02)
+  * Residual out-projections: same uniform, then / sqrt(n_residuals * n_layer)
+    when ``rescale_prenorm_residual`` (GPT-2-style depth rescale)
+  * Depthwise conv: PyTorch Conv1d default == U(+-1/sqrt(width)) for both
+    weight and bias (fan_in = in_channels/groups * width = width)
+
+Weights are stored as (in_features, out_features) so the forward pass is a
+plain ``x @ W`` (row-major friendly for the MXU); this is the transpose of
+the torch convention, handled by the HF importer.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def uniform_fan_in(key: jax.Array, shape: tuple[int, ...], fan_in: int,
+                   dtype=jnp.float32) -> jax.Array:
+    """PyTorch Linear/Conv default init: U(-1/sqrt(fan_in), +1/sqrt(fan_in))."""
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def init_linear(key: jax.Array, d_in: int, d_out: int, bias: bool = False,
+                dtype=jnp.float32) -> dict:
+    p = {"kernel": uniform_fan_in(key, (d_in, d_out), d_in, dtype)}
+    if bias:
+        p["bias"] = jnp.zeros((d_out,), dtype)
+    return p
+
+
+def linear(params: dict, x: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
+    """bf16 matmul with fp32 accumulation (MXU-native), bf16 output."""
+    y = jnp.dot(
+        x.astype(compute_dtype),
+        params["kernel"].astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    if "bias" in params:
+        y = y + params["bias"].astype(jnp.float32)
+    return y.astype(compute_dtype)
+
+
+def init_dt_bias(key: jax.Array, shape: tuple[int, ...], dt_min: float,
+                 dt_max: float, dt_init_floor: float) -> jax.Array:
+    """Inverse-softplus(dt) with dt ~ LogUniform(dt_min, dt_max), floored.
+
+    Same construction as the dt_bias init in both mamba-ssm mixers
+    (modules/mamba_simple.py and modules/mamba2.py): softplus(dt_bias)
+    lands the initial timestep in [dt_min, dt_max] on a log scale.
+    """
+    u = jax.random.uniform(key, shape, jnp.float32)
+    dt = jnp.exp(u * (math.log(dt_max) - math.log(dt_min)) + math.log(dt_min))
+    dt = jnp.maximum(dt, dt_init_floor)
+    # inverse softplus: x = dt + log(1 - exp(-dt))
+    return dt + jnp.log(-jnp.expm1(-dt))
+
+
+def init_conv(key: jax.Array, channels: int, width: int, bias: bool) -> dict:
+    kw, kb = jax.random.split(key)
+    p = {"kernel": uniform_fan_in(kw, (channels, width), width)}
+    if bias:
+        p["bias"] = uniform_fan_in(kb, (channels,), width)
+    return p

@@ -1,0 +1,310 @@
+"""Full language model: embedding -> N blocks -> final norm -> tied head.
+
+Semantics match the reference wrapper + its dep
+(``/root/reference/model.py:25-47`` — loss is plain cross-entropy against
+the loader's pre-shifted targets — and ``mamba_ssm.models.mixer_seq_simple.
+MixerModel``/``create_block``: prenorm blocks, fp32 residual stream, tied
+embeddings, fused add+RMSNorm between blocks, optional gated MLP when
+``d_intermediate > 0``, optional attention layers at ``attn_layer_idx``).
+
+TPU-native structure: homogeneous stacks run as ``lax.scan`` over
+layer-stacked parameters (one compiled block body regardless of depth,
+which is also the FSDP-friendly layout — shard the non-layer axes and the
+scan slices locally); hybrid stacks interleave attention via a Python loop.
+Per-block ``jax.checkpoint`` implements activation rematerialization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.models.attention import (
+    attention_mixer,
+    attention_mixer_step,
+    init_attention_params,
+    init_attention_state,
+)
+from mamba_distributed_tpu.models.common import init_linear, linear
+from mamba_distributed_tpu.models.mamba1 import (
+    init_mamba1_params,
+    init_mamba1_state,
+    mamba1_mixer,
+    mamba1_mixer_step,
+)
+from mamba_distributed_tpu.models.mamba2 import (
+    init_mamba2_params,
+    init_mamba2_state,
+    mamba2_mixer,
+    mamba2_mixer_step,
+)
+from mamba_distributed_tpu.ops.norm import add_rms_norm, rms_norm
+
+
+def _init_mixer(key: jax.Array, cfg: ModelConfig) -> dict:
+    if cfg.ssm_layer == "mamba2":
+        return init_mamba2_params(key, cfg)
+    if cfg.ssm_layer == "mamba1":
+        return init_mamba1_params(key, cfg)
+    raise ValueError(cfg.ssm_layer)
+
+
+def _mixer_fwd(params, cfg, u, seq_ctx=None):
+    fn = mamba2_mixer if cfg.ssm_layer == "mamba2" else mamba1_mixer
+    return fn(params, cfg, u, seq_ctx=seq_ctx)
+
+
+def _init_block(key: jax.Array, cfg: ModelConfig, attn: bool) -> dict:
+    k_mix, k_mlp = jax.random.split(key)
+    p = {
+        "norm": {"weight": jnp.ones((cfg.d_model,), jnp.float32)},
+        "mixer": init_attention_params(k_mix, cfg) if attn else _init_mixer(k_mix, cfg),
+    }
+    if cfg.d_intermediate > 0:
+        k1, k2 = jax.random.split(k_mlp)
+        p["norm2"] = {"weight": jnp.ones((cfg.d_model,), jnp.float32)}
+        p["mlp"] = {
+            "fc1": init_linear(k1, cfg.d_model, 2 * cfg.d_intermediate, False),
+            "fc2": init_linear(k2, cfg.d_intermediate, cfg.d_model, False),
+        }
+        # fc2 is the second residual projection; depth-rescale like out_proj
+        if cfg.rescale_prenorm_residual:
+            import math
+
+            p["mlp"]["fc2"]["kernel"] = p["mlp"]["fc2"]["kernel"] / math.sqrt(
+                2 * cfg.n_layer
+            )
+    return p
+
+
+def _gated_mlp(params: dict, x: jax.Array, compute_dtype) -> jax.Array:
+    """GatedMLP (mamba_ssm modules/mlp.py): fc2(y * silu(gate))."""
+    yz = linear(params["fc1"], x, compute_dtype)
+    y, gate = jnp.split(yz, 2, axis=-1)
+    return linear(params["fc2"], y * jax.nn.silu(gate.astype(jnp.float32)).astype(y.dtype), compute_dtype)
+
+
+def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None):
+    """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP]."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    normed, residual = add_rms_norm(
+        hidden, residual, block_params["norm"]["weight"], cfg.norm_eps,
+        residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
+    )
+    if attn:
+        hidden = attention_mixer(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
+    else:
+        hidden = _mixer_fwd(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
+    if cfg.d_intermediate > 0:
+        normed, residual = add_rms_norm(
+            hidden, residual, block_params["norm2"]["weight"], cfg.norm_eps,
+            residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
+        )
+        hidden = _gated_mlp(block_params["mlp"], normed, compute_dtype)
+    return hidden, residual
+
+
+def init_lm_params(key: jax.Array, cfg: ModelConfig) -> dict:
+    """Build the full parameter pytree (fp32 master weights)."""
+    n = cfg.n_layer
+    attn_idx = set(cfg.attn_layer_idx)
+    k_emb, k_blocks, k_head = jax.random.split(key, 3)
+    layer_keys = jax.random.split(k_blocks, n)
+
+    params = {
+        "embedding": cfg.initializer_range
+        * jax.random.normal(k_emb, (cfg.vocab_size_padded, cfg.d_model), jnp.float32),
+        "norm_f": {"weight": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = init_linear(k_head, cfg.d_model, cfg.vocab_size_padded, False)
+
+    if attn_idx:
+        mamba_keys = [layer_keys[i] for i in range(n) if i not in attn_idx]
+        attn_keys = [layer_keys[i] for i in range(n) if i in attn_idx]
+        params["blocks"] = jax.vmap(lambda k: _init_block(k, cfg, False))(
+            jnp.stack(mamba_keys)
+        )
+        params["attn_blocks"] = jax.vmap(lambda k: _init_block(k, cfg, True))(
+            jnp.stack(attn_keys)
+        )
+    else:
+        params["blocks"] = jax.vmap(lambda k: _init_block(k, cfg, False))(layer_keys)
+    return params
+
+
+def lm_forward(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,
+    num_last_tokens: int = 0,
+    seq_ctx=None,
+) -> jax.Array:
+    """input_ids (b, t) int32 -> logits (b, t[, num_last_tokens], V) bf16."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    hidden = params["embedding"][input_ids].astype(compute_dtype)
+    residual = None
+
+    if cfg.attn_layer_idx:
+        attn_idx = set(cfg.attn_layer_idx)
+        mi = ai = 0
+        for i in range(cfg.n_layer):
+            attn = i in attn_idx
+            stack = params["attn_blocks"] if attn else params["blocks"]
+            j = ai if attn else mi
+            bp = jax.tree.map(lambda p, j=j: p[j], stack)
+            body = _block_fwd
+            if cfg.remat:
+                body = jax.checkpoint(body, static_argnums=(1, 4, 5))
+            hidden, residual = body(bp, cfg, hidden, residual, attn, seq_ctx)
+            if attn:
+                ai += 1
+            else:
+                mi += 1
+    else:
+        # residual must be a concrete array for a scan carry
+        residual = jnp.zeros_like(hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype)
+
+        def body(carry, bp):
+            hidden, residual = carry
+            hidden, residual = _block_fwd(bp, cfg, hidden, residual, False, seq_ctx)
+            return (hidden, residual), None
+
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        (hidden, residual), _ = jax.lax.scan(body, (hidden, residual), params["blocks"])
+
+    normed, _ = add_rms_norm(
+        hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
+        residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
+    )
+    if num_last_tokens > 0:
+        normed = normed[:, -num_last_tokens:]
+    if cfg.tie_embeddings:
+        logits = jnp.dot(
+            normed.astype(compute_dtype),
+            params["embedding"].T.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(compute_dtype)
+    else:
+        logits = linear(params["lm_head"], normed, compute_dtype)
+    return logits
+
+
+def lm_loss(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,
+    targets: jax.Array,
+    seq_ctx=None,
+) -> jax.Array:
+    """Mean cross-entropy in fp32 (reference model.py:43-46; targets are the
+    loader's pre-shifted next tokens, so no internal shift)."""
+    logits = lm_forward(params, cfg, input_ids, seq_ctx=seq_ctx)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def count_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Recurrent decode (O(1) per token) — used by inference/generate.py
+# ---------------------------------------------------------------------------
+
+
+def init_lm_state(cfg: ModelConfig, batch: int, max_len: int = 0):
+    """Per-layer decode states, layer-stacked to mirror the param layout."""
+    init_mix = init_mamba2_state if cfg.ssm_layer == "mamba2" else init_mamba1_state
+    if cfg.attn_layer_idx:
+        n_attn = len(cfg.attn_layer_idx)
+        n_mamba = cfg.n_layer - n_attn
+        mamba_states = [init_mix(cfg, batch) for _ in range(n_mamba)]
+        attn_states = [
+            init_attention_state(cfg, batch, max_len) for _ in range(n_attn)
+        ]
+        stack = lambda states: jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        return {"blocks": stack(mamba_states), "attn_blocks": stack(attn_states)}
+    cs, ss = init_mix(cfg, batch)
+    n = cfg.n_layer
+    return {
+        "blocks": (
+            jnp.tile(cs[None], (n,) + (1,) * cs.ndim),
+            jnp.tile(ss[None], (n,) + (1,) * ss.ndim),
+        )
+    }
+
+
+def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
+    """One decode step.  token (b,) int32 -> (logits (b, V), new state)."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    hidden = params["embedding"][token].astype(compute_dtype)
+    residual = None
+    mix_step = mamba2_mixer_step if cfg.ssm_layer == "mamba2" else mamba1_mixer_step
+
+    if cfg.attn_layer_idx:
+        attn_idx = set(cfg.attn_layer_idx)
+        mi = ai = 0
+        new_m, new_a = [], []
+        for i in range(cfg.n_layer):
+            attn = i in attn_idx
+            if attn:
+                bp = jax.tree.map(lambda p, j=ai: p[j], params["attn_blocks"])
+                st = jax.tree.map(lambda s, j=ai: s[j], state["attn_blocks"])
+            else:
+                bp = jax.tree.map(lambda p, j=mi: p[j], params["blocks"])
+                st = jax.tree.map(lambda s, j=mi: s[j], state["blocks"])
+            normed, residual = add_rms_norm(
+                hidden, residual, bp["norm"]["weight"], cfg.norm_eps,
+            )
+            if attn:
+                hidden, st = attention_mixer_step(bp["mixer"], cfg, normed, st)
+                new_a.append(st)
+                ai += 1
+            else:
+                hidden, st = mix_step(bp["mixer"], cfg, normed, *st)
+                new_m.append(st)
+                mi += 1
+            if cfg.d_intermediate > 0:
+                normed, residual = add_rms_norm(
+                    hidden, residual, bp["norm2"]["weight"], cfg.norm_eps,
+                )
+                hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
+        stack = lambda states: jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        new_state = {"blocks": stack(new_m), "attn_blocks": stack(new_a)}
+    else:
+        residual = jnp.zeros_like(hidden, dtype=jnp.float32)
+
+        def body(carry, xs):
+            hidden, residual = carry
+            bp, st = xs
+            normed, residual = add_rms_norm(
+                hidden, residual, bp["norm"]["weight"], cfg.norm_eps,
+            )
+            hidden, st = mix_step(bp["mixer"], cfg, normed, *st)
+            if cfg.d_intermediate > 0:
+                normed, residual = add_rms_norm(
+                    hidden, residual, bp["norm2"]["weight"], cfg.norm_eps,
+                )
+                hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
+            return (hidden, residual), st
+
+        (hidden, residual), new_blocks = jax.lax.scan(
+            body, (hidden, residual), (params["blocks"], state["blocks"])
+        )
+        new_state = {"blocks": new_blocks}
+
+    normed, _ = add_rms_norm(hidden, residual, params["norm_f"]["weight"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.dot(
+            normed.astype(compute_dtype),
+            params["embedding"].T.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = linear(params["lm_head"], normed, compute_dtype).astype(jnp.float32)
+    return logits.astype(jnp.float32), new_state

@@ -16,6 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# Plugins (jaxtyping) import jax before this conftest runs, so the env var
+# alone can arrive too late; the config update works until the backend is
+# actually initialized, which no plugin does.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
