@@ -1,0 +1,84 @@
+"""Benchmark: time the jitted 280M train step on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline: the reference's derived ~174K tokens/sec/GPU on 8xA100
+(BASELINE.md "Aggregate throughput"); vs_baseline = ours / 174000.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from mamba_distributed_tpu.config import get_preset
+    from mamba_distributed_tpu.models import init_lm_params
+    from mamba_distributed_tpu.parallel.mesh import build_mesh
+    from mamba_distributed_tpu.parallel.sharding import opt_state_shardings, param_shardings
+    from mamba_distributed_tpu.training.optimizer import make_optimizer
+    from mamba_distributed_tpu.training.train_step import make_train_step
+    from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
+
+    B, T = 8, 1024
+    cfg = get_preset("mamba2-280m", micro_batch_size=B, total_batch_size=B * T)
+    mesh = build_mesh(cfg.mesh, jax.devices()[:1])
+
+    key = jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(lambda k: init_lm_params(k, cfg.model), key)
+    pshard = param_shardings(shapes, mesh, False)
+    params = jax.jit(
+        lambda k: init_lm_params(k, cfg.model), out_shardings=pshard
+    )(key)
+    optimizer = make_optimizer(cfg)
+    opt_shapes = jax.eval_shape(optimizer.init, params)
+    oshard = opt_state_shardings(opt_shapes, shapes, pshard, mesh)
+    opt_state = jax.jit(optimizer.init, out_shardings=oshard)(params)
+    step = make_train_step(cfg, optimizer, mesh, params, opt_state)
+
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.device_put(
+        jax.random.randint(kx, (1, B, T), 0, cfg.model.vocab_size, jnp.int32)
+    )
+    y = jax.device_put(
+        jax.random.randint(ky, (1, B, T), 0, cfg.model.vocab_size, jnp.int32)
+    )
+
+    # warmup (compile + 2 steps); float() forces a host transfer because
+    # block_until_ready is a no-op on some experimental platforms
+    for _ in range(3):
+        params, opt_state, loss, _ = step(params, opt_state, x, y)
+    float(loss)
+
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        params, opt_state, loss, _ = step(params, opt_state, x, y)
+    float(loss)  # steps chain on params, so this closes all iters
+    dt = (time.time() - t0) / iters
+
+    tok_per_sec = B * T / dt
+    fpt = flops_per_token(cfg.model, T, training=True)
+    mfu = fpt * tok_per_sec / peak_flops_per_chip()
+    print(
+        json.dumps(
+            {
+                "metric": "train_tokens_per_sec_per_chip_mamba2_280m",
+                "value": round(tok_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(tok_per_sec / 174_000.0, 4),
+                "mfu": round(mfu, 4),
+                "step_ms": round(dt * 1000, 2),
+                "device": jax.devices()[0].device_kind,
+                "batch": [B, T],
+                "loss": round(float(loss), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

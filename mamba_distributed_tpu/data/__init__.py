@@ -1,0 +1,6 @@
+"""Token-shard data pipeline (reference: dataloader.py)."""
+
+from mamba_distributed_tpu.data.loader import ShardedTokenLoader
+from mamba_distributed_tpu.data.synthetic import ensure_synthetic_shards
+
+__all__ = ["ShardedTokenLoader", "ensure_synthetic_shards"]

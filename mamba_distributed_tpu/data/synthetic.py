@@ -1,0 +1,44 @@
+"""Deterministic synthetic token shards.
+
+The real 10B-token corpus is "bring your own data" (reference README.md:11);
+for tests, smoke training, and benchmarks this generates Zipf-distributed
+uint16 shards in the same on-disk format the reference loader reads
+(``{split}`` in the filename, ``.npy`` of token ids).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def ensure_synthetic_shards(
+    data_dir: str,
+    vocab_size: int = 50257,
+    tokens_per_shard: int = 2_097_152,
+    num_shards: int = 2,
+    val_shards: int = 1,
+    seed: int = 1337,
+) -> str:
+    """Create shards in ``data_dir`` if it doesn't already hold any.
+
+    Zipf-ish marginals give a non-flat unigram distribution so losses move
+    the way real text's do (a uniform stream would pin loss at ln(V)).
+    """
+    if os.path.isdir(data_dir) and any(
+        f.endswith(".npy") for f in os.listdir(data_dir)
+    ):
+        return data_dir
+    os.makedirs(data_dir, exist_ok=True)
+    for split, count in (("train", num_shards), ("val", val_shards)):
+        for i in range(count):
+            rng = np.random.default_rng(seed + i + (10_000 if split == "val" else 0))
+            # Zipf over a shuffled vocab, clipped into range
+            ranks = rng.zipf(1.2, size=tokens_per_shard)
+            tokens = (ranks - 1).clip(max=vocab_size - 1).astype(np.uint16)
+            path = os.path.join(
+                data_dir, f"synthetic_{split}_{i:06d}.npy"
+            )
+            np.save(path, tokens)
+    return data_dir

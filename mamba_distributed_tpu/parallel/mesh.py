@@ -1,0 +1,42 @@
+"""Logical device mesh over TPU chips.
+
+The four logical axes (data, fsdp, seq, tensor) map onto the physical ICI
+torus in that order — data/fsdp outermost (gradient reductions ride the
+largest rings), seq innermost (ppermute neighbours stay physically
+adjacent).  `jax.experimental.mesh_utils` handles the physical layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from mamba_distributed_tpu.config import MeshConfig
+
+
+def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    """Build the (data, fsdp, seq, tensor) mesh.
+
+    Axis sizes must multiply to the device count; axes of size 1 are kept
+    (they're free) so every sharding rule can name all four axes.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if cfg.num_devices > n:
+        raise ValueError(
+            f"mesh {cfg.shape} wants {cfg.num_devices} devices, have {n}"
+        )
+    devices = devices[: cfg.num_devices]
+    try:
+        dev_array = mesh_utils.create_device_mesh(cfg.shape, devices=devices)
+    except (ValueError, AssertionError):
+        # non-TPU or odd topologies: plain reshape keeps neighbours adjacent
+        dev_array = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev_array, cfg.axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshConfig(), devices=jax.devices()[:1])

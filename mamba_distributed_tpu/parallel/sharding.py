@@ -1,0 +1,106 @@
+"""Sharding rules: how params, optimizer state, and batches lay out on the mesh.
+
+Data parallel (BASELINE config 2): batch axis over (data, fsdp); params
+replicated — XLA inserts the gradient psum that DDP's bucketed NCCL
+all-reduce did (/root/reference/train.py:86,219-221).
+
+FSDP (config 3): additionally shard every large parameter (and its Adam
+moments, which inherit the same spec) over the fsdp axis — ZeRO-3-style
+param + optimizer-state sharding; XLA inserts the all-gathers/reduce-
+scatters.  Layer-stacked block params (leading n_layer axis from the
+scan-over-layers layout) shard a *non-layer* axis so `lax.scan` slices
+locally instead of gathering the whole stack per step.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mamba_distributed_tpu.config import ModelConfig
+
+
+def _spec_for(path: str, shape: tuple[int, ...], fsdp_size: int,
+              stacked: bool) -> P:
+    """Shard the largest fsdp-divisible axis (skipping the layer axis of
+    stacked block params); replicate whatever doesn't divide."""
+    if fsdp_size <= 1 or not shape:
+        return P()
+    start = 1 if stacked and len(shape) > 1 else 0
+    cands = [
+        (shape[i], i) for i in range(start, len(shape)) if shape[i] % fsdp_size == 0
+    ]
+    if not cands:
+        return P()
+    _, axis = max(cands)
+    spec = [None] * len(shape)
+    spec[axis] = "fsdp"
+    return P(*spec)
+
+
+def param_specs(params, shard: bool, fsdp_size: int):
+    """PartitionSpec pytree matching ``params``.
+
+    ``shard=False`` -> everything replicated (pure DP).
+    """
+    def leaf_spec(path, leaf):
+        if not shard:
+            return P()
+        names = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+        stacked = "blocks" in names or "attn_blocks" in names
+        return _spec_for("/".join(map(str, names)), np.shape(leaf), fsdp_size, stacked)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def param_shardings(params, mesh: Mesh, shard: bool):
+    fsdp_size = mesh.shape["fsdp"]
+    specs = param_specs(params, shard, fsdp_size)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params, mesh: Mesh, shard: bool):
+    """device_put the param pytree with its shardings (lazy, async)."""
+    shardings = param_shardings(params, mesh, shard)
+    return jax.device_put(params, shardings)
+
+
+def opt_state_shardings(opt_shapes, params, param_sharding_tree, mesh: Mesh):
+    """Shardings for the optimizer state: Adam moments (and any other
+    params-shaped leaf) inherit the matching parameter's sharding; scalars
+    and everything else replicate on the mesh.
+
+    Matching is by tree-path suffix: optax's ``mu``/``nu`` (and masked
+    wrappers) mirror the param tree, so the param path is a suffix of the
+    state leaf's path.
+    """
+    import jax.tree_util as jtu
+
+    flat_params = jtu.tree_flatten_with_path(params)[0]
+    by_path = {
+        jtu.keystr(path): (np.shape(leaf), sh)
+        for (path, leaf), sh in zip(
+            flat_params, jax.tree.leaves(param_sharding_tree)
+        )
+    }
+    replicated = NamedSharding(mesh, P())
+
+    def leaf_shard(path, leaf):
+        ks = jtu.keystr(path)
+        for ppath, (shape, sh) in by_path.items():
+            if ks.endswith(ppath) and np.shape(leaf) == shape:
+                return sh
+        return replicated
+
+    return jtu.tree_map_with_path(leaf_shard, opt_shapes)
+
+
+def batch_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
+    """(B, T) batches: B over (data, fsdp), T over seq when SP is on."""
+    return P(("data", "fsdp"), "seq" if seq_sharded else None)
+
+
+def batch_sharding(mesh: Mesh, seq_sharded: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh, seq_sharded))

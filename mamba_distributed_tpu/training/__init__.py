@@ -1,0 +1,16 @@
+"""Training subsystem: optimizer, jitted train step, trainer loop."""
+
+from mamba_distributed_tpu.training.optimizer import lr_schedule, make_optimizer
+from mamba_distributed_tpu.training.train_step import (
+    make_eval_step,
+    make_train_step,
+)
+from mamba_distributed_tpu.training.trainer import Trainer
+
+__all__ = [
+    "lr_schedule",
+    "make_optimizer",
+    "make_train_step",
+    "make_eval_step",
+    "Trainer",
+]

@@ -1,0 +1,67 @@
+"""Full-state checkpointing via Orbax: params + optimizer + loader + RNG.
+
+The reference saves model-only every 1,000 steps and cannot resume
+(/root/reference/train.py:152-163, acknowledged in-code at 161-162).  Here
+a checkpoint restores the *exact* training trajectory: restoring and
+stepping reproduces the same losses bit-for-bit (pinned by
+tests/test_checkpoint.py).  Sharded arrays save/restore distributed-aware
+through Orbax's TypeHandlers — each host writes its own shards.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _manager(directory: str) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save_checkpoint(directory, step, params, opt_state, loader_state, rng) -> None:
+    mngr = _manager(directory)
+    state = {
+        "params": params,
+        "opt_state": opt_state,
+        "loader": {k: np.asarray(v) for k, v in loader_state.items()},
+        "rng": rng,
+        "step": np.asarray(step),
+    }
+    mngr.save(step, args=ocp.args.StandardSave(state))
+    mngr.wait_until_finished()
+    mngr.close()
+
+
+def restore_checkpoint(directory, params_like, opt_state_like, step=None):
+    """Restore into the shardings/dtypes of the given abstract targets."""
+    mngr = _manager(directory)
+    if step is None:
+        step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {directory}")
+    target = {
+        "params": params_like,
+        "opt_state": opt_state_like,
+        "loader": {
+            "current_shard": np.asarray(0),
+            "current_position": np.asarray(0),
+        },
+        "rng": jax.random.PRNGKey(0),
+        "step": np.asarray(0),
+    }
+    restored = mngr.restore(step, args=ocp.args.StandardRestore(target))
+    mngr.close()
+    loader_state = {k: int(v) for k, v in restored["loader"].items()}
+    return (
+        int(restored["step"]),
+        restored["params"],
+        restored["opt_state"],
+        loader_state,
+        restored["rng"],
+    )

@@ -1,0 +1,86 @@
+"""Jitted train/eval steps with gradient accumulation.
+
+One ``jax.jit`` covers the whole reference inner loop
+(/root/reference/train.py:205-227): the micro-batch loop is a ``lax.scan``
+over the leading accum axis, gradient averaging replaces DDP's allreduce
+(XLA inserts the psum from the batch sharding), clip + AdamW update run
+fused on-device.  Params/optimizer buffers are donated, so the step is
+in-place at the HBM level.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mamba_distributed_tpu.config import TrainConfig
+from mamba_distributed_tpu.models import lm_loss
+from mamba_distributed_tpu.parallel.sharding import batch_sharding
+
+
+def make_train_step(
+    cfg: TrainConfig,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    params,
+    opt_state,
+    seq_ctx=None,
+):
+    """Build the compiled train step.
+
+    Shardings are read off the already-placed ``params``/``opt_state`` so
+    the step preserves them exactly (and donates the buffers).
+
+    Returns ``step(params, opt_state, x, y) ->
+    (params, opt_state, loss, grad_norm)`` with x/y (accum, B_global, T).
+    """
+    model_cfg = cfg.model
+
+    def loss_fn(p, x, y):
+        return lm_loss(p, model_cfg, x, y, seq_ctx=seq_ctx)
+
+    def step_fn(params, opt_state, x, y):
+        accum = x.shape[0]
+        if accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, x[0], y[0])
+        else:
+            def micro(carry, xs):
+                gsum, lsum = carry
+                xb, yb = xs
+                l, g = jax.value_and_grad(loss_fn)(params, xb, yb)
+                return (jax.tree.map(jnp.add, gsum, g), lsum + l), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (gsum, lsum), _ = jax.lax.scan(micro, (zeros, 0.0), (x, y))
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            loss = lsum / accum
+        grad_norm = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, grad_norm
+
+    pshard = jax.tree.map(lambda a: a.sharding, params)
+    oshard = jax.tree.map(lambda a: a.sharding, opt_state)
+    bshard = batch_sharding(mesh, seq_sharded=seq_ctx is not None)
+    # batches carry a leading (replicated) grad-accum axis
+    ashard = NamedSharding(mesh, P(None, *bshard.spec))
+    return jax.jit(
+        step_fn,
+        in_shardings=(pshard, oshard, ashard, ashard),
+        out_shardings=(pshard, oshard, None, None),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_eval_step(cfg: TrainConfig, mesh, params, seq_ctx=None):
+    """Compiled loss-only step, x/y (B_global, T)."""
+    model_cfg = cfg.model
+
+    def eval_fn(params, x, y):
+        return lm_loss(params, model_cfg, x, y, seq_ctx=seq_ctx)
+
+    pshard = jax.tree.map(lambda a: a.sharding, params)
+    bshard = batch_sharding(mesh, seq_sharded=seq_ctx is not None)
+    return jax.jit(eval_fn, in_shardings=(pshard, bshard, bshard))

@@ -1,0 +1,6 @@
+"""Shared utilities: FLOPs accounting, metrics logging, profiling."""
+
+from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
+from mamba_distributed_tpu.utils.metrics import MetricsLogger
+
+__all__ = ["flops_per_token", "peak_flops_per_chip", "MetricsLogger"]

@@ -1,0 +1,84 @@
+"""Analytic FLOPs accounting for MFU (the BASELINE.json headline metric).
+
+The reference publishes no MFU (SURVEY.md §6); this is the standard
+matmul-dominated accounting: 2*m*n FLOPs per (m x n) matvec per token,
+3x forward for a training step (fwd + 2x bwd), attention causally halved.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from mamba_distributed_tpu.config import ModelConfig
+
+# bf16 peak per chip. v5 lite == v5e.
+_PEAK = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device=None) -> float:
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default
+
+
+def _mamba2_layer_flops(cfg: ModelConfig, seq_len: int) -> float:
+    d, di = cfg.d_model, cfg.d_inner
+    n, h, p = cfg.effective_d_state, cfg.nheads, cfg.headdim
+    g = cfg.ngroups
+    l = min(cfg.chunk_size, seq_len)
+    f = 2 * d * (2 * di + 2 * g * n + h)  # in_proj
+    f += 2 * (di + 2 * g * n) * cfg.d_conv  # depthwise conv
+    # SSD per token: G (l*n), M@x (l*p), chunk states (n*p), off-diag (n*p)
+    f += 2 * h * (l * (n + p) + 2 * n * p)
+    f += 2 * di * d  # out_proj
+    return f
+
+
+def _mamba1_layer_flops(cfg: ModelConfig, seq_len: int) -> float:
+    d, di = cfg.d_model, cfg.d_inner
+    n, dtr = cfg.effective_d_state, cfg.effective_dt_rank
+    f = 2 * d * 2 * di  # in_proj
+    f += 2 * di * cfg.d_conv
+    f += 2 * di * (dtr + 2 * n)  # x_proj
+    f += 2 * dtr * di  # dt_proj
+    f += 8 * di * n  # recurrence (dA, dBu, state update, C reduction)
+    f += 2 * di * d  # out_proj
+    return f
+
+
+def _attn_layer_flops(cfg: ModelConfig, seq_len: int) -> float:
+    nh = cfg.effective_attn_num_heads
+    nkv = cfg.effective_attn_num_kv_heads
+    hd = cfg.d_model // nh
+    f = 2 * cfg.d_model * (nh + 2 * nkv) * hd  # qkv
+    f += 2 * seq_len * nh * hd  # scores + AV, causally halved: 4*(t/2)*nh*hd
+    f += 2 * nh * hd * cfg.d_model  # out_proj
+    return f
+
+
+def flops_per_token(cfg: ModelConfig, seq_len: int, training: bool = True) -> float:
+    """Matmul FLOPs per token for one forward (x3 when ``training``)."""
+    attn_idx = set(cfg.attn_layer_idx)
+    total = 0.0
+    for i in range(cfg.n_layer):
+        if i in attn_idx:
+            total += _attn_layer_flops(cfg, seq_len)
+        elif cfg.ssm_layer == "mamba2":
+            total += _mamba2_layer_flops(cfg, seq_len)
+        else:
+            total += _mamba1_layer_flops(cfg, seq_len)
+        if cfg.d_intermediate > 0:
+            total += 6 * cfg.d_model * cfg.d_intermediate
+    total += 2 * cfg.d_model * cfg.vocab_size_padded  # LM head
+    return total * (3.0 if training else 1.0)

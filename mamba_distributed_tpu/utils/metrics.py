@@ -1,0 +1,54 @@
+"""Metrics logging, reference-text-format compatible.
+
+The log file carries exactly the reference's 3-field lines
+(``"{step} train {loss:.6f}"`` / ``"{step} val {loss:.4f}"``,
+/root/reference/train.py:124,150,240) so its plot tooling (plot.ipynb)
+parses ours unchanged; the console line additionally carries lr, grad
+norm, step time, tokens/sec, and MFU (the reference printed the first
+four, train.py:237-239; MFU is new).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class MetricsLogger:
+    def __init__(self, log_dir: str, master_process: bool = True,
+                 filename: str = "log.txt"):
+        self.master = master_process
+        self.log_file = None
+        # truncation (reference train.py:122) is deferred to the first write
+        # so a checkpoint resume can preserve the pre-crash history
+        self._truncate_pending = True
+        if master_process:
+            os.makedirs(log_dir, exist_ok=True)
+            self.log_file = os.path.join(log_dir, filename)
+
+    def preserve_history(self) -> None:
+        """Keep the existing log file (called on checkpoint resume)."""
+        self._truncate_pending = False
+
+    def _append(self, line: str) -> None:
+        if self.log_file:
+            mode = "w" if self._truncate_pending else "a"
+            self._truncate_pending = False
+            with open(self.log_file, mode) as f:
+                f.write(line + "\n")
+
+    def train_step(self, step: int, loss: float, lr: float, grad_norm: float,
+                   dt_s: float, tokens_per_sec: float, mfu: float) -> None:
+        if not self.master:
+            return
+        print(
+            f"step {step:5d} | loss: {loss:.6f} | lr {lr:.4e} | "
+            f"norm: {grad_norm:.4f} | dt: {dt_s * 1000:.2f}ms | "
+            f"tok/sec: {tokens_per_sec:.2f} | mfu: {mfu * 100:.1f}%"
+        )
+        self._append(f"{step} train {loss:.6f}")
+
+    def val(self, step: int, loss: float) -> None:
+        if not self.master:
+            return
+        print(f"validation loss: {loss:.4f}")
+        self._append(f"{step} val {loss:.4f}")

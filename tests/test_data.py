@@ -1,0 +1,124 @@
+"""Data-pipeline tests: rank striding, shard cycling, x/y shift, resume.
+
+The properties mirrored from /root/reference/dataloader.py:34-52 plus the
+resume determinism SURVEY.md §4 calls for.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.data import ShardedTokenLoader, ensure_synthetic_shards
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("shards")
+    # small shards: 4096 tokens each, 3 train + 1 val
+    for split, count in (("train", 3), ("val", 1)):
+        for i in range(count):
+            rng = np.random.default_rng(i + (100 if split == "val" else 0))
+            np.save(
+                d / f"tok_{split}_{i:03d}.npy",
+                rng.integers(0, 1000, size=4096).astype(np.uint16),
+            )
+    return str(d)
+
+
+def test_xy_shift(shard_dir):
+    dl = ShardedTokenLoader(2, 8, shard_dir, "train", master_process=False)
+    x, y = dl.next_batch()
+    assert x.shape == (2, 8) and y.shape == (2, 8)
+    flat_x, flat_y = x.reshape(-1), y.reshape(-1)
+    # y is x shifted by one within the contiguous B*T+1 window
+    assert (flat_y[:-1] == flat_x[1:]).all()
+
+
+def test_rank_striding_disjoint_and_complete(shard_dir):
+    """W ranks jointly cover consecutive disjoint windows of the stream."""
+    B, T, W = 1, 16, 4
+    loaders = [
+        ShardedTokenLoader(B, T, shard_dir, "train", r, W, master_process=False)
+        for r in range(W)
+    ]
+    tokens = np.load(
+        sorted(
+            os.path.join(shard_dir, s)
+            for s in os.listdir(shard_dir)
+            if "train" in s
+        )[0]
+    ).astype(np.int32)
+    xs = [ld.next_batch()[0].reshape(-1) for ld in loaders]
+    for r in range(W):
+        expect = tokens[r * B * T : (r + 1) * B * T]
+        assert (xs[r] == expect).all()
+
+
+def test_shard_cycling(shard_dir):
+    B, T = 4, 32  # window 128+1 of 4096 -> 32 windows per shard
+    dl = ShardedTokenLoader(B, T, shard_dir, "train", master_process=False)
+    n_shards = len(dl.shards)
+    windows_per_shard = 4096 // (B * T)
+    first_x, _ = dl.next_batch()
+    # drain shard 0 (the guard advances one batch early: tail dropped)
+    seen_shards = {0}
+    for _ in range(n_shards * windows_per_shard):
+        dl.next_batch()
+        seen_shards.add(dl.current_shard)
+    assert seen_shards == set(range(n_shards))
+    # cycle back to shard 0 reproduces the same first batch
+    while dl.current_shard != 0 or dl.current_position != B * T * 0:
+        dl.next_batch()
+    x2, _ = dl.next_batch()
+    assert (x2 == first_x).all()
+
+
+def test_resume_determinism(shard_dir):
+    dl = ShardedTokenLoader(2, 16, shard_dir, "train", master_process=False)
+    for _ in range(5):
+        dl.next_batch()
+    state = dl.state()
+    expect = [dl.next_batch() for _ in range(40)]  # crosses a shard boundary
+
+    dl2 = ShardedTokenLoader(2, 16, shard_dir, "train", master_process=False)
+    dl2.restore(state)
+    got = [dl2.next_batch() for _ in range(40)]
+    for (ex, ey), (gx, gy) in zip(expect, got):
+        assert (ex == gx).all() and (ey == gy).all()
+
+
+def test_val_split_isolated(shard_dir):
+    dl = ShardedTokenLoader(1, 8, shard_dir, "val", master_process=False)
+    assert len(dl.shards) == 1
+    assert all("val" in s for s in dl.shards)
+
+
+def test_reset_reproduces(shard_dir):
+    dl = ShardedTokenLoader(2, 8, shard_dir, "train", master_process=False)
+    x1, y1 = dl.next_batch()
+    dl.next_batch()
+    dl.reset()
+    x2, y2 = dl.next_batch()
+    assert (x1 == x2).all() and (y1 == y2).all()
+
+
+def test_synthetic_generation(tmp_path):
+    d = ensure_synthetic_shards(
+        str(tmp_path / "syn"), vocab_size=1000, tokens_per_shard=2048,
+        num_shards=2,
+    )
+    dl = ShardedTokenLoader(1, 32, d, "train", master_process=False)
+    assert len(dl.shards) == 2
+    x, y = dl.next_batch()
+    assert x.max() < 1000 and x.min() >= 0
+    # deterministic across regeneration
+    d2 = ensure_synthetic_shards(
+        str(tmp_path / "syn2"), vocab_size=1000, tokens_per_shard=2048,
+        num_shards=2,
+    )
+    a = np.load(os.path.join(d, "synthetic_train_000000.npy"))
+    b = np.load(os.path.join(d2, "synthetic_train_000000.npy"))
+    assert (a == b).all()
+    # idempotent: calling again doesn't rewrite
+    assert ensure_synthetic_shards(d) == d

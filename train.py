@@ -1,0 +1,95 @@
+"""Train a Mamba LM on TPU.
+
+TPU-native replacement for the reference's ``torchrun --standalone
+--nproc_per_node=8 train.py`` (/root/reference/README.md:16): no process-
+per-device — one process per host, a `jax.sharding.Mesh` over the chips,
+and XLA SPMD for every collective.
+
+Examples:
+  python train.py --preset mamba2-280m --max-steps 30
+  python train.py --preset mamba2-280m-dp8            # 8-chip data parallel
+  python train.py --preset mamba2-1.3b-fsdp16         # FSDP
+  python train.py --preset mamba2-280m --mesh-data 4  # override mesh axes
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+import jax
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="mamba2-280m",
+                   help="one of config.PRESETS")
+    p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --checkpoint-dir")
+    p.add_argument("--micro-batch-size", type=int, default=None)
+    p.add_argument("--total-batch-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--mesh-data", type=int, default=None)
+    p.add_argument("--mesh-fsdp", type=int, default=None)
+    p.add_argument("--mesh-seq", type=int, default=None)
+    p.add_argument("--mesh-tensor", type=int, default=None)
+    p.add_argument("--multihost", action="store_true",
+                   help="call jax.distributed.initialize() first (TPU pods)")
+    return p.parse_args()
+
+
+def build_config(args):
+    from mamba_distributed_tpu.config import get_preset
+
+    cfg = get_preset(args.preset)
+    overrides = {}
+    for field, arg in [
+        ("micro_batch_size", args.micro_batch_size),
+        ("total_batch_size", args.total_batch_size),
+        ("seq_len", args.seq_len),
+        ("seed", args.seed),
+    ]:
+        if arg is not None:
+            overrides[field] = arg
+    mesh_over = {
+        k: v for k, v in [
+            ("data", args.mesh_data), ("fsdp", args.mesh_fsdp),
+            ("seq", args.mesh_seq), ("tensor", args.mesh_tensor),
+        ] if v is not None
+    }
+    if mesh_over:
+        overrides["mesh"] = dataclasses.replace(cfg.mesh, **mesh_over)
+    if args.data_dir is not None:
+        overrides["data"] = dataclasses.replace(cfg.data, data_dir=args.data_dir)
+    if args.log_dir is not None:
+        overrides["log_dir"] = args.log_dir
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def main():
+    args = parse_args()
+    if args.multihost:
+        jax.distributed.initialize()
+    cfg = build_config(args)
+
+    from mamba_distributed_tpu.training import Trainer
+
+    trainer = Trainer(cfg)
+    if args.resume and args.checkpoint_dir:
+        try:
+            trainer.restore_checkpoint(args.checkpoint_dir)
+            print(f"resumed from step {trainer.step}")
+        except FileNotFoundError:
+            print("no checkpoint found; starting fresh")
+    trainer.run(max_steps=args.max_steps, checkpoint_dir=args.checkpoint_dir)
+
+
+if __name__ == "__main__":
+    main()
