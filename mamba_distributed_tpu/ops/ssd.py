@@ -216,6 +216,36 @@ def state_passing(
     return prev_states, final_state
 
 
+def combine_chunk_outputs(
+    y_diag: jax.Array,
+    c_decayed: jax.Array,
+    prev_states: jax.Array,
+    x: jax.Array,
+    D: jax.Array | None,
+    compute_dtype,
+) -> jax.Array:
+    """Assemble the SSD output from per-chunk pieces.
+
+    Shared by the single-device path (ssd_chunked) and the sequence-
+    parallel path (parallel/seq_parallel.sp_ssd): off-diagonal correction
+    through the carried states + optional D skip connection.
+    """
+    b, nc, l, h, p = y_diag.shape
+    y_off = jnp.einsum(
+        "bclhn,bchpn->bclhp",
+        c_decayed.astype(compute_dtype),
+        prev_states.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    y = (y_diag + y_off).reshape(b, nc * l, h, p)
+    if D is not None:
+        Df = D.astype(jnp.float32)
+        y = y + x.astype(jnp.float32) * (
+            Df[None, None, :, :] if Df.ndim == 2 else Df[None, None, :, None]
+        )
+    return y.astype(x.dtype)
+
+
 def ssd_chunked(
     x: jax.Array,
     dt: jax.Array,
@@ -243,20 +273,7 @@ def ssd_chunked(
         x, dt, A, B, C, l, compute_dtype
     )
     prev_states, final_state = state_passing(states, chunk_decay, initial_state)
-    # off-diagonal: contribution of earlier chunks through the carried state
-    y_off = jnp.einsum(
-        "bclhn,bchpn->bclhp",
-        c_decayed.astype(compute_dtype),
-        prev_states.astype(compute_dtype),
-        preferred_element_type=jnp.float32,
-    )
-    y = (y_diag + y_off).reshape(b, t, h, p)
-    if D is not None:
-        Df = D.astype(jnp.float32)
-        y = y + x.astype(jnp.float32) * (
-            Df[None, None, :, :] if Df.ndim == 2 else Df[None, None, :, None]
-        )
-    y = y.astype(x.dtype)
+    y = combine_chunk_outputs(y_diag, c_decayed, prev_states, x, D, compute_dtype)
     if return_final_state:
         return y, final_state
     return y

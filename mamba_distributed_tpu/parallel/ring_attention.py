@@ -1,0 +1,100 @@
+"""Ring attention over the sequence mesh axis (hybrid config 5).
+
+Blockwise causal attention with online-softmax accumulation: each device
+keeps its local Q block and rotates KV blocks around the ``seq`` ring via
+``ppermute`` — S-1 hops of the local KV instead of an all-gather of the
+whole sequence.  Causality is enforced per (q-block, kv-block) pair from
+the global block indices; fully-future blocks are computed-and-masked
+(compute is uniform, which XLA/TPU prefers over divergent control flow).
+
+The math follows the published blockwise/ring-attention construction
+(Liu et al. 2023); the implementation is an in-tree shard_map + lax.scan.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attn(q, k, v, qpos, kpos):
+    """Masked fp32 scores for one (q-block, kv-block) pair.
+
+    q (b, tq, nkv, rep, hd); k/v (b, tk, nkv, hd).
+    Returns scores (b, nkv, rep, tq, tk) with -inf above the causal line.
+    """
+    hd = q.shape[-1]
+    scores = jnp.einsum(
+        "bqgrh,bkgh->bgrqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)
+    mask = qpos[:, None] >= kpos[None, :]  # (tq, tk)
+    return jnp.where(mask[None, None, None], scores, -jnp.inf)
+
+
+def ring_attention(seq_ctx, q, k, v):
+    """q (b, t, nh, hd), k/v (b, t, nkv, hd), t sharded over seq_ctx.axis.
+
+    Returns (b, t, nh, hd) in q.dtype.  Exact (up to fp32 softmax) match
+    with single-device causal attention — pinned by tests.
+    """
+    ctx = seq_ctx
+    n = ctx.size
+    b, t, nh, hd = q.shape
+    nkv = k.shape[2]
+    rep = nh // nkv
+    bat4 = P(ctx.batch_axes, ctx.axis, None, None)
+
+    def local(q_l, k_l, v_l):
+        bl, tl, _, _ = q_l.shape
+        my = jax.lax.axis_index(ctx.axis)
+        qh = q_l.reshape(bl, tl, nkv, rep, hd)
+        qpos = my * tl + jnp.arange(tl)
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def accumulate(acc, kv, i):
+            m, num, den = acc
+            k_i, v_i = kv
+            # kv block currently held came from rank (my - i) mod n
+            src = (my - i) % n
+            kpos = src * tl + jnp.arange(tl)
+            s = _block_attn(qh, k_i, v_i, qpos, kpos)  # (b,g,r,tq,tk)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            # guard: fully-masked rows keep m at -inf; exp(-inf - -inf) -> use where
+            scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            num = num * scale[..., None] + jnp.einsum(
+                "bgrqk,bkgh->bgrqh", p.astype(v_i.dtype), v_i,
+                preferred_element_type=jnp.float32,
+            )
+            den = den * scale + jnp.sum(p, axis=-1)
+            return m_new, num, den
+
+        def step(carry, i):
+            kv, acc = carry
+            acc = accumulate(acc, kv, i)
+            kv = jax.lax.ppermute(kv, ctx.axis, perm)
+            return (kv, acc), None
+
+        m0 = jnp.full((bl, nkv, rep, tl), -jnp.inf, jnp.float32)
+        num0 = jnp.zeros((bl, nkv, rep, tl, hd), jnp.float32)
+        den0 = jnp.zeros((bl, nkv, rep, tl), jnp.float32)
+        # n-1 hops; the last block is consumed without a wasted final permute
+        (kv, acc), _ = jax.lax.scan(
+            step, ((k_l, v_l), (m0, num0, den0)), jnp.arange(n - 1)
+        )
+        m, num, den = accumulate(acc, kv, n - 1)
+        out = num / jnp.maximum(den[..., None], 1e-30)
+        # (b, g, r, tq, hd) -> (b, tq, g*r, hd)
+        out = jnp.moveaxis(out, 3, 1).reshape(bl, tl, nh, hd)
+        return out.astype(q_l.dtype)
+
+    fn = jax.shard_map(
+        local, mesh=ctx.mesh, in_specs=(bat4, bat4, bat4), out_specs=bat4,
+        check_vma=False,
+    )
+    return fn(q, k, v)

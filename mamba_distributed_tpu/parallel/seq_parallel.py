@@ -1,0 +1,171 @@
+"""Sequence/context parallelism for the SSD path (BASELINE config 4).
+
+The SSM analogue of ring attention (SURVEY.md §5 long-context plan): the
+sequence axis is sharded over the mesh's ``seq`` axis; each device runs
+the chunked SSD on its local tokens, and only the tiny (b, h, p, n)
+boundary states cross devices — O(d_state) traffic instead of O(T).
+
+Mechanics (explicit `shard_map`, because the state recurrence has a
+direction XLA's sharding propagation can't infer):
+
+  * conv halo: each device ppermutes its last (width-1) inputs to the next
+    device, which uses them as ``initial_state`` — exactly the decode-cache
+    hook `ops/conv.py` exposes.
+  * SSD state passing: each device computes its local per-chunk states and
+    a (decay, final_state) summary; summaries are all-gathered over the seq
+    axis (S entries of (b,h)+(b,h,p,n) — tiny), every device combines the
+    prefix before it into its incoming state, and re-runs the local
+    associative state pass seeded with it.
+
+Both transforms are exact: sharded output == single-device output to fp32
+tolerance (pinned by tests/test_seq_parallel.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mamba_distributed_tpu.ops.conv import causal_conv1d
+from mamba_distributed_tpu.ops.ssd import (
+    chunk_local,
+    combine_chunk_outputs,
+    state_passing,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqContext:
+    """Carries the mesh and axis names the sequence-sharded ops run over.
+
+    ``batch_axes`` must match how the caller shards the batch dimension
+    (the trainer's batch sharding: ('data', 'fsdp')).
+    """
+
+    mesh: Mesh
+    axis: str = "seq"
+    batch_axes: tuple[str, ...] = ("data", "fsdp")
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+
+def _shifted(ctx: SeqContext, x: jax.Array) -> jax.Array:
+    """Value from the previous seq rank (zeros into rank 0)."""
+    n = ctx.size
+    perm = [(i, i + 1) for i in range(n - 1)]
+    return jax.lax.ppermute(x, ctx.axis, perm)
+
+
+def sp_conv1d(
+    ctx: SeqContext,
+    x: jax.Array,
+    weight: jax.Array,
+    bias: jax.Array | None,
+    activation: str | None = "silu",
+):
+    """Causal depthwise conv with a (width-1)-token halo exchange.
+
+    x (b, t_global, d) with t sharded over ``ctx.axis``.
+    Returns (y, None) — the decode conv state is meaningless under SP.
+    """
+    width = weight.shape[1]
+    bat = P(ctx.batch_axes, ctx.axis, None)
+    has_bias = bias is not None
+
+    def local(x_l, w, *rest):
+        b = rest[0] if has_bias else None
+        halo = None
+        if width > 1:  # width=1 needs no halo (and -(width-1) would slice badly)
+            assert x_l.shape[1] >= width - 1, (
+                f"local sequence shard ({x_l.shape[1]}) shorter than the "
+                f"conv halo ({width - 1})"
+            )
+            halo = _shifted(ctx, x_l[:, -(width - 1) :, :])
+        return causal_conv1d(x_l, w, b, activation=activation, initial_state=halo)
+
+    in_specs = (bat, P(None, None)) + ((P(None),) if has_bias else ())
+    fn = jax.shard_map(
+        local, mesh=ctx.mesh, in_specs=in_specs, out_specs=bat, check_vma=False
+    )
+    args = (x, weight) + ((bias,) if has_bias else ())
+    return fn(*args), None
+
+
+def sp_ssd(
+    ctx: SeqContext,
+    x: jax.Array,
+    dt: jax.Array,
+    A: jax.Array,
+    B: jax.Array,
+    C: jax.Array,
+    chunk_size: int,
+    D: jax.Array | None = None,
+    compute_dtype=jnp.bfloat16,
+):
+    """Sequence-sharded chunked SSD.
+
+    Shapes as ops/ssd.ssd_chunked: x (b, t, h, p), dt (b, t, h),
+    B/C (b, t, g, n), with t sharded over ``ctx.axis``.
+    Returns (y, None) — the final state stays on the last shard.
+    """
+    from mamba_distributed_tpu.ops.scan import _divisor_chunk
+
+    bat3 = P(ctx.batch_axes, ctx.axis, None)
+    bat4 = P(ctx.batch_axes, ctx.axis, None, None)
+    has_D = D is not None
+
+    def local(x_l, dt_l, A_, B_l, C_l, *rest):
+        D_ = rest[0] if has_D else None
+        b, t_l, h, p = x_l.shape
+        l = _divisor_chunk(t_l, chunk_size)
+        y_diag, states, chunk_decay, c_decayed = chunk_local(
+            x_l, dt_l, A_, B_l, C_l, l, compute_dtype
+        )
+        # local pass to get this shard's summary
+        _, final_local = state_passing(states, chunk_decay)
+        decay_total = jnp.prod(chunk_decay, axis=1)  # (b, h)
+
+        # gather (decay_total, final_local) from every seq rank
+        n = ctx.size
+        idx = jax.lax.axis_index(ctx.axis)
+        decays = jax.lax.all_gather(decay_total, ctx.axis)  # (S, b, h)
+        finals = jax.lax.all_gather(final_local, ctx.axis)  # (S, b, h, p, n)
+
+        # incoming state = sum over ranks j < idx of final_j * prod_{j<m<idx} decay_m
+        ranks = jnp.arange(n)
+        # suffix[j] = prod over m with j < m < idx of decays[m]
+        def suffix_prod(j):
+            mask = ((ranks > j) & (ranks < idx)).astype(decays.dtype)
+            return jnp.prod(
+                decays * mask[:, None, None] + (1.0 - mask)[:, None, None], axis=0
+            )
+
+        suffixes = jax.vmap(suffix_prod)(ranks)  # (S, b, h)
+        contrib_mask = (ranks < idx).astype(decays.dtype)  # (S,)
+        s_in = jnp.sum(
+            finals
+            * (suffixes * contrib_mask[:, None, None])[..., None, None],
+            axis=0,
+        )  # (b, h, p, n)
+
+        # local pass seeded with the incoming state, then the shared
+        # output assembly (ops/ssd.combine_chunk_outputs)
+        prev_states, _ = state_passing(states, chunk_decay, initial_state=s_in)
+        return combine_chunk_outputs(
+            y_diag, c_decayed, prev_states, x_l, D_, compute_dtype
+        )
+
+    in_specs = (bat4, bat3, P(None), bat4, bat4)
+    if has_D:
+        in_specs += (P(None, None) if D.ndim == 2 else P(None),)
+    fn = jax.shard_map(
+        local, mesh=ctx.mesh, in_specs=in_specs, out_specs=bat4, check_vma=False
+    )
+    args = (x, dt, A, B, C) + ((D,) if has_D else ())
+    return fn(*args), None
