@@ -1,0 +1,97 @@
+"""HellaSwag evaluation CLI.
+
+Mirror of the reference's ``python eval.py -m custom|hugging_face ...``
+(/root/reference/eval.py:186-200), with its bugs fixed: the reversed
+``Enum`` bases that crashed at import and the ``hugging_face`` branch that
+never constructed a model (SURVEY.md §3.4) both work here.
+
+  python eval.py -m custom --checkpoint <orbax-dir> --preset mamba2-280m
+  python eval.py -m custom --checkpoint model.pt --preset mamba2-280m
+  python eval.py -m hugging_face --hf-path <local HF dir>
+
+Needs a GPT-2 BPE tokenizer (tiktoken) and a local hellaswag_val.jsonl —
+both are downloads the reference does on the fly; this environment is
+zero-egress, so point the flags at local copies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+
+
+class ModelType(str, enum.Enum):  # reference eval.py:22 had the bases reversed
+    CUSTOM = "custom"
+    HF = "hugging_face"
+
+
+def get_encoder():
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+        return enc.encode
+    except Exception as e:  # no network / no cached BPE
+        raise SystemExit(
+            f"GPT-2 tokenizer unavailable ({e}); HellaSwag needs tiktoken's "
+            "gpt2 encoding (or inject your own via the library API "
+            "mamba_distributed_tpu.eval.evaluate_hellaswag)."
+        )
+
+
+def load_custom(checkpoint: str, preset: str):
+    from mamba_distributed_tpu.config import get_preset
+
+    cfg = get_preset(preset).model
+    if checkpoint.endswith(".pt"):
+        from mamba_distributed_tpu.models.hf import load_hf_checkpoint
+
+        params, cfg = load_hf_checkpoint(checkpoint, cfg)
+    else:
+        from mamba_distributed_tpu.training.checkpoint import restore_params_only
+
+        params = restore_params_only(checkpoint)
+    return params, cfg
+
+
+def load_hf(path: str):
+    from mamba_distributed_tpu.models.hf import load_hf_checkpoint
+
+    return load_hf_checkpoint(path)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model_type", default="custom",
+                   choices=[m.value for m in ModelType])
+    p.add_argument("--checkpoint", default="log/checkpoint")
+    p.add_argument("--preset", default="mamba2-280m")
+    p.add_argument("-v", "--hf-path", default=None,
+                   help="local HF directory (config.json + pytorch_model.bin)")
+    p.add_argument("--data-file", default="hellaswag/hellaswag_val.jsonl")
+    p.add_argument("--limit", type=int, default=2000)
+    p.add_argument("--log-file", default="log/hellaswag_eval.txt")
+    args = p.parse_args()
+
+    from mamba_distributed_tpu.eval import evaluate_hellaswag, iterate_examples
+    from mamba_distributed_tpu.models import lm_forward
+
+    if args.model_type == ModelType.HF.value:
+        assert args.hf_path, "--hf-path required for hugging_face"
+        params, cfg = load_hf(args.hf_path)
+    else:
+        params, cfg = load_custom(args.checkpoint, args.preset)
+
+    result = evaluate_hellaswag(
+        lambda tokens: lm_forward(params, cfg, tokens),
+        iterate_examples(args.data_file),
+        get_encoder(),
+        limit=args.limit,
+        log_path=args.log_file,
+        verbose=True,
+    )
+    print(result)
+
+
+if __name__ == "__main__":
+    main()

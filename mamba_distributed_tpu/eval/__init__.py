@@ -1,0 +1,9 @@
+"""Evaluation harnesses (HellaSwag)."""
+
+from mamba_distributed_tpu.eval.hellaswag import (
+    evaluate_hellaswag,
+    iterate_examples,
+    render_example,
+)
+
+__all__ = ["evaluate_hellaswag", "iterate_examples", "render_example"]

@@ -1,0 +1,147 @@
+"""HellaSwag evaluation, reproducing the reference scoring exactly.
+
+Semantics pinned to /root/reference/eval.py:72-183:
+  * each ending tokenized with a leading " " (GPT-2 BPE quirk, eval.py:96-98)
+  * rows padded to the per-batch max length, completion mask marks ending
+    tokens (eval.py:103-109)
+  * autoregressive CE at all positions, logits/tokens/mask shifted by one
+    (eval.py:143-155)
+  * ``acc`` = argmin of summed loss, ``acc_norm`` = argmin of mean loss
+    (eval.py:157-162)
+  * evaluation stops at 2,000 examples and appends the summary line
+    ``"{n} {correct}/{n} {acc:.4f}"`` (eval.py:180-183) — the comparable
+    number to the reference's published 0.324
+
+Fixed relative to the reference: the broken ``Enum`` subclass and dead HF
+branch (SURVEY.md §3.4) don't exist here, the tokenizer is injected (this
+environment has no network for tiktoken's BPE fetch), and rows are padded
+to a bucket so the jitted forward compiles once, not per example.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def render_example(example: dict, encode: Callable[[str], list[int]]):
+    """dict -> (data, tokens (4, L) int32, mask (4, L) int32, label)."""
+    ctx = example["ctx"]
+    label = int(example["label"])
+    endings = example["endings"]
+
+    ctx_tokens = encode(ctx)
+    data = {"label": label, "ctx_tokens": ctx_tokens, "ending_tokens": []}
+    tok_rows, mask_rows = [], []
+    for end in endings:
+        end_tokens = encode(" " + end)  # " "-prefix rule (reference eval.py:96)
+        tok_rows.append(ctx_tokens + end_tokens)
+        mask_rows.append([0] * len(ctx_tokens) + [1] * len(end_tokens))
+        data["ending_tokens"].append(end_tokens)
+
+    max_len = max(len(r) for r in tok_rows)
+    tokens = np.zeros((4, max_len), dtype=np.int32)
+    mask = np.zeros((4, max_len), dtype=np.int32)
+    for i, (tr, mr) in enumerate(zip(tok_rows, mask_rows)):
+        tokens[i, : len(tr)] = tr
+        mask[i, : len(mr)] = mr
+    return data, tokens, mask, label
+
+
+def iterate_examples(path: str) -> Iterator[dict]:
+    """Yield examples from a local HellaSwag jsonl file.
+
+    The reference downloads from rowanz/hellaswag (eval.py:62-69); this
+    environment has no network, so the file must exist locally.
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found. Download hellaswag_val.jsonl from "
+            "github.com/rowanz/hellaswag/tree/master/data and point "
+            "--data-file at it."
+        )
+    with open(path) as f:
+        for line in f:
+            yield json.loads(line)
+
+
+def _scores_fn(forward):
+    """Build the jitted (tokens, mask) -> (sum_loss, avg_loss) scorer."""
+
+    def scores(tokens, mask):
+        logits = forward(tokens).astype(jnp.float32)  # (4, L, V)
+        shift_logits = logits[:, :-1]
+        shift_tokens = tokens[:, 1:]
+        logp = jax.nn.log_softmax(shift_logits, axis=-1)
+        tok_lp = jnp.take_along_axis(logp, shift_tokens[..., None], axis=-1)[..., 0]
+        shift_mask = mask[:, 1:].astype(jnp.float32)
+        sum_loss = jnp.sum(-tok_lp * shift_mask, axis=1)
+        avg_loss = sum_loss / jnp.maximum(jnp.sum(shift_mask, axis=1), 1.0)
+        return sum_loss, avg_loss
+
+    return jax.jit(scores)
+
+
+def _pad_bucket(n: int, bucket: int = 32) -> int:
+    return ((n + bucket - 1) // bucket) * bucket
+
+
+def evaluate_hellaswag(
+    forward: Callable[[jax.Array], jax.Array],
+    examples: Iterable[dict],
+    encode: Callable[[str], list[int]],
+    limit: int = 2000,
+    log_path: str | None = None,
+    verbose: bool = False,
+    bucket: int = 32,
+) -> dict:
+    """Run the eval; ``forward`` maps (4, L) int32 tokens -> (4, L, V) logits.
+
+    Returns {"acc", "acc_norm", "num_total", ...} after ``limit`` examples
+    (the reference's comparability cap, eval.py:180).
+    """
+    scorer = _scores_fn(forward)
+    num_total = num_correct = num_correct_norm = 0
+
+    for example in examples:
+        data, tokens, mask, label = render_example(example, encode)
+        L = _pad_bucket(tokens.shape[1], bucket)  # few jit shapes, not per-row
+        pt = np.zeros((4, L), np.int32)
+        pm = np.zeros((4, L), np.int32)
+        pt[:, : tokens.shape[1]] = tokens
+        pm[:, : mask.shape[1]] = mask
+        sum_loss, avg_loss = scorer(pt, pm)
+        pred = int(jnp.argmin(sum_loss))
+        pred_norm = int(jnp.argmin(avg_loss))
+
+        num_total += 1
+        num_correct += int(pred == label)
+        num_correct_norm += int(pred_norm == label)
+        if verbose:
+            print(
+                f"{num_total} acc_norm: {num_correct_norm}/{num_total}"
+                f"={num_correct_norm / num_total:.4f}"
+            )
+        if num_total == limit:
+            break
+
+    result = {
+        "num_total": num_total,
+        "acc": num_correct / max(num_total, 1),
+        "acc_norm": num_correct_norm / max(num_total, 1),
+        "num_correct": num_correct,
+        "num_correct_norm": num_correct_norm,
+    }
+    if log_path:
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        with open(log_path, "a") as f:  # append, like reference eval.py:181
+            f.write(
+                f"{num_total} {num_correct_norm}/{num_total} "
+                f"{num_correct_norm / max(num_total, 1):.4f}"
+            )
+    return result

@@ -1,0 +1,5 @@
+"""Inference: recurrent O(1)-per-token generation + sampling."""
+
+from mamba_distributed_tpu.inference.generate import generate, top_k_sample
+
+__all__ = ["generate", "top_k_sample"]

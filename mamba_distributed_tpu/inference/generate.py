@@ -1,0 +1,82 @@
+"""Recurrent generation with top-k sampling.
+
+Functional upgrade of the reference's generate/top_k_sampling
+(/root/reference/model.py:49-95, train.py:166-199): same sampling recipe
+(top-k 50, softmax over the k logits, categorical draw), but the decode
+loop carries the O(1) recurrent state (conv cache + SSM state per layer)
+instead of re-running the full growing prefix through the model each token
+— the reference never used its dep's ``inference_params`` (SURVEY.md §3.3).
+
+Everything (prefill scan + decode scan) is one jit; token-for-token the
+logits match the full-sequence forward (pinned by tests/test_model.py
+decode-parity and tests/test_inference.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.models.lm import init_lm_state, lm_step
+
+
+def top_k_sample(
+    key: jax.Array,
+    logits: jax.Array,
+    k: int = 50,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """Sample from the top-k renormalized distribution.  logits (b, V) -> (b,)."""
+    vals, idx = jax.lax.top_k(logits, k)
+    choice = jax.random.categorical(key, vals / temperature)
+    return jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "temperature")
+)
+def generate(
+    params: dict,
+    cfg: ModelConfig,
+    prompt_ids: jax.Array,
+    key: jax.Array,
+    max_new_tokens: int = 32,
+    top_k: int = 50,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """prompt_ids (b, t) int32 -> (b, t + max_new_tokens) sampled tokens.
+
+    EOT stopping is a host-side concern (jit generates the full budget;
+    truncate at the tokenizer's EOT afterwards, as the caller wishes).
+    """
+    b, t = prompt_ids.shape
+    state = init_lm_state(cfg, batch=b, max_len=t + max_new_tokens)
+
+    def prefill(carry, tok):
+        state, _ = carry
+        logits, state = lm_step(params, cfg, state, tok)
+        return (state, logits), None  # carry only the last logits
+
+    zeros = jnp.zeros((b, cfg.vocab_size_padded), jnp.float32)
+    (state, last_logits), _ = jax.lax.scan(
+        prefill, (state, zeros), jnp.moveaxis(prompt_ids, 1, 0)
+    )
+
+    # never sample the vocab-padding rows (tied zero-padded embeddings give
+    # them logit 0.0, which would outrank real negative logits)
+    pad_mask = jnp.where(
+        jnp.arange(cfg.vocab_size_padded) < cfg.vocab_size, 0.0, -jnp.inf
+    )
+
+    def decode(carry, k_i):
+        state, logits = carry
+        tok = top_k_sample(k_i, logits + pad_mask, top_k, temperature)
+        logits, state = lm_step(params, cfg, state, tok)
+        return (state, logits), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), new_tokens = jax.lax.scan(decode, (state, last_logits), keys)
+    return jnp.concatenate([prompt_ids, jnp.moveaxis(new_tokens, 0, 1)], axis=1)

@@ -1,0 +1,142 @@
+"""HF / mamba_ssm checkpoint importer.
+
+Equivalent of the reference's ``load_from_hf`` (/root/reference/model.py:
+97-116), for a zero-egress environment: instead of ``cached_file`` hub
+downloads, it maps a *local* ``state-spaces``-style torch state dict
+(``MambaLMHeadModel`` naming: ``backbone.layers.{i}.mixer...``) onto this
+framework's layer-stacked JAX param tree.
+
+Layout differences handled here:
+  * torch Linear stores (out, in) -> ours is (in, out): transpose
+  * torch depthwise Conv1d stores (ch, 1, width) -> ours (ch, width)
+  * per-layer tensors -> stacked along a leading n_layer axis
+  * tied lm_head.weight is dropped (ours reuses the embedding)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from mamba_distributed_tpu.config import ModelConfig
+
+
+def config_from_hf_json(config_data: dict) -> ModelConfig:
+    """mamba_ssm MambaConfig json -> ModelConfig."""
+    ssm_cfg = config_data.get("ssm_cfg") or {}
+    layer = ssm_cfg.get("layer", "Mamba1").lower()
+    kw = dict(
+        d_model=config_data["d_model"],
+        n_layer=config_data["n_layer"],
+        vocab_size=config_data["vocab_size"],
+        ssm_layer="mamba2" if layer == "mamba2" else "mamba1",
+        d_intermediate=config_data.get("d_intermediate", 0),
+        rms_norm=config_data.get("rms_norm", True),
+        residual_in_fp32=config_data.get("residual_in_fp32", True),
+        tie_embeddings=config_data.get("tie_embeddings", True),
+        pad_vocab_size_multiple=config_data.get("pad_vocab_size_multiple", 8),
+    )
+    for src, dst in [
+        ("d_state", "d_state"), ("d_conv", "d_conv"), ("expand", "expand"),
+        ("headdim", "headdim"), ("ngroups", "ngroups"),
+        ("chunk_size", "chunk_size"),
+    ]:
+        if src in ssm_cfg:
+            kw[dst] = ssm_cfg[src]
+    return ModelConfig(**kw)
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like -> float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def import_state_dict(state_dict: dict, cfg: ModelConfig) -> dict:
+    """torch MambaLMHeadModel state dict -> layer-stacked JAX param tree."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    n = cfg.n_layer
+    if cfg.attn_layer_idx:
+        raise NotImplementedError("hybrid HF import not supported yet")
+
+    def layer(i: int) -> dict:
+        pre = f"backbone.layers.{i}."
+        mixer = {}
+        mixer["in_proj"] = {"kernel": sd[pre + "mixer.in_proj.weight"].T}
+        if pre + "mixer.in_proj.bias" in sd:
+            mixer["in_proj"]["bias"] = sd[pre + "mixer.in_proj.bias"]
+        conv_w = sd[pre + "mixer.conv1d.weight"]  # (ch, 1, width)
+        mixer["conv"] = {"kernel": conv_w.reshape(conv_w.shape[0], conv_w.shape[-1])}
+        if pre + "mixer.conv1d.bias" in sd:
+            mixer["conv"]["bias"] = sd[pre + "mixer.conv1d.bias"]
+        mixer["A_log"] = sd[pre + "mixer.A_log"]
+        mixer["D"] = sd[pre + "mixer.D"]
+        mixer["out_proj"] = {"kernel": sd[pre + "mixer.out_proj.weight"].T}
+        if pre + "mixer.out_proj.bias" in sd:
+            mixer["out_proj"]["bias"] = sd[pre + "mixer.out_proj.bias"]
+        if cfg.ssm_layer == "mamba2":
+            mixer["dt_bias"] = sd[pre + "mixer.dt_bias"]
+            mixer["norm"] = {"weight": sd[pre + "mixer.norm.weight"]}
+        else:
+            mixer["x_proj"] = {"kernel": sd[pre + "mixer.x_proj.weight"].T}
+            mixer["dt_proj"] = {
+                "kernel": sd[pre + "mixer.dt_proj.weight"].T,
+                "bias": sd[pre + "mixer.dt_proj.bias"],
+            }
+        block = {"norm": {"weight": sd[pre + "norm.weight"]}, "mixer": mixer}
+        if cfg.d_intermediate > 0:
+            block["norm2"] = {"weight": sd[pre + "norm2.weight"]}
+            block["mlp"] = {
+                "fc1": {"kernel": sd[pre + "mlp.fc1.weight"].T},
+                "fc2": {"kernel": sd[pre + "mlp.fc2.weight"].T},
+            }
+        return block
+
+    layers = [layer(i) for i in range(n)]
+    import jax
+
+    blocks = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *layers)
+
+    emb = sd["backbone.embedding.weight"]
+    vp = cfg.vocab_size_padded
+    if emb.shape[0] < vp:  # pad rows like pad_vocab_size_multiple does
+        emb = np.concatenate(
+            [emb, np.zeros((vp - emb.shape[0], emb.shape[1]), emb.dtype)]
+        )
+    params = {
+        "embedding": jnp.asarray(emb),
+        "blocks": blocks,
+        "norm_f": {"weight": jnp.asarray(sd["backbone.norm_f.weight"])},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": jnp.asarray(sd["lm_head.weight"].T)}
+    return params
+
+
+def load_hf_checkpoint(path: str, cfg: ModelConfig | None = None):
+    """Load (params, cfg) from a local HF-style directory or .pt file.
+
+    Directory: expects ``config.json`` + ``pytorch_model.bin``.
+    File: a torch checkpoint holding either a raw state dict or the
+    reference trainer's ``{"model": state_dict, ...}`` wrapper
+    (/root/reference/train.py:154-158).
+    """
+    import torch
+
+    if os.path.isdir(path):
+        with open(os.path.join(path, "config.json")) as f:
+            cfg = config_from_hf_json(json.load(f))
+        sd = torch.load(
+            os.path.join(path, "pytorch_model.bin"),
+            map_location="cpu", weights_only=True,
+        )
+    else:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+        sd = obj.get("model", obj) if isinstance(obj, dict) else obj
+        assert cfg is not None, "pass a ModelConfig when loading a bare .pt"
+    sd = {k.removeprefix("module."): v for k, v in sd.items()}  # DDP prefix
+    return import_state_dict(sd, cfg), cfg

@@ -38,6 +38,20 @@ def save_checkpoint(directory, step, params, opt_state, loader_state, rng) -> No
     mngr.close()
 
 
+def restore_params_only(directory: str, step: int | None = None):
+    """Restore just the model params from a full-state checkpoint (eval/
+    inference don't need optimizer, loader, or RNG state)."""
+    mngr = _manager(directory)
+    if step is None:
+        step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {directory}")
+    # no target tree: orbax restores the on-disk structure as numpy
+    restored = mngr.restore(step, args=ocp.args.StandardRestore())
+    mngr.close()
+    return restored["params"]
+
+
 def restore_checkpoint(directory, params_like, opt_state_like, step=None):
     """Restore into the shardings/dtypes of the given abstract targets."""
     mngr = _manager(directory)

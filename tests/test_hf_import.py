@@ -1,0 +1,126 @@
+"""HF/mamba_ssm checkpoint importer tests.
+
+Builds a synthetic torch state dict with MambaLMHeadModel's naming and
+shapes (torch-cpu is available; mamba_ssm itself is not needed) and pins
+the layout transforms: transposes, conv squeeze, layer stacking, vocab
+padding, tied-head drop.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.models import count_params, lm_forward
+from mamba_distributed_tpu.models.hf import (
+    config_from_hf_json,
+    import_state_dict,
+    load_hf_checkpoint,
+)
+
+CFG = ModelConfig(d_model=32, n_layer=2, vocab_size=61, ssm_layer="mamba2",
+                  headdim=8, chunk_size=16, d_state=16,
+                  compute_dtype="float32")
+
+
+def synthetic_state_dict(cfg: ModelConfig, seed=0) -> dict:
+    g = torch.Generator().manual_seed(seed)
+    di = cfg.d_inner
+    ds = cfg.effective_d_state
+    nh = cfg.nheads
+    gnel = cfg.ngroups
+    d_in_proj = 2 * di + 2 * gnel * ds + nh
+    conv_dim = di + 2 * gnel * ds
+    r = lambda *s: torch.randn(*s, generator=g) * 0.05
+    sd = {"backbone.embedding.weight": r(cfg.vocab_size, cfg.d_model)}
+    for i in range(cfg.n_layer):
+        pre = f"backbone.layers.{i}."
+        sd[pre + "norm.weight"] = torch.ones(cfg.d_model)
+        sd[pre + "mixer.in_proj.weight"] = r(d_in_proj, cfg.d_model)
+        sd[pre + "mixer.conv1d.weight"] = r(conv_dim, 1, cfg.d_conv)
+        sd[pre + "mixer.conv1d.bias"] = r(conv_dim)
+        sd[pre + "mixer.dt_bias"] = r(nh)
+        sd[pre + "mixer.A_log"] = torch.zeros(nh)
+        sd[pre + "mixer.D"] = torch.ones(nh)
+        sd[pre + "mixer.norm.weight"] = torch.ones(di)
+        sd[pre + "mixer.out_proj.weight"] = r(cfg.d_model, di)
+    sd["backbone.norm_f.weight"] = torch.ones(cfg.d_model)
+    sd["lm_head.weight"] = sd["backbone.embedding.weight"]  # tied
+    return sd
+
+
+def test_import_shapes_and_count():
+    sd = synthetic_state_dict(CFG)
+    params = import_state_dict(sd, CFG)
+    # analytic count uses the padded vocab; import pads the embedding to match
+    assert count_params(params) == CFG.num_params()
+    assert params["embedding"].shape == (CFG.vocab_size_padded, CFG.d_model)
+    # transposes landed: ours is (in, out), stacked over layers
+    d_in_proj = 2 * CFG.d_inner + 2 * CFG.ngroups * CFG.effective_d_state + CFG.nheads
+    assert params["blocks"]["mixer"]["in_proj"]["kernel"].shape == (
+        CFG.n_layer, CFG.d_model, d_in_proj,
+    )
+
+
+def test_import_values_roundtrip():
+    sd = synthetic_state_dict(CFG)
+    params = import_state_dict(sd, CFG)
+    w = sd["backbone.layers.1.mixer.in_proj.weight"].numpy()
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["mixer"]["in_proj"]["kernel"][1]), w.T
+    )
+    cw = sd["backbone.layers.0.mixer.conv1d.weight"].numpy()
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["mixer"]["conv"]["kernel"][0]),
+        cw.reshape(cw.shape[0], cw.shape[-1]),
+    )
+
+
+def test_imported_model_runs():
+    import jax
+
+    sd = synthetic_state_dict(CFG)
+    params = import_state_dict(sd, CFG)
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, CFG.vocab_size)
+    logits = lm_forward(params, CFG, x)
+    assert logits.shape == (2, 32, CFG.vocab_size_padded)
+    assert bool(np.isfinite(np.asarray(logits, dtype=np.float32)).all())
+
+
+def test_load_reference_style_pt(tmp_path):
+    """The reference trainer's {'model': sd, ...} wrapper loads too
+    (/root/reference/train.py:154-158)."""
+    sd = synthetic_state_dict(CFG)
+    path = str(tmp_path / "model_03000.pt")
+    torch.save({"model": sd, "step": 3000, "val_loss": 3.26}, path)
+    params, cfg = load_hf_checkpoint(path, CFG)
+    assert params["embedding"].shape == (CFG.vocab_size_padded, CFG.d_model)
+
+
+def test_hf_dir_with_config(tmp_path):
+    import json
+
+    sd = synthetic_state_dict(CFG)
+    d = tmp_path / "hf"
+    d.mkdir()
+    config = {
+        "d_model": CFG.d_model, "n_layer": CFG.n_layer,
+        "vocab_size": CFG.vocab_size,
+        "ssm_cfg": {"layer": "Mamba2", "d_state": 16, "headdim": 8,
+                    "chunk_size": 16},
+        "rms_norm": True, "residual_in_fp32": True, "tie_embeddings": True,
+        "pad_vocab_size_multiple": 8,
+    }
+    (d / "config.json").write_text(json.dumps(config))
+    torch.save(sd, str(d / "pytorch_model.bin"))
+    params, cfg = load_hf_checkpoint(str(d))
+    assert cfg.ssm_layer == "mamba2" and cfg.effective_d_state == 16
+    assert params["blocks"]["mixer"]["A_log"].shape == (2, cfg.nheads)
+
+
+def test_config_from_hf_json_mamba1_default():
+    cfg = config_from_hf_json({"d_model": 768, "n_layer": 64,
+                               "vocab_size": 50277})
+    assert cfg.ssm_layer == "mamba1"  # empty ssm_cfg builds Mamba-1
+    assert cfg.effective_d_state == 16
