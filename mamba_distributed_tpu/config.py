@@ -80,6 +80,21 @@ class ModelConfig:
     # --- memory ---
     remat: bool = True  # per-block activation checkpointing
 
+    # --- kernel backend for the SSD scan: "xla" (einsum formulation) or
+    # "pallas" (fused VMEM kernels, ops/pallas/) ---
+    ssm_impl: str = "xla"
+
+    def __post_init__(self):
+        if self.ssm_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"ssm_impl must be 'xla' or 'pallas', got {self.ssm_impl!r}"
+            )
+        if self.ssm_impl == "pallas" and self.ssm_layer != "mamba2":
+            raise ValueError(
+                "ssm_impl='pallas' backs the SSD scan; it requires "
+                f"ssm_layer='mamba2' (got {self.ssm_layer!r})"
+            )
+
     @property
     def vocab_size_padded(self) -> int:
         m = self.pad_vocab_size_multiple

@@ -146,6 +146,21 @@ def mamba2_mixer(
             seq_ctx, x, dtf, A, B, C, cfg.chunk_size, D,
             compute_dtype=compute_dtype,
         )
+    elif cfg.ssm_impl == "pallas":
+        from mamba_distributed_tpu.ops.pallas import ssd_chunked_pallas
+
+        if initial_ssm_state is None and not return_final_state:
+            y = ssd_chunked_pallas(
+                x, dtf, A, B, C, chunk_size=cfg.chunk_size, D=D,
+                compute_dtype=compute_dtype,
+            )
+            ssm_state = None
+        else:
+            y, ssm_state = ssd_chunked_pallas(
+                x, dtf, A, B, C, chunk_size=cfg.chunk_size, D=D,
+                initial_state=initial_ssm_state, return_final_state=True,
+                compute_dtype=compute_dtype,
+            )
     else:
         y, ssm_state = ssd_chunked(
             x, dtf, A, B, C,

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The XLA formulations in ``ops/ssd.py`` are correct and MXU-friendly but
+materialize the (l x l) intra-chunk decay matrix (O(b*t*h*l) bytes) in HBM
+each layer; these kernels rebuild it in VMEM per tile instead, which is
+where the MFU headroom lives (SURVEY.md §7 stage 5).
+"""
+
+from mamba_distributed_tpu.ops.pallas.ssd_kernels import ssd_chunked_pallas
+
+__all__ = ["ssd_chunked_pallas"]

@@ -1,0 +1,244 @@
+"""Pallas SSD (Mamba-2) chunked-scan kernels.
+
+TPU-native counterpart of the Triton SSD kernels the reference depends on
+(``mamba_ssm/ops/triton/ssd_chunk_scan.py`` etc., mamba-ssm 2.2.2) — but
+re-derived for the MXU/VMEM model, not translated:
+
+  * one grid cell = (batch, chunk, head-block); the (l x l) decay matrix
+    ``L`` is rebuilt from the cumulative log-decay *inside VMEM* per cell,
+    never touching HBM (the XLA path's biggest intermediate);
+  * the two sequential pieces stay at the XLA level where they belong:
+    the inter-chunk state recurrence is a tiny ``associative_scan``
+    (ops/ssd.state_passing), and grouped B/C are indexed per head-block
+    via the BlockSpec index map (never repeated into (b, t, h, n) form);
+  * heads are processed ``hb = 128 // headdim`` at a time so the lane
+    dimension of the y/x tiles stays full.
+
+Training uses ``jax.custom_vjp``: the backward runs the einsum
+formulation (exact same math; XLA autodiff), so gradients are identical
+to ``ssd_chunked`` — pinned by tests/test_pallas.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mamba_distributed_tpu.ops.scan import _divisor_chunk
+from mamba_distributed_tpu.ops.ssd import state_passing
+
+
+def _chunk_states_kernel(x_ref, dt_ref, acum_ref, B_ref, out_ref, *, compute_dtype):
+    """Per-chunk state contribution: out[hb, p, n] = sum_l decay*dt*x (x) B."""
+    a = acum_ref[0, 0]            # (l, hb) fp32, inclusive cumsum of dt*A
+    dt = dt_ref[0, 0]             # (l, hb) fp32
+    Bb = B_ref[0, 0, :, 0]        # (l, n)
+    x = x_ref[0, 0]               # (l, hb, p)
+
+    decay = jnp.exp(a[-1:, :] - a) * dt            # (l, hb)
+    Bd = Bb[:, None, :] * decay[:, :, None]        # (l, hb, n)
+    # batched over hb: (hb, p, l) @ (hb, l, n) -> (hb, p, n)
+    xt = jnp.transpose(x, (1, 2, 0)).astype(compute_dtype)
+    Bt = jnp.transpose(Bd, (1, 0, 2)).astype(compute_dtype)
+    out_ref[0, 0] = jax.lax.dot_general(
+        xt, Bt, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _chunk_output_kernel(
+    x_ref, dt_ref, acum_ref, B_ref, C_ref, prev_ref, y_ref, *, compute_dtype
+):
+    """y = (G odot L) @ (x*dt) + (C*exp(a)) @ prev_state^T for one cell."""
+    a = acum_ref[0, 0]            # (l, hb) fp32
+    dt = dt_ref[0, 0]             # (l, hb)
+    Bb = B_ref[0, 0, :, 0].astype(compute_dtype)   # (l, n)
+    Cb = C_ref[0, 0, :, 0].astype(compute_dtype)   # (l, n)
+    x = x_ref[0, 0]               # (l, hb, p)
+    prev = prev_ref[0, 0]         # (hb, p, n) fp32
+    l = a.shape[0]
+
+    # G is group-shared across the hb heads of this block
+    G = jnp.dot(Cb, Bb.T, preferred_element_type=jnp.float32)  # (l, l)
+
+    # decay matrix rebuilt in VMEM: L[h, i, j] = exp(a_i - a_j) on i >= j
+    ai = a.T[:, :, None]          # (hb, l, 1)
+    aj = a.T[:, None, :]          # (hb, 1, l)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (l, l), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (l, l), 1)
+    tril = ii >= jj
+    M = jnp.where(tril[None], G[None] * jnp.exp(ai - aj), 0.0)  # (hb, l, l)
+
+    xdt = (x.astype(jnp.float32) * dt[:, :, None]).astype(compute_dtype)
+    xdt_t = jnp.transpose(xdt, (1, 0, 2))          # (hb, l, p)
+    y = jax.lax.dot_general(
+        M.astype(compute_dtype), xdt_t, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                              # (hb, l, p)
+
+    # off-diagonal: carried-state contribution
+    cd = (Cb[None] * jnp.exp(a.T)[:, :, None]).astype(compute_dtype)  # (hb, l, n)
+    y = y + jax.lax.dot_general(
+        cd, jnp.transpose(prev, (0, 2, 1)).astype(compute_dtype),
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    y_ref[0, 0] = jnp.transpose(y, (1, 0, 2)).astype(y_ref.dtype)  # (l, hb, p)
+
+
+def _heads_per_block(h: int, p: int, g: int) -> int:
+    hb = max(1, 128 // p)
+    heads_per_group = h // g
+    while heads_per_group % hb != 0 or h % hb != 0:
+        hb -= 1
+    return max(hb, 1)
+
+
+def _ssd_pallas_fwd_impl(
+    x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
+):
+    """Forward via the two kernels + XLA state passing.
+
+    Shapes: x (b,t,h,p); dt (b,t,h) [bias-added+softplused]; A (h,);
+    B/C (b,t,g,n).  Returns (y_no_D (b,t,h,p) fp32-accurate, final_state).
+    """
+    b, t, h, p = x.shape
+    g, n = B.shape[2], B.shape[3]
+    l = _divisor_chunk(t, chunk_size)
+    nc = t // l
+    hb = _heads_per_block(h, p, g)
+    nhb = h // hb
+
+    dtf = dt.astype(jnp.float32)
+    dA = dtf * A.astype(jnp.float32)                 # (b, t, h)
+    dAc = dA.reshape(b, nc, l, h)
+    a_cum = jnp.cumsum(dAc, axis=2)                  # (b, nc, l, h)
+    chunk_decay = jnp.exp(a_cum[:, :, -1, :])        # (b, nc, h)
+
+    xr = x.reshape(b, nc, l, h, p)
+    dtr = dtf.reshape(b, nc, l, h)
+    Br = B.reshape(b, nc, l, g, n)
+    Cr = C.reshape(b, nc, l, g, n)
+
+    grid = (b, nc, nhb)
+    # index maps: (bi, ci, hi) -> block indices; B/C pick the head-block's group
+    x_spec = pl.BlockSpec((1, 1, l, hb, p), lambda bi, ci, hi: (bi, ci, 0, hi, 0))
+    dt_spec = pl.BlockSpec((1, 1, l, hb), lambda bi, ci, hi: (bi, ci, 0, hi))
+    bc_spec = pl.BlockSpec(
+        (1, 1, l, 1, n), lambda bi, ci, hi: (bi, ci, 0, (hi * hb * g) // h, 0)
+    )
+
+    states = pl.pallas_call(
+        functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
+        out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
+        grid=grid,
+        in_specs=[x_spec, dt_spec, dt_spec, bc_spec],
+        out_specs=pl.BlockSpec(
+            (1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+        ),
+        interpret=interpret,
+    )(xr, dtr, a_cum, Br)
+
+    prev_states, final_state = state_passing(states, chunk_decay, initial_state)
+
+    y = pl.pallas_call(
+        functools.partial(_chunk_output_kernel, compute_dtype=compute_dtype),
+        out_shape=jax.ShapeDtypeStruct((b, nc, l, h, p), x.dtype),
+        grid=grid,
+        in_specs=[
+            x_spec, dt_spec, dt_spec, bc_spec, bc_spec,
+            pl.BlockSpec((1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
+        ],
+        out_specs=x_spec,
+        interpret=interpret,
+    )(xr, dtr, a_cum, Br, Cr, prev_states)
+
+    return y.reshape(b, t, h, p), final_state
+
+
+def _add_D(y, x, D):
+    if D is None:
+        return y
+    Df = D.astype(jnp.float32)
+    yf = y.astype(jnp.float32) + x.astype(jnp.float32) * (
+        Df[None, None, :, :] if Df.ndim == 2 else Df[None, None, :, None]
+    )
+    return yf.astype(x.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7)
+)
+def _ssd_pallas_core(x, dt, A, B, C, chunk_size, compute_dtype, interpret):
+    y, _ = _ssd_pallas_fwd_impl(
+        x, dt, A, B, C, chunk_size, None, compute_dtype, interpret
+    )
+    return y
+
+
+def _core_fwd(x, dt, A, B, C, chunk_size, compute_dtype, interpret):
+    y = _ssd_pallas_core(x, dt, A, B, C, chunk_size, compute_dtype, interpret)
+    return y, (x, dt, A, B, C)
+
+
+def _core_bwd(chunk_size, compute_dtype, interpret, res, dy):
+    """Backward through the einsum formulation — same math, XLA autodiff."""
+    from mamba_distributed_tpu.ops.ssd import ssd_chunked
+
+    x, dt, A, B, C = res
+
+    def f(x, dt, A, B, C):
+        # dt here is already softplus-ed; ssd_chunked takes it as-is
+        return ssd_chunked(
+            x, dt, A, B, C, chunk_size=chunk_size, D=None,
+            compute_dtype=compute_dtype,
+        )
+
+    _, vjp = jax.vjp(f, x, dt, A, B, C)
+    return vjp(dy)
+
+
+_ssd_pallas_core.defvjp(_core_fwd, _core_bwd)
+
+
+def ssd_chunked_pallas(
+    x: jax.Array,
+    dt: jax.Array,
+    A: jax.Array,
+    B: jax.Array,
+    C: jax.Array,
+    chunk_size: int = 256,
+    D: jax.Array | None = None,
+    initial_state: jax.Array | None = None,
+    return_final_state: bool = False,
+    compute_dtype=jnp.bfloat16,
+    interpret: bool | None = None,
+):
+    """Drop-in for ops/ssd.ssd_chunked backed by Pallas kernels.
+
+    With ``return_final_state`` or ``initial_state`` (decode prefill / SP)
+    the non-custom-vjp path is used; the training path (neither) gets the
+    custom VJP with an XLA backward.  ``interpret=None`` auto-selects the
+    Pallas interpreter off-TPU (CPU tests run the same kernel code).
+    """
+    if interpret is None:
+        # real Mosaic lowering on TPU (incl. tunneled platforms whose
+        # backend name isn't "tpu"); interpreter elsewhere (CPU tests)
+        kind = getattr(jax.devices()[0], "device_kind", "").lower()
+        interpret = not (jax.default_backend() == "tpu" or "tpu" in kind)
+    if initial_state is None and not return_final_state:
+        y = _ssd_pallas_core(
+            x, dt, A, B, C, chunk_size, compute_dtype, interpret
+        )
+        return _add_D(y, x, D)
+    y, final_state = _ssd_pallas_fwd_impl(
+        x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
+    )
+    y = _add_D(y, x, D)
+    if return_final_state:
+        return y, final_state
+    return y

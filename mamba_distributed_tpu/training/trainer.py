@@ -41,6 +41,8 @@ class Trainer:
         cfg: TrainConfig,
         devices=None,
         verbose: bool = True,
+        sample_prompt_ids=None,
+        decode_fn=None,
     ):
         self.cfg = cfg
         self.mesh = build_mesh(cfg.mesh, devices)
@@ -114,6 +116,12 @@ class Trainer:
 
         self.logger = MetricsLogger(cfg.log_dir, self.verbose)
         self.step = 0
+        # in-training sampling (reference train.py:166-199): every
+        # sample_every steps generate 4 continuations of the prompt.
+        # Token ids are injected (no tokenizer download in zero-egress
+        # environments); decode_fn, if given, renders them as text.
+        self._sample_prompt_ids = sample_prompt_ids
+        self._decode_fn = decode_fn
         self._flops_per_token = flops_per_token(cfg.model, cfg.seq_len)
         self._peak = peak_flops_per_chip() * self.mesh.devices.size
 
@@ -158,6 +166,12 @@ class Trainer:
             if step % cfg.val_every == 0 or step == last - 1:
                 val_loss = self.validate()
                 self.logger.val(step, val_loss)
+            if (
+                self._sample_prompt_ids is not None
+                and step % cfg.sample_every == 0
+                and step > 0
+            ):
+                self.sample()
             if checkpoint_dir and step > 0 and step % cfg.checkpoint_every == 0:
                 self.save_checkpoint(checkpoint_dir)
 
@@ -176,6 +190,31 @@ class Trainer:
             )
             self.step += 1
         return self
+
+    def sample(self, num_return: int = 4, max_new_tokens: int = 32,
+               top_k: int = 50):
+        """Generate continuations like the reference's in-loop sampling
+        (4 sequences x 32 tokens, top-k 50, train.py:170-175) — but with
+        O(1) recurrent decode instead of full-prefix re-forwards."""
+        import numpy as np
+
+        from mamba_distributed_tpu.inference import generate
+
+        prompt = jnp.asarray(self._sample_prompt_ids, jnp.int32)[None, :]
+        prompt = jnp.tile(prompt, (num_return, 1))
+        self.rng, key = jax.random.split(self.rng)
+        out = generate(
+            self.params, self.cfg.model, prompt, key,
+            max_new_tokens=max_new_tokens, top_k=top_k,
+        )
+        if self.verbose:
+            for row in np.asarray(out):
+                text = (
+                    self._decode_fn(row.tolist()) if self._decode_fn
+                    else f"tokens {row.tolist()}"
+                )
+                print(f"sample: {text}")
+        return out
 
     # --- checkpointing (training/checkpoint.py; full-state, exact resume) ---
 
