@@ -6,13 +6,17 @@ split name, rank-strided sequential windows (rank r reads windows
 r, r+W, r+2W, ... of each shard), next-token (x, y) pairs from a B*T+1
 slice, shard cycling with dropped tails, deterministic order, no shuffling.
 
-Beyond the reference it adds (SURVEY.md §5 checkpoint/resume):
+Beyond the reference it adds:
   * ``state()`` / ``restore()`` — exact-resume loader position for
     checkpointing (the reference cannot resume, train.py:161-162);
   * multi-host awareness — on TPU-VM pods each host is one "process", so
     ``process_rank``/``num_processes`` default to the JAX process grid;
-  * numpy outputs shaped (B, T) ready to be device_put against a
-    data-sharded ``NamedSharding``.
+  * a native C++ backend (data/native.py + data/native/shard_reader.cc):
+    shards are memory-mapped instead of fully loaded into host RAM
+    (the reference np.load()s the whole shard, dataloader.py:7-11), and
+    the x/y pair is assembled in one C++ pass.  ``backend="auto"`` uses
+    it when the toolchain built it; numpy otherwise.  Both backends are
+    tested to produce identical batches.
 """
 
 from __future__ import annotations
@@ -38,11 +42,23 @@ class ShardedTokenLoader:
         process_rank: int = 0,
         num_processes: int = 1,
         master_process: bool = True,
+        backend: str = "auto",
     ):
         assert split in {"train", "val"}
+        assert backend in {"auto", "native", "numpy"}
         self.B, self.T = B, T
         self.process_rank = process_rank
         self.num_processes = num_processes
+
+        self._backend = backend
+        if backend == "numpy":
+            self._native = False
+        else:
+            from mamba_distributed_tpu.data import native
+
+            self._native = native.available()
+            if backend == "native" and not self._native:
+                raise RuntimeError("native shard reader unavailable")
 
         shards = sorted(
             os.path.join(data_dir, s)
@@ -52,25 +68,58 @@ class ShardedTokenLoader:
         assert shards, f"no shards found for split {split} in {data_dir}"
         self.shards = shards
         if master_process:
-            print(f"found {len(shards)} shards for split {split}")
+            backend_name = "native" if self._native else "numpy"
+            print(f"found {len(shards)} shards for split {split} ({backend_name})")
         self.reset()
+
+    # --- shard backends ---
+
+    def _open_shard(self, idx: int) -> None:
+        path = self.shards[idx]
+        if self._native:
+            from mamba_distributed_tpu.data.native import NativeShard
+
+            if getattr(self, "_shard", None) is not None:
+                self._shard.close()
+            try:
+                self._shard = NativeShard(path)
+            except OSError:
+                if self._backend == "native":
+                    raise
+                # "auto": shard dtype/layout outside the C++ parser's set
+                # (e.g. int64, big-endian) — degrade to numpy for this loader
+                self._shard = None
+                self._native = False
+            else:
+                self._shard_len = len(self._shard)
+                return
+        self._shard = None
+        self.tokens = load_tokens(path)
+        self._shard_len = len(self.tokens)
+
+    def _slice(self, pos: int):
+        B, T = self.B, self.T
+        if self._native:
+            return self._shard.fill_batch(pos, B, T)
+        buf = self.tokens[pos : pos + B * T + 1]
+        return buf[:-1].reshape(B, T), buf[1:].reshape(B, T)
+
+    # --- reference API ---
 
     def reset(self) -> None:
         self.current_shard = 0
-        self.tokens = load_tokens(self.shards[self.current_shard])
+        self._open_shard(0)
         self.current_position = self.B * self.T * self.process_rank
 
     def next_batch(self) -> tuple[np.ndarray, np.ndarray]:
         B, T = self.B, self.T
-        buf = self.tokens[self.current_position : self.current_position + B * T + 1]
-        x = buf[:-1].reshape(B, T)
-        y = buf[1:].reshape(B, T)
+        x, y = self._slice(self.current_position)
         self.current_position += B * T * self.num_processes
         # advance when the *next* strided window would overrun the shard
         # (same guard as reference dataloader.py:46-51 — tails are dropped)
-        if self.current_position + (B * T * self.num_processes + 1) > len(self.tokens):
+        if self.current_position + (B * T * self.num_processes + 1) > self._shard_len:
             self.current_shard = (self.current_shard + 1) % len(self.shards)
-            self.tokens = load_tokens(self.shards[self.current_shard])
+            self._open_shard(self.current_shard)
             self.current_position = B * T * self.process_rank
         return x, y
 
@@ -84,5 +133,5 @@ class ShardedTokenLoader:
 
     def restore(self, state: dict) -> None:
         self.current_shard = int(state["current_shard"]) % len(self.shards)
-        self.tokens = load_tokens(self.shards[self.current_shard])
+        self._open_shard(self.current_shard)
         self.current_position = int(state["current_position"])

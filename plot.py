@@ -1,0 +1,68 @@
+"""Plot train/val loss curves from the reference-format log file.
+
+Script equivalent of the reference's plot.ipynb (cells 0-1): parses
+``"{step} train {loss}"`` / ``"{step} val {loss}"`` lines — the format both
+the reference and this framework write — and saves ``validation_loss.png``.
+
+  python plot.py [--log log/log.txt] [--out log/validation_loss.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def parse_log(path: str):
+    train, val = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) != 3:
+                continue
+            step, kind, loss = parts
+            try:
+                entry = (int(step), float(loss))
+            except ValueError:
+                continue
+            if kind == "train":
+                train.append(entry)
+            elif kind == "val":
+                val.append(entry)
+    return train, val
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--log", default="log/log.txt")
+    p.add_argument("--out", default="log/validation_loss.png")
+    p.add_argument("--ref-log", default=None,
+                   help="optional second log to overlay (e.g. the reference's)")
+    args = p.parse_args()
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    train, val = parse_log(args.log)
+    fig, ax = plt.subplots(figsize=(8, 5))
+    if train:
+        ax.plot(*zip(*train), label="train loss", alpha=0.6, linewidth=0.8)
+    if val:
+        ax.plot(*zip(*val), label="val loss", marker="o", markersize=3)
+    if args.ref_log:
+        rt, rv = parse_log(args.ref_log)
+        if rv:
+            ax.plot(*zip(*rv), label="reference val", linestyle="--")
+    ax.set_xlabel("step")
+    ax.set_ylabel("loss")
+    ax.legend()
+    ax.grid(alpha=0.3)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    fig.savefig(args.out, dpi=120, bbox_inches="tight")
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
