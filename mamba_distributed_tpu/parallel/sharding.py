@@ -10,6 +10,17 @@ param + optimizer-state sharding; XLA inserts the all-gathers/reduce-
 scatters.  Layer-stacked block params (leading n_layer axis from the
 scan-over-layers layout) shard a *non-layer* axis so `lax.scan` slices
 locally instead of gathering the whole stack per step.
+
+Tensor parallel (over the ``tensor`` axis): mixer weights shard their
+d_inner-derived axis — in_proj/conv column-parallel, out_proj/dt_proj
+row-parallel (mamba_ssm 2.2.2 carries the same, unused, ``process_group``
+plumbing in its mixers, SURVEY.md §2.3).  This is GSPMD-correctness TP:
+because in_proj/wqkv pack multiple segments (z|xBC|dt, q|k|v) on one
+axis, an even column shard cuts inside segments and XLA inserts a
+reshard after the projection rather than keeping every inner activation
+sharded Megatron-style; losses are exactly single-device (tested), the
+communication pattern is compiler-chosen.  A per-rank-permuted packed
+layout would tighten it — future work, BASELINE configs don't use TP.
 """
 
 from __future__ import annotations
@@ -21,42 +32,84 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mamba_distributed_tpu.config import ModelConfig
 
 
-def _spec_for(path: str, shape: tuple[int, ...], fsdp_size: int,
-              stacked: bool) -> P:
-    """Shard the largest fsdp-divisible axis (skipping the layer axis of
-    stacked block params); replicate whatever doesn't divide."""
-    if fsdp_size <= 1 or not shape:
+# (path-suffix pattern, axis-from-end carrying the d_inner/head dimension)
+# column-parallel weights shard their output axis, row-parallel their input
+_TP_RULES: tuple[tuple[tuple[str, ...], int], ...] = (
+    (("mixer", "in_proj", "kernel"), -1),   # column
+    (("mixer", "out_proj", "kernel"), -2),  # row
+    (("mixer", "conv", "kernel"), -2),
+    (("mixer", "conv", "bias"), -1),
+    (("mixer", "x_proj", "kernel"), -2),    # row (input is sharded x)
+    (("mixer", "dt_proj", "kernel"), -1),
+    (("mixer", "dt_proj", "bias"), -1),
+    (("mixer", "A_log"), -1),               # mamba2 (nh,); mamba1 handled below
+    (("mixer", "dt_bias"), -1),
+    (("mixer", "D"), -1),
+    (("mixer", "norm", "weight"), -1),
+    (("mixer", "wqkv", "kernel"), -1),
+    (("mlp", "fc1", "kernel"), -1),
+    (("mlp", "fc2", "kernel"), -2),
+)
+
+
+def _tp_axis(names: list[str], ndim: int, stacked: bool) -> int | None:
+    """Which axis (if any) of this param shards over the tensor axis."""
+    for pattern, ax in _TP_RULES:
+        k = len(pattern)
+        if tuple(names[-k:]) == pattern:
+            # mamba1's A_log is (di, n): the head/channel axis is -2 there
+            if pattern[-1] == "A_log" and ndim - (1 if stacked else 0) == 2:
+                ax = -2
+            return ndim + ax
+    return None
+
+
+def _spec_for(names: list[str], shape: tuple[int, ...], fsdp_size: int,
+              tensor_size: int, stacked: bool) -> P:
+    """Tensor-parallel axis first (by rule), then the largest remaining
+    fsdp-divisible axis (skipping the layer axis of stacked params);
+    replicate whatever doesn't divide."""
+    spec: list = [None] * len(shape)
+    if tensor_size > 1:
+        ax = _tp_axis(names, len(shape), stacked)
+        if ax is not None and shape[ax] % tensor_size == 0:
+            spec[ax] = "tensor"
+    if fsdp_size > 1:
+        start = 1 if stacked and len(shape) > 1 else 0
+        cands = [
+            (shape[i], i)
+            for i in range(start, len(shape))
+            if spec[i] is None and shape[i] % fsdp_size == 0
+        ]
+        if cands:
+            _, axis = max(cands)
+            spec[axis] = "fsdp"
+    if all(s is None for s in spec):
         return P()
-    start = 1 if stacked and len(shape) > 1 else 0
-    cands = [
-        (shape[i], i) for i in range(start, len(shape)) if shape[i] % fsdp_size == 0
-    ]
-    if not cands:
-        return P()
-    _, axis = max(cands)
-    spec = [None] * len(shape)
-    spec[axis] = "fsdp"
     return P(*spec)
 
 
-def param_specs(params, shard: bool, fsdp_size: int):
+def param_specs(params, shard: bool, fsdp_size: int, tensor_size: int = 1):
     """PartitionSpec pytree matching ``params``.
 
-    ``shard=False`` -> everything replicated (pure DP).
+    ``shard=False`` disables FSDP; tensor parallelism applies whenever
+    ``tensor_size > 1`` (it is a layout requirement, not an option).
     """
     def leaf_spec(path, leaf):
-        if not shard:
-            return P()
-        names = [getattr(k, "key", getattr(k, "idx", None)) for k in path]
+        names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
         stacked = "blocks" in names or "attn_blocks" in names
-        return _spec_for("/".join(map(str, names)), np.shape(leaf), fsdp_size, stacked)
+        return _spec_for(
+            names, np.shape(leaf),
+            fsdp_size if shard else 1, tensor_size, stacked,
+        )
 
     return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
 
 def param_shardings(params, mesh: Mesh, shard: bool):
-    fsdp_size = mesh.shape["fsdp"]
-    specs = param_specs(params, shard, fsdp_size)
+    specs = param_specs(
+        params, shard, mesh.shape["fsdp"], mesh.shape["tensor"]
+    )
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 
