@@ -79,12 +79,20 @@ class ModelConfig:
 
     # --- memory ---
     remat: bool = True  # per-block activation checkpointing
+    # "all": recompute everything (min memory); "dots": save matmul
+    # outputs, recompute elementwise (jax dots_with_no_batch_dims policy —
+    # trades HBM for a lighter backward)
+    remat_policy: str = "all"
 
     # --- kernel backend for the SSD scan: "xla" (einsum formulation) or
     # "pallas" (fused VMEM kernels, ops/pallas/) ---
     ssm_impl: str = "xla"
 
     def __post_init__(self):
+        if self.remat_policy not in ("all", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'all' or 'dots', got {self.remat_policy!r}"
+            )
         if self.ssm_impl not in ("xla", "pallas"):
             raise ValueError(
                 f"ssm_impl must be 'xla' or 'pallas', got {self.ssm_impl!r}"

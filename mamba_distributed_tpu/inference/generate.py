@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from mamba_distributed_tpu.config import ModelConfig
-from mamba_distributed_tpu.models.lm import init_lm_state, lm_step
+from mamba_distributed_tpu.models.lm import lm_prefill, lm_step
 
 
 def top_k_sample(
@@ -53,16 +53,10 @@ def generate(
     truncate at the tokenizer's EOT afterwards, as the caller wishes).
     """
     b, t = prompt_ids.shape
-    state = init_lm_state(cfg, batch=b, max_len=t + max_new_tokens)
-
-    def prefill(carry, tok):
-        state, _ = carry
-        logits, state = lm_step(params, cfg, state, tok)
-        return (state, logits), None  # carry only the last logits
-
-    zeros = jnp.zeros((b, cfg.vocab_size_padded), jnp.float32)
-    (state, last_logits), _ = jax.lax.scan(
-        prefill, (state, zeros), jnp.moveaxis(prompt_ids, 1, 0)
+    # parallel prefill: one full-sequence forward builds the decode state
+    # (the reference re-ran the whole prefix per token instead)
+    last_logits, state = lm_prefill(
+        params, cfg, prompt_ids, max_len=t + max_new_tokens
     )
 
     # never sample the vocab-padding rows (tied zero-padded embeddings give

@@ -137,8 +137,12 @@ def attention_mixer(
 
 
 def init_attention_state(cfg: ModelConfig, batch: int, max_len: int,
-                         dtype=jnp.bfloat16):
+                         dtype=None):
+    """KV caches in the compute dtype — matching what attention_mixer's
+    prefill path produces, so init- and prefill-built states share avals."""
     nh, nkv, hd, _ = _attn_dims(cfg)
+    if dtype is None:
+        dtype = jnp.dtype(cfg.compute_dtype)
     k = jnp.zeros((batch, max_len, nkv, hd), dtype)
     v = jnp.zeros((batch, max_len, nkv, hd), dtype)
     return k, v, jnp.array(0, jnp.int32)

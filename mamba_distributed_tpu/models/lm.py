@@ -85,24 +85,70 @@ def _gated_mlp(params: dict, x: jax.Array, compute_dtype) -> jax.Array:
     return linear(params["fc2"], y * jax.nn.silu(gate.astype(jnp.float32)).astype(y.dtype), compute_dtype)
 
 
-def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None):
-    """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP]."""
+def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
+               return_state: bool = False):
+    """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP].
+
+    ``return_state=True`` (prefill) additionally returns the mixer's decode
+    state (conv+SSM caches, or attention KV caches).
+    """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     normed, residual = add_rms_norm(
         hidden, residual, block_params["norm"]["weight"], cfg.norm_eps,
         residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
     )
+    state = None
     if attn:
-        hidden = attention_mixer(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
+        if return_state:
+            hidden, state = attention_mixer(
+                block_params["mixer"], cfg, normed, return_final_state=True
+            )
+        else:
+            hidden = attention_mixer(
+                block_params["mixer"], cfg, normed, seq_ctx=seq_ctx
+            )
     else:
-        hidden = _mixer_fwd(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
+        if return_state:
+            mix = mamba2_mixer if cfg.ssm_layer == "mamba2" else mamba1_mixer
+            hidden, state = mix(
+                block_params["mixer"], cfg, normed, return_final_state=True
+            )
+        else:
+            hidden = _mixer_fwd(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
     if cfg.d_intermediate > 0:
         normed, residual = add_rms_norm(
             hidden, residual, block_params["norm2"]["weight"], cfg.norm_eps,
             residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
         )
         hidden = _gated_mlp(block_params["mlp"], normed, compute_dtype)
+    if return_state:
+        return hidden, residual, state
     return hidden, residual
+
+
+def _final_logits(params, cfg: ModelConfig, hidden, residual):
+    """Final fused add+norm -> (tied) LM head, fp32-accumulated."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    normed, _ = add_rms_norm(
+        hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
+        residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
+    )
+    if cfg.tie_embeddings:
+        return jnp.dot(
+            normed.astype(compute_dtype),
+            params["embedding"].T.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+    return linear(params["lm_head"], normed, compute_dtype).astype(jnp.float32)
+
+
+def _remat(fn, cfg: ModelConfig, static_argnums=()):
+    """Per-block checkpointing with the configured save policy."""
+    if cfg.remat_policy == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    else:
+        policy = None
+    return jax.checkpoint(fn, policy=policy, static_argnums=static_argnums)
 
 
 def init_lm_params(key: jax.Array, cfg: ModelConfig) -> dict:
@@ -156,7 +202,7 @@ def lm_forward(
             bp = jax.tree.map(lambda p, j=j: p[j], stack)
             body = _block_fwd
             if cfg.remat:
-                body = jax.checkpoint(body, static_argnums=(1, 4, 5))
+                body = _remat(body, cfg, static_argnums=(1, 4, 5))
             hidden, residual = body(bp, cfg, hidden, residual, attn, seq_ctx)
             if attn:
                 ai += 1
@@ -172,24 +218,13 @@ def lm_forward(
             return (hidden, residual), None
 
         if cfg.remat:
-            body = jax.checkpoint(body)
+            body = _remat(body, cfg)
         (hidden, residual), _ = jax.lax.scan(body, (hidden, residual), params["blocks"])
 
-    normed, _ = add_rms_norm(
-        hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
-        residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
-    )
     if num_last_tokens > 0:
-        normed = normed[:, -num_last_tokens:]
-    if cfg.tie_embeddings:
-        logits = jnp.dot(
-            normed.astype(compute_dtype),
-            params["embedding"].T.astype(compute_dtype),
-            preferred_element_type=jnp.float32,
-        ).astype(compute_dtype)
-    else:
-        logits = linear(params["lm_head"], normed, compute_dtype)
-    return logits
+        hidden = hidden[:, -num_last_tokens:]
+        residual = residual[:, -num_last_tokens:]
+    return _final_logits(params, cfg, hidden, residual).astype(compute_dtype)
 
 
 def lm_loss(
@@ -215,6 +250,71 @@ def count_params(params) -> int:
 # ---------------------------------------------------------------------------
 # Recurrent decode (O(1) per token) — used by inference/generate.py
 # ---------------------------------------------------------------------------
+
+
+def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
+               max_len: int = 0):
+    """Parallel prefill: one full-sequence forward that also returns the
+    per-layer decode state (conv cache, SSM state, attention KV caches
+    padded to ``max_len``).  The sequential per-token prefill this replaces
+    is what the reference effectively did by re-running the prefix
+    (SURVEY.md §3.3).  Shares ``_block_fwd`` with lm_forward.
+
+    Returns (last_logits (b, V) fp32, state) — state feeds lm_step.
+    """
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    b, t = input_ids.shape
+    if cfg.attn_layer_idx and max_len <= t:
+        raise ValueError(
+            f"hybrid prefill needs KV capacity beyond the prompt: "
+            f"max_len={max_len} <= prompt length {t}"
+        )
+    hidden = params["embedding"][input_ids].astype(compute_dtype)
+    residual = None
+
+    def pad_attn(state):
+        k, v, length = state
+        pad = [(0, 0), (0, max_len - k.shape[1]), (0, 0), (0, 0)]
+        return jnp.pad(k, pad), jnp.pad(v, pad), length
+
+    if cfg.attn_layer_idx:
+        attn_idx = set(cfg.attn_layer_idx)
+        mi = ai = 0
+        m_states, a_states = [], []
+        for i in range(cfg.n_layer):
+            attn = i in attn_idx
+            stack = params["attn_blocks"] if attn else params["blocks"]
+            bp = jax.tree.map(lambda p, j=(ai if attn else mi): p[j], stack)
+            hidden, residual, st = _block_fwd(
+                bp, cfg, hidden, residual, attn, return_state=True
+            )
+            if attn:
+                a_states.append(pad_attn(st))
+                ai += 1
+            else:
+                m_states.append(st)
+                mi += 1
+        stack = lambda sts: jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+        state = {"blocks": stack(m_states), "attn_blocks": stack(a_states)}
+    else:
+        residual = jnp.zeros_like(
+            hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+        )
+
+        def body(carry, bp):
+            hidden, residual = carry
+            hidden, residual, st = _block_fwd(
+                bp, cfg, hidden, residual, False, return_state=True
+            )
+            return (hidden, residual), st
+
+        (hidden, residual), state_blocks = jax.lax.scan(
+            body, (hidden, residual), params["blocks"]
+        )
+        state = {"blocks": state_blocks}
+
+    logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
+    return logits[:, 0].astype(jnp.float32), state
 
 
 def init_lm_state(cfg: ModelConfig, batch: int, max_len: int = 0):

@@ -133,9 +133,13 @@ def mamba1_mixer(
     return out
 
 
-def init_mamba1_state(cfg: ModelConfig, batch: int, dtype=jnp.float32):
+def init_mamba1_state(cfg: ModelConfig, batch: int, dtype=None):
+    """conv cache in the compute dtype (matches full-sequence prefill);
+    SSM state fp32 (matches the scan's carry)."""
     di = cfg.d_inner
     ds = cfg.effective_d_state
+    if dtype is None:
+        dtype = jnp.dtype(cfg.compute_dtype)
     conv_state = jnp.zeros((batch, cfg.d_conv - 1, di), dtype)
     ssm_state = jnp.zeros((batch, di, ds), jnp.float32)
     return conv_state, ssm_state

@@ -181,9 +181,15 @@ def mamba2_mixer(
     return out
 
 
-def init_mamba2_state(cfg: ModelConfig, batch: int, dtype=jnp.float32):
-    """Zero decode state: (conv_state, ssm_state) for one mixer."""
+def init_mamba2_state(cfg: ModelConfig, batch: int, dtype=None):
+    """Zero decode state: (conv_state, ssm_state) for one mixer.
+
+    conv cache in the compute dtype (matching what the full-sequence
+    prefill produces), SSM state in fp32 (matching state_passing).
+    """
     di, ds, g, nh, _, conv_dim = _dims(cfg)
+    if dtype is None:
+        dtype = jnp.dtype(cfg.compute_dtype)
     conv_state = jnp.zeros((batch, cfg.d_conv - 1, conv_dim), dtype)
     ssm_state = jnp.zeros((batch, nh, cfg.headdim, ds), jnp.float32)
     return conv_state, ssm_state

@@ -51,6 +51,96 @@ def test_generate_greedy_matches_full_forward(layer, rng):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+def test_prefill_state_matches_step_state(layer, rng):
+    """lm_prefill's state continues decoding identically to a token-by-token
+    lm_step prefill."""
+    from mamba_distributed_tpu.models.lm import init_lm_state, lm_prefill, lm_step
+
+    cfg = cfg_for(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+
+    logits_p, state_p = lm_prefill(params, cfg, prompt)
+    state_s = init_lm_state(cfg, batch=2)
+    for i in range(12):
+        logits_s, state_s = lm_step(params, cfg, state_s, prompt[:, i])
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(logits_s),
+                               atol=2e-3, rtol=1e-3)
+    # next decoded token's logits agree from either state
+    nxt = jnp.argmax(logits_s, axis=-1)
+    lp, _ = lm_step(params, cfg, state_p, nxt)
+    ls, _ = lm_step(params, cfg, state_s, nxt)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(ls),
+                               atol=2e-3, rtol=1e-3)
+
+
+def test_prefill_state_hybrid(rng):
+    from mamba_distributed_tpu.models.lm import init_lm_state, lm_prefill, lm_step
+
+    cfg = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2", headdim=8,
+        chunk_size=16, d_state=16, compute_dtype="float32",
+        attn_layer_idx=(1,), attn_num_heads=4, attn_num_kv_heads=2,
+        remat=False,
+    )
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 64)
+    logits_p, state_p = lm_prefill(params, cfg, prompt, max_len=16)
+    state_s = init_lm_state(cfg, batch=1, max_len=16)
+    for i in range(8):
+        logits_s, state_s = lm_step(params, cfg, state_s, prompt[:, i])
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(logits_s),
+                               atol=2e-3, rtol=1e-3)
+    nxt = jnp.argmax(logits_s, axis=-1)
+    lp, _ = lm_step(params, cfg, state_p, nxt)
+    ls, _ = lm_step(params, cfg, state_s, nxt)
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(ls),
+                               atol=2e-3, rtol=1e-3)
+
+
+def test_prefill_half_precision_residual(rng):
+    """bf16 compute + residual_in_fp32=False must not break the scan carry
+    dtype invariant in prefill."""
+    cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
+                      headdim=8, chunk_size=16, d_state=16,
+                      compute_dtype="bfloat16", residual_in_fp32=False)
+    from mamba_distributed_tpu.models.lm import lm_prefill
+
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    logits, state = lm_prefill(params, cfg, prompt)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_prefill_state_avals_match_init_state(rng):
+    """init_lm_state and lm_prefill build states with identical avals, so
+    a step jitted against one accepts the other without recompiling."""
+    from mamba_distributed_tpu.models.lm import init_lm_state, lm_prefill
+
+    cfg = cfg_for("mamba2")
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.ones((2, 8), jnp.int32)
+    _, state_p = lm_prefill(params, cfg, prompt)
+    state_i = init_lm_state(cfg, batch=2)
+    for a, b in zip(jax.tree.leaves(state_p), jax.tree.leaves(state_i)):
+        assert a.shape == b.shape and a.dtype == b.dtype, (a, b)
+
+
+def test_hybrid_prefill_requires_capacity():
+    from mamba_distributed_tpu.models.lm import lm_prefill
+
+    cfg = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2", headdim=8,
+        chunk_size=16, d_state=16, compute_dtype="float32",
+        attn_layer_idx=(1,), attn_num_heads=4, remat=False,
+    )
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="KV capacity"):
+        lm_prefill(params, cfg, prompt)  # default max_len=0 would clobber
+
+
 def test_generate_never_samples_pad_tokens(rng):
     """Zero-padded tied embeddings give pad ids logit 0.0, which beats
     real tokens' negative logits; generate must mask them out."""

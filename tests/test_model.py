@@ -138,6 +138,28 @@ def test_remat_matches_no_remat():
     assert jnp.allclose(l1, l2, atol=1e-6)
 
 
+def test_remat_policy_dots_matches():
+    """remat_policy='dots' is numerically identical (only memory differs)."""
+    cfg_all = CFGS["mamba2"]
+    cfg_dots = ModelConfig(**{**TINY, "ssm_layer": "mamba2",
+                              "remat_policy": "dots"})
+    params = init_lm_params(jax.random.PRNGKey(0), cfg_all)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, 64)
+    l1, g1 = jax.value_and_grad(lm_loss)(params, cfg_all, x, y)
+    l2, g2 = jax.value_and_grad(lm_loss)(params, cfg_dots, x, y)
+    assert jnp.allclose(l1, l2, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        assert jnp.allclose(a, b, atol=1e-5), "grads diverge across policies"
+
+
+def test_remat_policy_validation():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="remat_policy"):
+        ModelConfig(remat_policy="everything")
+
+
 def test_mixers_differ():
     """mamba1 and mamba2 are genuinely different computations."""
     c1, c2 = CFGS["mamba1"], CFGS["mamba2"]
