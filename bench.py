@@ -2,29 +2,82 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline: the reference's derived ~174K tokens/sec/GPU on 8xA100
-(BASELINE.md "Aggregate throughput"); vs_baseline = ours / 174000.
+(BASELINE.md "Aggregate throughput"); vs_baseline = ours / 174000.  It is
+a throughput-per-chip comparison at the same model + seq_len (each side
+runs its own batch size — the reference used B=32/GPU), and the key is
+omitted entirely for other presets/seq_lens, which have no reference
+number to compare against.
+
+Progress goes to stderr with timestamps so a hung run is diagnosable from
+the log tail (device claim on pooled/tunneled TPUs can queue for minutes).
+
+Env knobs (for sweeps; defaults are the shipped configuration):
+  BENCH_PRESET     preset name            (default mamba2-280m)
+  BENCH_B          micro batch size       (default 8)
+  BENCH_T          sequence length        (default 1024)
+  BENCH_SSM_IMPL   xla | pallas           (default preset's)
+  BENCH_REMAT      0 | 1                  (default preset's)
+  BENCH_REMAT_POLICY all | dots           (default preset's)
+  BENCH_ITERS      timed iterations       (default 10)
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+_T0 = time.time()
+
+# reference-derived tokens/sec/GPU for the 280M @ T=1024 recipe (BASELINE.md)
+BASELINE_TOK_PER_SEC = 174_000.0
+BASELINE_PRESET = "mamba2-280m"
+BASELINE_T = 1024
+
+# shipped single-chip defaults (shared by time_config and _env_spec)
+DEFAULT_B = 8
+DEFAULT_T = BASELINE_T
+DEFAULT_PRESET = BASELINE_PRESET
 
 
-def main() -> None:
+def _progress(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def time_config(spec: dict, iters: int = 10) -> dict:
+    """Time the jitted train step for one configuration on the local chip.
+
+    spec keys (all optional): preset, B, T, ssm_impl, remat, remat_policy.
+    Returns {**spec, tok_per_sec, mfu, step_ms} or {**spec, error} on
+    failure (e.g. OOM at large batch) so sweeps can continue.
+    """
+    import jax
+    import jax.numpy as jnp
+
     from mamba_distributed_tpu.config import get_preset
     from mamba_distributed_tpu.models import init_lm_params
     from mamba_distributed_tpu.parallel.mesh import build_mesh
-    from mamba_distributed_tpu.parallel.sharding import opt_state_shardings, param_shardings
+    from mamba_distributed_tpu.parallel.sharding import (
+        opt_state_shardings,
+        param_shardings,
+    )
     from mamba_distributed_tpu.training.optimizer import make_optimizer
     from mamba_distributed_tpu.training.train_step import make_train_step
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
-    B, T = 8, 1024
-    cfg = get_preset("mamba2-280m", micro_batch_size=B, total_batch_size=B * T)
+    B = spec.get("B", DEFAULT_B)
+    T = spec.get("T", DEFAULT_T)
+    preset = spec.get("preset", DEFAULT_PRESET)
+    cfg = get_preset(preset, micro_batch_size=B, seq_len=T, total_batch_size=B * T)
+    model_over = {
+        k: spec[k] for k in ("ssm_impl", "remat", "remat_policy") if k in spec
+    }
+    if model_over:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, **model_over)
+        )
     mesh = build_mesh(cfg.mesh, jax.devices()[:1])
 
     key = jax.random.PRNGKey(0)
@@ -33,6 +86,8 @@ def main() -> None:
     params = jax.jit(
         lambda k: init_lm_params(k, cfg.model), out_shardings=pshard
     )(key)
+    jax.block_until_ready(params)
+    _progress(f"{spec or 'default'}: params initialized on device")
     optimizer = make_optimizer(cfg)
     opt_shapes = jax.eval_shape(optimizer.init, params)
     oshard = opt_state_shardings(opt_shapes, shapes, pshard, mesh)
@@ -47,37 +102,91 @@ def main() -> None:
         jax.random.randint(ky, (1, B, T), 0, cfg.model.vocab_size, jnp.int32)
     )
 
-    # warmup (compile + 2 steps); float() forces a host transfer because
-    # block_until_ready is a no-op on some experimental platforms
-    for _ in range(3):
-        params, opt_state, loss, _ = step(params, opt_state, x, y)
-    float(loss)
+    try:
+        # warmup (compile + 2 steps); float() forces a host transfer because
+        # block_until_ready is a no-op on some experimental platforms
+        for i in range(3):
+            params, opt_state, loss, _ = step(params, opt_state, x, y)
+            if i == 0:
+                float(loss)
+                _progress("train step compiled + first step done")
+        float(loss)
 
-    iters = 10
-    t0 = time.time()
-    for _ in range(iters):
-        params, opt_state, loss, _ = step(params, opt_state, x, y)
-    float(loss)  # steps chain on params, so this closes all iters
-    dt = (time.time() - t0) / iters
+        t0 = time.time()
+        for _ in range(iters):
+            params, opt_state, loss, _ = step(params, opt_state, x, y)
+        final_loss = float(loss)  # steps chain on params; closes all iters
+        dt = (time.time() - t0) / iters
+    except Exception as e:  # e.g. OOM at larger B — report and let sweeps go on
+        return {**spec, "error": f"{type(e).__name__}: {str(e)[:200]}"}
 
     tok_per_sec = B * T / dt
     fpt = flops_per_token(cfg.model, T, training=True)
-    mfu = fpt * tok_per_sec / peak_flops_per_chip()
-    print(
-        json.dumps(
-            {
-                "metric": "train_tokens_per_sec_per_chip_mamba2_280m",
-                "value": round(tok_per_sec, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(tok_per_sec / 174_000.0, 4),
-                "mfu": round(mfu, 4),
-                "step_ms": round(dt * 1000, 2),
-                "device": jax.devices()[0].device_kind,
-                "batch": [B, T],
-                "loss": round(float(loss), 4),
-            }
-        )
-    )
+    return {
+        **spec,
+        "tok_per_sec": round(tok_per_sec, 1),
+        "mfu": round(fpt * tok_per_sec / peak_flops_per_chip(), 4),
+        "step_ms": round(dt * 1000, 2),
+        "loss": round(final_loss, 4),
+        "ssm_impl": cfg.model.ssm_impl,
+        "remat": cfg.model.remat,
+    }
+
+
+def _env_spec() -> dict:
+    spec = {
+        "B": int(os.environ.get("BENCH_B", str(DEFAULT_B))),
+        "T": int(os.environ.get("BENCH_T", str(DEFAULT_T))),
+        "preset": os.environ.get("BENCH_PRESET", DEFAULT_PRESET),
+    }
+    if os.environ.get("BENCH_SSM_IMPL"):
+        spec["ssm_impl"] = os.environ["BENCH_SSM_IMPL"]
+    if os.environ.get("BENCH_REMAT"):
+        v = os.environ["BENCH_REMAT"]
+        if v not in ("0", "1"):
+            raise SystemExit(f"BENCH_REMAT must be 0 or 1, got {v!r}")
+        spec["remat"] = v == "1"
+    if os.environ.get("BENCH_REMAT_POLICY"):
+        spec["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
+    return spec
+
+
+def main() -> None:
+    import jax
+
+    # BENCH_PLATFORM=cpu forces the CPU backend for harness testing.  The
+    # env var JAX_PLATFORMS alone is not enough on axon-site machines (the
+    # site plugin overrides it programmatically), so set the config too.
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    _progress(f"jax {jax.__version__} imported; initializing backend...")
+    dev = jax.devices()[0]
+    _progress(f"backend up: {len(jax.devices())}x {dev.device_kind or dev.platform}")
+
+    spec = _env_spec()
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    r = time_config(spec, iters=iters)
+    if "error" in r:
+        print(json.dumps(r), flush=True)
+        raise SystemExit(1)
+
+    out = {
+        "metric": f"train_tokens_per_sec_per_chip_{spec['preset'].replace('-', '_')}",
+        "value": r["tok_per_sec"],
+        "unit": "tokens/sec/chip",
+        "mfu": r["mfu"],
+        "step_ms": r["step_ms"],
+        "device": dev.device_kind,
+        "batch": [spec["B"], spec["T"]],
+        "ssm_impl": r["ssm_impl"],
+        "remat": r["remat"],
+        "loss": r["loss"],
+    }
+    # vs_baseline is only defined for the reference's model + seq_len
+    if spec["preset"] == BASELINE_PRESET and spec["T"] == BASELINE_T:
+        out["vs_baseline"] = round(r["tok_per_sec"] / BASELINE_TOK_PER_SEC, 4)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

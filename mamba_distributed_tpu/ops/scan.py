@@ -28,10 +28,25 @@ import jax.numpy as jnp
 
 
 def _divisor_chunk(t: int, chunk_size: int) -> int:
-    """Largest chunk size <= chunk_size that divides t (t is a static shape)."""
+    """Largest chunk size <= chunk_size that divides t (t is a static shape).
+
+    Warns when the divisor degrades badly (e.g. prime-ish t forces tiny
+    chunks): the chunked scans then degenerate toward per-token work.  t is
+    static under jit, so the warning fires at trace time, once per shape.
+    """
     l = min(chunk_size, t)
     while t % l != 0:
         l -= 1
+    if l < min(chunk_size, t, 16):
+        import warnings
+
+        warnings.warn(
+            f"sequence length {t} has no divisor near chunk_size={chunk_size}; "
+            f"falling back to chunk size {l}, which degrades the chunked scan "
+            f"toward per-token work — pad the sequence to a multiple of a "
+            f"reasonable chunk size instead",
+            stacklevel=3,
+        )
     return l
 
 

@@ -126,31 +126,39 @@ def chunk_local(
       y_diag       (b, nc, l, h, p) intra-chunk contribution
       states       (b, nc, h, p, n) per-chunk final state contribution
       chunk_decay  (b, nc, h)       exp(sum of dt*A over the chunk)
-      c_decayed    (b, nc, l, h, n) C * exp(cumsum dt*A) for the off-diag term
+      off_ctx      (C (b, nc, l, g, n) compute-dtype, state_decay
+                   (b, nc, l, h) fp32) — inputs to the off-diagonal
+                   correction (combine_chunk_outputs)
+
+    B and C stay in their group-compact (g, n) form throughout: the G
+    Gram matrix is computed once per group (h/g-fold fewer MACs than the
+    per-head formulation), per-head decay scalars attach to the tensors
+    that are already per-head (x, the off-diagonal output), and nothing
+    of shape (b, t, h, n) is ever materialized.
     """
     b, t, h, p = x.shape
-    n = B.shape[-1]
+    g, n = B.shape[2], B.shape[-1]
+    assert h % g == 0
+    hg = h // g
     l = chunk_size
     assert t % l == 0, (t, l)
     nc = t // l
 
     dtf = dt.astype(jnp.float32)
     Af = A.astype(jnp.float32)
-    Bh = _expand_groups(B, h)
-    Ch = _expand_groups(C, h)
 
     xc = x.reshape(b, nc, l, h, p)
     dtc = dtf.reshape(b, nc, l, h)
-    Bc = Bh.reshape(b, nc, l, h, n)
-    Cc = Ch.reshape(b, nc, l, h, n)
+    Bc = B.reshape(b, nc, l, g, n)
+    Cc = C.reshape(b, nc, l, g, n)
 
     dA = dtc * Af  # (b, nc, l, h), <= 0
     dA_cum = jnp.cumsum(dA, axis=2)  # inclusive cumsum within chunk
 
     # --- intra-chunk (diagonal blocks): batched MXU matmuls ---
-    # G[i, j] = <C_i, B_j>  -> (b, nc, h, l, l)
+    # G[i, j] = <C_i, B_j> is group-shared -> (b, nc, g, l, l)
     G = jnp.einsum(
-        "bclhn,bcshn->bchls",
+        "bclgn,bcsgn->bcgls",
         Cc.astype(compute_dtype),
         Bc.astype(compute_dtype),
         preferred_element_type=jnp.float32,
@@ -159,7 +167,8 @@ def chunk_local(
     # decay matrix — the biggest intermediate of the whole op, O(b*t*h*l) —
     # is materialized in the compute dtype to halve its HBM traffic
     L_mat = jnp.exp(segsum(jnp.moveaxis(dA, 2, -1)))  # (b, nc, h, l, l)
-    M = (G * L_mat).astype(compute_dtype)
+    Lg = L_mat.reshape(b, nc, g, hg, l, l)
+    M = (G[:, :, :, None] * Lg).astype(compute_dtype).reshape(b, nc, h, l, l)
     xdt = (xc.astype(jnp.float32) * dtc[..., None]).astype(compute_dtype)
     y_diag = jnp.einsum(
         "bchls,bcshp->bclhp",
@@ -168,22 +177,22 @@ def chunk_local(
         preferred_element_type=jnp.float32,
     )
 
-    # --- per-chunk state summaries ---
+    # --- per-chunk state summaries (per-head decay*dt attaches to x) ---
     decay_states = jnp.exp(dA_cum[:, :, -1:, :] - dA_cum)  # (b, nc, l, h)
-    states = jnp.einsum(
-        "bclhn,bclhp->bchpn",
-        (Bc.astype(jnp.float32) * (decay_states * dtc)[..., None]).astype(
-            compute_dtype
-        ),
-        xc.astype(compute_dtype),
-        preferred_element_type=jnp.float32,
+    xg = (
+        (xc.astype(jnp.float32) * (decay_states * dtc)[..., None])
+        .astype(compute_dtype)
+        .reshape(b, nc, l, g, hg, p)
     )
+    states = jnp.einsum(
+        "bclgn,bclgjp->bcgjpn",
+        Bc.astype(compute_dtype),
+        xg,
+        preferred_element_type=jnp.float32,
+    ).reshape(b, nc, h, p, n)
     chunk_decay = jnp.exp(dA_cum[:, :, -1, :])  # (b, nc, h)
-    # stored for the off-diagonal einsum; compute dtype halves its footprint
-    c_decayed = (
-        Cc.astype(jnp.float32) * jnp.exp(dA_cum)[..., None]
-    ).astype(compute_dtype)
-    return y_diag, states, chunk_decay, c_decayed
+    off_ctx = (Cc.astype(compute_dtype), jnp.exp(dA_cum))
+    return y_diag, states, chunk_decay, off_ctx
 
 
 def state_passing(
@@ -224,7 +233,7 @@ def state_passing(
 
 def combine_chunk_outputs(
     y_diag: jax.Array,
-    c_decayed: jax.Array,
+    off_ctx: tuple[jax.Array, jax.Array],
     prev_states: jax.Array,
     x: jax.Array,
     D: jax.Array | None,
@@ -234,15 +243,22 @@ def combine_chunk_outputs(
 
     Shared by the single-device path (ssd_chunked) and the sequence-
     parallel path (parallel/seq_parallel.sp_ssd): off-diagonal correction
-    through the carried states + optional D skip connection.
+    through the carried states + optional D skip connection.  The per-head
+    decay scalar multiplies the einsum *output*, so C never expands past
+    its group-compact form.
     """
     b, nc, l, h, p = y_diag.shape
+    Cc, state_decay = off_ctx  # (b, nc, l, g, n), (b, nc, l, h)
+    g = Cc.shape[3]
+    n = prev_states.shape[-1]
+    prev_g = prev_states.reshape(b, nc, g, h // g, p, n)
     y_off = jnp.einsum(
-        "bclhn,bchpn->bclhp",
-        c_decayed.astype(compute_dtype),
-        prev_states.astype(compute_dtype),
+        "bclgn,bcgjpn->bclgjp",
+        Cc.astype(compute_dtype),
+        prev_g.astype(compute_dtype),
         preferred_element_type=jnp.float32,
-    )
+    ).reshape(b, nc, l, h, p)
+    y_off = y_off * state_decay[..., None]
     y = (y_diag + y_off).reshape(b, nc * l, h, p)
     if D is not None:
         Df = D.astype(jnp.float32)
@@ -275,11 +291,11 @@ def ssd_chunked(
     b, t, h, p = x.shape
     l = _divisor_chunk(t, chunk_size)
 
-    y_diag, states, chunk_decay, c_decayed = chunk_local(
+    y_diag, states, chunk_decay, off_ctx = chunk_local(
         x, dt, A, B, C, l, compute_dtype
     )
     prev_states, final_state = state_passing(states, chunk_decay, initial_state)
-    y = combine_chunk_outputs(y_diag, c_decayed, prev_states, x, D, compute_dtype)
+    y = combine_chunk_outputs(y_diag, off_ctx, prev_states, x, D, compute_dtype)
     if return_final_state:
         return y, final_state
     return y

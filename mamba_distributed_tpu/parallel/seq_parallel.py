@@ -124,7 +124,7 @@ def sp_ssd(
         D_ = rest[0] if has_D else None
         b, t_l, h, p = x_l.shape
         l = _divisor_chunk(t_l, chunk_size)
-        y_diag, states, chunk_decay, c_decayed = chunk_local(
+        y_diag, states, chunk_decay, off_ctx = chunk_local(
             x_l, dt_l, A_, B_l, C_l, l, compute_dtype
         )
         # local pass to get this shard's summary
@@ -158,7 +158,7 @@ def sp_ssd(
         # output assembly (ops/ssd.combine_chunk_outputs)
         prev_states, _ = state_passing(states, chunk_decay, initial_state=s_in)
         return combine_chunk_outputs(
-            y_diag, c_decayed, prev_states, x_l, D_, compute_dtype
+            y_diag, off_ctx, prev_states, x_l, D_, compute_dtype
         )
 
     in_specs = (bat4, bat3, P(None), bat4, bat4)

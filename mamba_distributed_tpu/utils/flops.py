@@ -39,8 +39,9 @@ def _mamba2_layer_flops(cfg: ModelConfig, seq_len: int) -> float:
     l = min(cfg.chunk_size, seq_len)
     f = 2 * d * (2 * di + 2 * g * n + h)  # in_proj
     f += 2 * (di + 2 * g * n) * cfg.d_conv  # depthwise conv
-    # SSD per token: G (l*n), M@x (l*p), chunk states (n*p), off-diag (n*p)
-    f += 2 * h * (l * (n + p) + 2 * n * p)
+    # SSD per token: G Gram matrix is group-shared (ops/ssd.chunk_local),
+    # M@x (l*p), chunk states (n*p) and off-diag (n*p) are per-head
+    f += 2 * (g * l * n + h * l * p + 2 * h * n * p)
     f += 2 * di * d  # out_proj
     return f
 

@@ -1,0 +1,61 @@
+"""Sweep train-step configurations on the local chip in one process.
+
+One device claim, many configs: reuses bench.time_config (the exact
+protocol bench.py reports) across ssm_impl / remat / batch-size
+combinations and prints one JSON line per configuration, plus a final
+{"best": ...} line. Used to pick the defaults bench.py ships with.
+
+  python scripts/sweep_bench.py                 # full sweep
+  SWEEP_CONFIGS='[{"B":8,"ssm_impl":"xla"}]' python scripts/sweep_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _progress, time_config  # noqa: E402
+
+DEFAULT_CONFIGS = [
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "dots"},
+    {"B": 8, "ssm_impl": "xla", "remat": False},
+    {"B": 8, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
+    {"B": 8, "ssm_impl": "pallas", "remat": True, "remat_policy": "dots"},
+    {"B": 8, "ssm_impl": "pallas", "remat": False},
+    {"B": 16, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
+    {"B": 16, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
+    {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
+    {"B": 32, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
+]
+
+
+def main() -> None:
+    import jax
+
+    _progress("initializing backend...")
+    dev = jax.devices()[0]
+    _progress(f"backend up: {dev.device_kind or dev.platform}")
+
+    configs = (
+        json.loads(os.environ["SWEEP_CONFIGS"])
+        if os.environ.get("SWEEP_CONFIGS")
+        else DEFAULT_CONFIGS
+    )
+    iters = int(os.environ.get("BENCH_ITERS", "8"))
+    results = []
+    for spec in configs:
+        r = time_config(spec, iters=iters)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    ok = [r for r in results if "tok_per_sec" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["tok_per_sec"])
+        print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,8 +31,9 @@ TINY_MODEL = dict(
 )
 
 
-def make_cfg(tmp, mesh=None, shard=False, micro=8, accum=2, T=64, layer="mamba2"):
-    model = ModelConfig(**{**TINY_MODEL, "ssm_layer": layer})
+def make_cfg(tmp, mesh=None, shard=False, micro=8, accum=2, T=64, layer="mamba2",
+             model_over=None):
+    model = ModelConfig(**{**TINY_MODEL, "ssm_layer": layer, **(model_over or {})})
     mesh = mesh or MeshConfig()
     dp = mesh.data * mesh.fsdp
     return TrainConfig(
