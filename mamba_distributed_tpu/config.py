@@ -97,10 +97,10 @@ class ModelConfig:
             raise ValueError(
                 f"ssm_impl must be 'xla' or 'pallas', got {self.ssm_impl!r}"
             )
-        if self.ssm_impl == "pallas" and self.ssm_layer != "mamba2":
+        if self.ssm_impl == "pallas" and self.ssm_layer not in ("mamba1", "mamba2"):
             raise ValueError(
-                "ssm_impl='pallas' backs the SSD scan; it requires "
-                f"ssm_layer='mamba2' (got {self.ssm_layer!r})"
+                "ssm_impl='pallas' backs the SSD scan (mamba2) and the "
+                f"selective scan (mamba1); got ssm_layer={self.ssm_layer!r}"
             )
 
     @property

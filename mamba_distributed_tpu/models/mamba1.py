@@ -118,15 +118,25 @@ def mamba1_mixer(
     )
 
     A = -jnp.exp(params["A_log"])  # (di, ds)
-    y, ssm_state = selective_scan(
-        x, dt, A, B, C,
-        D=params["D"],
-        z=z,
-        delta_bias=params["dt_proj"]["bias"],
+    if cfg.ssm_impl == "pallas":
+        from mamba_distributed_tpu.ops.pallas import selective_scan_pallas
+
+        scan_fn = selective_scan_pallas
+    else:
+        scan_fn = selective_scan
+    scan_kw = dict(
+        D=params["D"], z=z, delta_bias=params["dt_proj"]["bias"],
         delta_softplus=True,
-        initial_state=initial_ssm_state,
-        return_final_state=True,
     )
+    if initial_ssm_state is None and not return_final_state:
+        # training path: keeps the Pallas backend on its custom-vjp route
+        y = scan_fn(x, dt, A, B, C, **scan_kw)
+        ssm_state = None
+    else:
+        y, ssm_state = scan_fn(
+            x, dt, A, B, C, **scan_kw,
+            initial_state=initial_ssm_state, return_final_state=True,
+        )
     out = linear(params["out_proj"], y, compute_dtype)
     if return_final_state:
         return out, (conv_state, ssm_state)

@@ -1,11 +1,15 @@
 """Pallas TPU kernels for the hot ops.
 
-The XLA formulations in ``ops/ssd.py`` are correct and MXU-friendly but
-materialize the (l x l) intra-chunk decay matrix (O(b*t*h*l) bytes) in HBM
-each layer; these kernels rebuild it in VMEM per tile instead, which is
-where the MFU headroom lives (SURVEY.md §7 stage 5).
+The XLA formulations in ``ops/ssd.py``/``ops/scan.py`` are correct but pay
+in HBM traffic: the SSD path materializes the (l x l) intra-chunk decay
+matrix (O(b*t*h*l) bytes) per layer, and the selective-scan path remats
+around a transient (b, l, d, n) tensor.  These kernels keep those
+intermediates in VMEM instead — the SSD decay matrix is rebuilt per tile,
+the selective-scan state lives in registers for the whole sequence — which
+is where the MFU headroom lives (SURVEY.md §7 stage 5).
 """
 
+from mamba_distributed_tpu.ops.pallas.scan_kernels import selective_scan_pallas
 from mamba_distributed_tpu.ops.pallas.ssd_kernels import ssd_chunked_pallas
 
-__all__ = ["ssd_chunked_pallas"]
+__all__ = ["selective_scan_pallas", "ssd_chunked_pallas"]

@@ -37,7 +37,7 @@ def _divisor_chunk(t: int, chunk_size: int) -> int:
     l = min(chunk_size, t)
     while t % l != 0:
         l -= 1
-    if l < min(chunk_size, t, 16):
+    if 4 * l <= min(chunk_size, t):
         import warnings
 
         warnings.warn(

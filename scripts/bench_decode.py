@@ -1,0 +1,91 @@
+"""Decode-throughput benchmark: recurrent O(1)-per-token generation.
+
+The reference's generate loop re-runs the entire growing prefix through
+the model for every new token (/root/reference/model.py:49-75,
+train.py:176-194) — O(T) work per token.  This framework decodes from
+carried conv/SSM state (inference/generate.py), so per-token cost is
+O(1); this script measures that as sampled tokens/sec/chip.
+
+Prints one JSON line.  Env knobs: DECODE_B (default 8), DECODE_PROMPT
+(default 128), DECODE_NEW (default 256), BENCH_PRESET, BENCH_PLATFORM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_T0 = time.time()
+
+
+def _progress(msg: str) -> None:
+    print(f"[decode +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+    _progress("initializing backend...")
+    dev = jax.devices()[0]
+    _progress(f"backend up: {dev.device_kind or dev.platform}")
+
+    from mamba_distributed_tpu.config import get_preset
+    from mamba_distributed_tpu.inference import generate
+    from mamba_distributed_tpu.models import init_lm_params
+
+    B = int(os.environ.get("DECODE_B", "8"))
+    prompt_len = int(os.environ.get("DECODE_PROMPT", "128"))
+    new_tokens = int(os.environ.get("DECODE_NEW", "256"))
+    preset = os.environ.get("BENCH_PRESET", "mamba2-280m")
+    cfg = get_preset(preset).model
+
+    key = jax.random.PRNGKey(0)
+    params = jax.jit(lambda k: init_lm_params(k, cfg))(key)
+    jax.block_until_ready(params)
+    _progress("params initialized")
+
+    kp, kg = jax.random.split(jax.random.PRNGKey(1))
+    prompt = jax.random.randint(kp, (B, prompt_len), 0, cfg.vocab_size, jnp.int32)
+
+    out = generate(params, cfg, prompt, kg, max_new_tokens=new_tokens)
+    jax.block_until_ready(out)
+    _progress("generate compiled + warm run done")
+
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    t0 = time.time()
+    for i in range(iters):
+        out = generate(
+            params, cfg, prompt, jax.random.fold_in(kg, i),
+            max_new_tokens=new_tokens,
+        )
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / iters
+
+    tok_per_sec = B * new_tokens / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
+                "value": round(tok_per_sec, 1),
+                "unit": "sampled tokens/sec/chip",
+                "per_token_ms": round(1000 * dt / new_tokens, 3),
+                "batch": B,
+                "prompt_len": prompt_len,
+                "new_tokens": new_tokens,
+                "device": dev.device_kind,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
