@@ -71,10 +71,14 @@ def iterate_examples(path: str) -> Iterator[dict]:
 
 
 def _scores_fn(forward):
-    """Build the jitted (tokens, mask) -> (sum_loss, avg_loss) scorer."""
+    """Build the jitted (tokens, mask) -> (sum_loss, avg_loss) scorer.
+
+    Rows are independent: tokens/mask are (R, L) with R = 4 rows per
+    example times however many examples the caller packs per call.
+    """
 
     def scores(tokens, mask):
-        logits = forward(tokens).astype(jnp.float32)  # (4, L, V)
+        logits = forward(tokens).astype(jnp.float32)  # (R, L, V)
         shift_logits = logits[:, :-1]
         shift_tokens = tokens[:, 1:]
         logp = jax.nn.log_softmax(shift_logits, axis=-1)
@@ -99,36 +103,53 @@ def evaluate_hellaswag(
     log_path: str | None = None,
     verbose: bool = False,
     bucket: int = 32,
+    example_batch: int = 8,
 ) -> dict:
-    """Run the eval; ``forward`` maps (4, L) int32 tokens -> (4, L, V) logits.
+    """Run the eval; ``forward`` maps (R, L) int32 tokens -> (R, L, V) logits.
 
-    Returns {"acc", "acc_norm", "num_total", ...} after ``limit`` examples
-    (the reference's comparability cap, eval.py:180).
+    ``example_batch`` examples are packed into one device call (R = 4 x
+    example_batch rows) — each row scores independently, so the numbers are
+    identical to the reference's one-example-at-a-time loop (eval.py:135),
+    just without starving the chip.  Returns {"acc", "acc_norm",
+    "num_total", ...} after ``limit`` examples (the reference's
+    comparability cap, eval.py:180).
     """
     scorer = _scores_fn(forward)
     num_total = num_correct = num_correct_norm = 0
 
-    for example in examples:
-        data, tokens, mask, label = render_example(example, encode)
-        L = _pad_bucket(tokens.shape[1], bucket)  # few jit shapes, not per-row
-        pt = np.zeros((4, L), np.int32)
-        pm = np.zeros((4, L), np.int32)
-        pt[:, : tokens.shape[1]] = tokens
-        pm[:, : mask.shape[1]] = mask
+    def score_batch(batch):
+        nonlocal num_total, num_correct, num_correct_norm
+        L = _pad_bucket(max(t.shape[1] for _, t, _, _ in batch), bucket)
+        pt = np.zeros((4 * example_batch, L), np.int32)  # fixed R: few jit shapes
+        pm = np.zeros((4 * example_batch, L), np.int32)
+        for i, (_, tokens, mask, _) in enumerate(batch):
+            pt[4 * i : 4 * i + 4, : tokens.shape[1]] = tokens
+            pm[4 * i : 4 * i + 4, : mask.shape[1]] = mask
         sum_loss, avg_loss = scorer(pt, pm)
-        pred = int(jnp.argmin(sum_loss))
-        pred_norm = int(jnp.argmin(avg_loss))
+        sum_loss = np.asarray(sum_loss).reshape(example_batch, 4)
+        avg_loss = np.asarray(avg_loss).reshape(example_batch, 4)
+        for i, (_, _, _, label) in enumerate(batch):
+            num_total += 1
+            num_correct += int(int(np.argmin(sum_loss[i])) == label)
+            num_correct_norm += int(int(np.argmin(avg_loss[i])) == label)
+            if verbose:
+                print(
+                    f"{num_total} acc_norm: {num_correct_norm}/{num_total}"
+                    f"={num_correct_norm / num_total:.4f}"
+                )
 
-        num_total += 1
-        num_correct += int(pred == label)
-        num_correct_norm += int(pred_norm == label)
-        if verbose:
-            print(
-                f"{num_total} acc_norm: {num_correct_norm}/{num_total}"
-                f"={num_correct_norm / num_total:.4f}"
-            )
-        if num_total == limit:
+    pending = []
+    taken = 0
+    for example in examples:
+        pending.append(render_example(example, encode))
+        taken += 1
+        if len(pending) == example_batch:
+            score_batch(pending)
+            pending = []
+        if taken == limit:
             break
+    if pending:
+        score_batch(pending)
 
     result = {
         "num_total": num_total,

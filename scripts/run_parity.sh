@@ -1,0 +1,16 @@
+#!/bin/bash
+# Early-curve parity run on the local chip (BASELINE: reference log/log_mamba.txt
+# steps 0-30 fall 10.99 -> ~9.0 on FineWeb-Edu).  Runs the 280M Mamba-2 with the
+# exact reference recipe (524,288 tokens/step via grad accum, warmup-715 cosine)
+# on synthetic zipf shards — data differs, so the comparable fingerprints are the
+# ln(50304) ~= 10.83 initial loss and a monotonic early fall as the model learns
+# the unigram marginals.  Writes the reference-format log to log_parity/.
+set -e
+cd "$(dirname "$0")/.."
+STEPS="${1:-30}"
+python train.py --preset mamba2-280m \
+  --micro-batch-size 8 \
+  --max-steps "$STEPS" \
+  --data-dir parity_data \
+  --log-dir log_parity
+tail -n +1 log_parity/log.txt | head -40

@@ -117,6 +117,29 @@ def test_log_line_format(tmp_path):
     assert parts[0] == "2" and "/" in parts[1] and len(parts[2].split(".")[1]) == 4
 
 
+def test_example_batching_is_equivalent(rng):
+    """Packing examples into one device call must not change any score:
+    batched vs one-at-a-time agree example-for-example."""
+    from mamba_distributed_tpu.config import ModelConfig
+    from mamba_distributed_tpu.models import init_lm_params, lm_forward
+
+    cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=128, headdim=8,
+                      chunk_size=16, d_state=16, compute_dtype="float32")
+    params = init_lm_params(rng, cfg)
+    fwd = lambda t: lm_forward(params, cfg, t)
+    exs = [
+        EXAMPLE,
+        dict(EXAMPLE, label=0),
+        {"ctx": "a dog ran", "label": 1,
+         "endings": ["far away", "home to the big red barn", "x", "y z"]},
+        dict(EXAMPLE, label=3),
+        {"ctx": "rain", "label": 0, "endings": ["fell", "rose", "sang", "sat"]},
+    ]
+    one = evaluate_hellaswag(fwd, exs, fake_encode, limit=5, example_batch=1)
+    batched = evaluate_hellaswag(fwd, exs, fake_encode, limit=5, example_batch=4)
+    assert one == batched
+
+
 def test_real_model_end_to_end(rng):
     from mamba_distributed_tpu.config import ModelConfig
     from mamba_distributed_tpu.models import init_lm_params, lm_forward
