@@ -88,21 +88,24 @@ def mamba1_mixer(
     ds = cfg.effective_d_state
     dtr = cfg.effective_dt_rank
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    if seq_ctx is not None:
-        raise NotImplementedError(
-            "sequence parallelism targets the SSD (mamba2) path; "
-            "BASELINE config 4 is mamba2 (see parallel/seq_parallel.py)"
-        )
 
     xz = linear(params["in_proj"], u, compute_dtype)
     x, z = xz[..., :di], xz[..., di:]
 
-    x, conv_state = causal_conv1d(
-        x, params["conv"]["kernel"], params["conv"].get("bias"),
-        activation="silu",
-        initial_state=initial_conv_state,
-        return_final_state=True,
-    )
+    if seq_ctx is not None:
+        from mamba_distributed_tpu.parallel.seq_parallel import sp_conv1d
+
+        x, conv_state = sp_conv1d(
+            seq_ctx, x, params["conv"]["kernel"],
+            params["conv"].get("bias"), "silu",
+        )
+    else:
+        x, conv_state = causal_conv1d(
+            x, params["conv"]["kernel"], params["conv"].get("bias"),
+            activation="silu",
+            initial_state=initial_conv_state,
+            return_final_state=True,
+        )
 
     x_db = linear(params["x_proj"], x, compute_dtype)
     dt = x_db[..., :dtr]
@@ -118,25 +121,32 @@ def mamba1_mixer(
     )
 
     A = -jnp.exp(params["A_log"])  # (di, ds)
-    if cfg.ssm_impl == "pallas":
-        from mamba_distributed_tpu.ops.pallas import selective_scan_pallas
-
-        scan_fn = selective_scan_pallas
-    else:
-        scan_fn = selective_scan
     scan_kw = dict(
         D=params["D"], z=z, delta_bias=params["dt_proj"]["bias"],
         delta_softplus=True,
     )
-    if initial_ssm_state is None and not return_final_state:
-        # training path: keeps the Pallas backend on its custom-vjp route
-        y = scan_fn(x, dt, A, B, C, **scan_kw)
-        ssm_state = None
+    if seq_ctx is not None:
+        # SP uses the shard_map scan (ssm_impl='pallas' is bypassed here,
+        # matching the mamba2 structure where sp_ssd owns the sharded path)
+        from mamba_distributed_tpu.parallel.seq_parallel import sp_selective_scan
+
+        y, ssm_state = sp_selective_scan(seq_ctx, x, dt, A, B, C, **scan_kw)
     else:
-        y, ssm_state = scan_fn(
-            x, dt, A, B, C, **scan_kw,
-            initial_state=initial_ssm_state, return_final_state=True,
-        )
+        if cfg.ssm_impl == "pallas":
+            from mamba_distributed_tpu.ops.pallas import selective_scan_pallas
+
+            scan_fn = selective_scan_pallas
+        else:
+            scan_fn = selective_scan
+        if initial_ssm_state is None and not return_final_state:
+            # training path: keeps the Pallas backend on its custom-vjp route
+            y = scan_fn(x, dt, A, B, C, **scan_kw)
+            ssm_state = None
+        else:
+            y, ssm_state = scan_fn(
+                x, dt, A, B, C, **scan_kw,
+                initial_state=initial_ssm_state, return_final_state=True,
+            )
     out = linear(params["out_proj"], y, compute_dtype)
     if return_final_state:
         return out, (conv_state, ssm_state)

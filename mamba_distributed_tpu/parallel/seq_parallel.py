@@ -127,32 +127,10 @@ def sp_ssd(
         y_diag, states, chunk_decay, off_ctx = chunk_local(
             x_l, dt_l, A_, B_l, C_l, l, compute_dtype
         )
-        # local pass to get this shard's summary
+        # local pass to get this shard's summary, then combine across ranks
         _, final_local = state_passing(states, chunk_decay)
         decay_total = jnp.prod(chunk_decay, axis=1)  # (b, h)
-
-        # gather (decay_total, final_local) from every seq rank
-        n = ctx.size
-        idx = jax.lax.axis_index(ctx.axis)
-        decays = jax.lax.all_gather(decay_total, ctx.axis)  # (S, b, h)
-        finals = jax.lax.all_gather(final_local, ctx.axis)  # (S, b, h, p, n)
-
-        # incoming state = sum over ranks j < idx of final_j * prod_{j<m<idx} decay_m
-        ranks = jnp.arange(n)
-        # suffix[j] = prod over m with j < m < idx of decays[m]
-        def suffix_prod(j):
-            mask = ((ranks > j) & (ranks < idx)).astype(decays.dtype)
-            return jnp.prod(
-                decays * mask[:, None, None] + (1.0 - mask)[:, None, None], axis=0
-            )
-
-        suffixes = jax.vmap(suffix_prod)(ranks)  # (S, b, h)
-        contrib_mask = (ranks < idx).astype(decays.dtype)  # (S,)
-        s_in = jnp.sum(
-            finals
-            * (suffixes * contrib_mask[:, None, None])[..., None, None],
-            axis=0,
-        )  # (b, h, p, n)
+        s_in = _incoming_state(ctx, decay_total, final_local)  # (b, h, p, n)
 
         # local pass seeded with the incoming state, then the shared
         # output assembly (ops/ssd.combine_chunk_outputs)
@@ -168,4 +146,113 @@ def sp_ssd(
         local, mesh=ctx.mesh, in_specs=in_specs, out_specs=bat4, check_vma=False
     )
     args = (x, dt, A, B, C) + ((D,) if has_D else ())
+    return fn(*args), None
+
+
+def _incoming_state(ctx: SeqContext, decay_total, final_local):
+    """Combine per-rank (decay, final-state) summaries into each rank's
+    incoming state: sum over ranks j < idx of final_j * prod_{j<m<idx} decay_m.
+
+    decay_total/final_local have matching shapes (decay broadcastable over
+    final); both are all-gathered over the seq axis (tiny: O(state), not
+    O(T)).  Shared by the SSD and selective-scan SP paths.
+    """
+    n = ctx.size
+    idx = jax.lax.axis_index(ctx.axis)
+    decays = jax.lax.all_gather(decay_total, ctx.axis)  # (S, ...)
+    finals = jax.lax.all_gather(final_local, ctx.axis)  # (S, ...)
+    ranks = jnp.arange(n)
+    extra = (1,) * (decays.ndim - 1)
+
+    def suffix_prod(j):
+        mask = ((ranks > j) & (ranks < idx)).astype(decays.dtype)
+        mask = mask.reshape(n, *extra)
+        return jnp.prod(decays * mask + (1.0 - mask), axis=0)
+
+    suffixes = jax.vmap(suffix_prod)(ranks)  # (S, ...)
+    contrib = (ranks < idx).astype(decays.dtype).reshape(n, *extra)
+    scale = suffixes * contrib
+    # broadcast decay-shaped scale up to the final-state shape
+    while scale.ndim < finals.ndim:
+        scale = scale[..., None]
+    return jnp.sum(finals * scale, axis=0)
+
+
+def sp_selective_scan(
+    ctx: SeqContext,
+    u: jax.Array,
+    dt: jax.Array,
+    A: jax.Array,
+    B: jax.Array,
+    C: jax.Array,
+    D: jax.Array | None = None,
+    z: jax.Array | None = None,
+    delta_bias: jax.Array | None = None,
+    delta_softplus: bool = False,
+):
+    """Sequence-sharded Mamba-1 selective scan.
+
+    Shapes as ops/scan.selective_scan: u/dt/z (b, t, d), A (d, n),
+    B/C (b, t, n), with t sharded over ``ctx.axis``.  Two local passes:
+    the first produces this shard's (elementwise decay, final state)
+    summary, the summaries are all-gathered (O(d*n) traffic, not O(T)),
+    and the second pass re-runs the local scan seeded with the combined
+    incoming state.  Exact: matches the full-sequence scan to fp32
+    tolerance (tests/test_seq_parallel.py).
+
+    The second pass deliberately re-runs the recurrence instead of
+    correcting pass 1's output with C_t . (exp(cumsum dt*A) * h_in) —
+    that correction needs the (b, t, d, n) cumulative-decay tensor the
+    chunked scan exists to avoid materializing, and the M1 recurrence is
+    a few percent of layer FLOPs (the projections dominate), so 2x scan
+    cost buys O(T/devices) memory with a negligible step-time impact.
+
+    Returns (y, None) — the final state stays on the last shard.
+    """
+    from mamba_distributed_tpu.ops.scan import _prep, selective_scan
+
+    bat3 = P(ctx.batch_axes, ctx.axis, None)
+    has_D, has_z, has_bias = D is not None, z is not None, delta_bias is not None
+
+    def local(u_l, dt_l, A_, B_l, C_l, *rest):
+        it = iter(rest)
+        D_ = next(it) if has_D else None
+        z_l = next(it) if has_z else None
+        bias_ = next(it) if has_bias else None
+
+        # pass 1: local summary (zero incoming state)
+        _, s_local = selective_scan(
+            u_l, dt_l, A_, B_l, C_l,
+            delta_bias=bias_, delta_softplus=delta_softplus,
+            return_final_state=True,
+        )
+        _, df, Af, _, _, _ = _prep(
+            u_l, dt_l, A_, B_l, C_l, None, bias_, delta_softplus
+        )
+        # elementwise decay over the local shard: exp(sum_t dt_t * A) (b, d, n)
+        decay_total = jnp.exp(jnp.einsum("btd,dn->bdn", df, Af))
+        h_in = _incoming_state(ctx, decay_total, s_local)
+
+        # pass 2: the real scan, seeded
+        return selective_scan(
+            u_l, dt_l, A_, B_l, C_l, D=D_, z=z_l,
+            delta_bias=bias_, delta_softplus=delta_softplus,
+            initial_state=h_in,
+        )
+
+    in_specs = [bat3, bat3, P(None, None), bat3, bat3]
+    args = [u, dt, A, B, C]
+    if has_D:
+        in_specs.append(P(None))
+        args.append(D)
+    if has_z:
+        in_specs.append(bat3)
+        args.append(z)
+    if has_bias:
+        in_specs.append(P(None))
+        args.append(delta_bias)
+    fn = jax.shard_map(
+        local, mesh=ctx.mesh, in_specs=tuple(in_specs), out_specs=bat3,
+        check_vma=False,
+    )
     return fn(*args), None
