@@ -108,6 +108,16 @@ def mamba2_mixer(
     di, ds, g, nh, _, conv_dim = _dims(cfg)
     b, t, _ = u.shape
     compute_dtype = jnp.dtype(cfg.compute_dtype)
+    if seq_ctx is not None and (
+        initial_conv_state is not None
+        or initial_ssm_state is not None
+        or return_final_state
+    ):
+        raise ValueError(
+            "sequence parallelism is a training/eval path: decode-state "
+            "carry (initial states / return_final_state) is not supported "
+            "under seq_ctx"
+        )
 
     zxbcdt = linear(params["in_proj"], u, compute_dtype)
     z, xBC, dt = _split_zxbcdt(zxbcdt, cfg)
