@@ -74,3 +74,21 @@ def init_conv(key: jax.Array, channels: int, width: int, bias: bool) -> dict:
     if bias:
         p["bias"] = uniform_fan_in(kb, (channels,), width)
     return p
+
+
+def check_no_decode_state_under_sp(
+    seq_ctx, initial_conv_state, initial_ssm_state, return_final_state: bool
+) -> None:
+    """Sequence parallelism is a training/eval path; decode-state carry
+    through a mixer is a single-device concern.  Raise loudly rather than
+    silently ignoring the carry (shared by the mamba1/mamba2 mixers)."""
+    if seq_ctx is not None and (
+        initial_conv_state is not None
+        or initial_ssm_state is not None
+        or return_final_state
+    ):
+        raise ValueError(
+            "sequence parallelism is a training/eval path: decode-state "
+            "carry (initial states / return_final_state) is not supported "
+            "under seq_ctx"
+        )

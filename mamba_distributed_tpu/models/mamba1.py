@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.common import (
+    check_no_decode_state_under_sp,
     init_conv,
     init_dt_bias,
     init_linear,
@@ -88,16 +89,9 @@ def mamba1_mixer(
     ds = cfg.effective_d_state
     dtr = cfg.effective_dt_rank
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    if seq_ctx is not None and (
-        initial_conv_state is not None
-        or initial_ssm_state is not None
-        or return_final_state
-    ):
-        raise ValueError(
-            "sequence parallelism is a training/eval path: decode-state "
-            "carry (initial states / return_final_state) is not supported "
-            "under seq_ctx"
-        )
+    check_no_decode_state_under_sp(
+        seq_ctx, initial_conv_state, initial_ssm_state, return_final_state
+    )
 
     xz = linear(params["in_proj"], u, compute_dtype)
     x, z = xz[..., :di], xz[..., di:]

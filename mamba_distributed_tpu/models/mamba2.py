@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.common import (
+    check_no_decode_state_under_sp,
     init_conv,
     init_dt_bias,
     init_linear,
@@ -97,27 +98,21 @@ def mamba2_mixer(
 
     Args:
       u: (b, t, d_model) in compute dtype.
-      initial_conv_state: (b, d_conv-1, conv_dim) carry for prefill/SP halo.
-      initial_ssm_state: (b, nheads, headdim, d_state) carry.
+      initial_conv_state: (b, d_conv-1, conv_dim) decode/prefill carry
+        (single-device only — mutually exclusive with ``seq_ctx``).
+      initial_ssm_state: (b, nheads, headdim, d_state) carry (same).
       seq_ctx: optional ``parallel.seq_parallel.SeqContext`` — when given,
         the conv halo and SSD chunk-state passing run across the mesh's
-        ``seq`` axis instead of locally.
+        ``seq`` axis instead of locally; decode-state carry is rejected.
 
     Returns: y (b, t, d_model) [, (conv_state, ssm_state)].
     """
     di, ds, g, nh, _, conv_dim = _dims(cfg)
     b, t, _ = u.shape
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    if seq_ctx is not None and (
-        initial_conv_state is not None
-        or initial_ssm_state is not None
-        or return_final_state
-    ):
-        raise ValueError(
-            "sequence parallelism is a training/eval path: decode-state "
-            "carry (initial states / return_final_state) is not supported "
-            "under seq_ctx"
-        )
+    check_no_decode_state_under_sp(
+        seq_ctx, initial_conv_state, initial_ssm_state, return_final_state
+    )
 
     zxbcdt = linear(params["in_proj"], u, compute_dtype)
     z, xBC, dt = _split_zxbcdt(zxbcdt, cfg)

@@ -231,6 +231,25 @@ def test_full_model_loss_seq_sharded_matches(ctx, rng):
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
 
 
+def test_full_model_hybrid_seq_sharded_matches(ctx, rng):
+    """Config-5 shape: SSM blocks + interleaved attention (ring under SP)
+    reproduces the single-device loss."""
+    cfg = ModelConfig(
+        d_model=32, n_layer=4, vocab_size=64, ssm_layer="mamba2", headdim=8,
+        chunk_size=16, d_state=16, compute_dtype="float32",
+        attn_layer_idx=(1, 3), attn_num_heads=4, attn_num_kv_heads=2,
+        d_intermediate=48,
+    )
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0, 64)
+    y = jax.random.randint(jax.random.PRNGKey(2), (4, 64), 0, 64)
+    ref = jax.jit(lm_loss, static_argnums=1)(params, cfg, x, y)
+    got = jax.jit(
+        lambda p, a, b: lm_loss(p, cfg, a, b, seq_ctx=ctx)
+    )(params, x, y)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
 def test_trainer_seq_parallel_matches_single_device(tmp_path):
     """Config-4 style run (data x seq mesh) reproduces the single-device
     loss trajectory."""
