@@ -17,11 +17,17 @@ Beyond the reference it adds:
     the x/y pair is assembled in one C++ pass.  ``backend="auto"`` uses
     it when the toolchain built it; numpy otherwise.  Both backends are
     tested to produce identical batches.
+  * single-batch prefetch — a worker thread assembles the next batch
+    while the caller trains on the current one.  The batch *sequence* is
+    a pure function of the cursor (shard index, position), so prefetching
+    changes nothing observable: ``state()`` still reports the next
+    unconsumed cursor and resume is bit-identical (tests pin this).
 """
 
 from __future__ import annotations
 
 import os
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -43,6 +49,7 @@ class ShardedTokenLoader:
         num_processes: int = 1,
         master_process: bool = True,
         backend: str = "auto",
+        prefetch: bool = True,
     ):
         assert split in {"train", "val"}
         assert backend in {"auto", "native", "numpy"}
@@ -70,16 +77,26 @@ class ShardedTokenLoader:
         if master_process:
             backend_name = "native" if self._native else "numpy"
             print(f"found {len(shards)} shards for split {split} ({backend_name})")
+
+        self._open_idx: int | None = None
+        self._shard = None
+        self._pool = ThreadPoolExecutor(max_workers=1) if prefetch else None
+        self._pending = None  # (cursor, Future) for the batch at that cursor
         self.reset()
+        # open shard 0 eagerly: backend="native" fails loudly at construction
+        # on unsupported shards, and "auto" settles its backend up front
+        self._open_shard(0)
 
     # --- shard backends ---
 
     def _open_shard(self, idx: int) -> None:
+        if idx == self._open_idx:
+            return
         path = self.shards[idx]
         if self._native:
             from mamba_distributed_tpu.data.native import NativeShard
 
-            if getattr(self, "_shard", None) is not None:
+            if self._shard is not None:
                 self._shard.close()
             try:
                 self._shard = NativeShard(path)
@@ -92,10 +109,12 @@ class ShardedTokenLoader:
                 self._native = False
             else:
                 self._shard_len = len(self._shard)
+                self._open_idx = idx
                 return
         self._shard = None
         self.tokens = load_tokens(path)
         self._shard_len = len(self.tokens)
+        self._open_idx = idx
 
     def _slice(self, pos: int):
         B, T = self.B, self.T
@@ -104,34 +123,76 @@ class ShardedTokenLoader:
         buf = self.tokens[pos : pos + B * T + 1]
         return buf[:-1].reshape(B, T), buf[1:].reshape(B, T)
 
+    def _compute(self, cursor):
+        """Pure step: cursor (shard, pos) -> ((x, y), next_cursor).
+
+        Only ever runs on the worker thread (or inline when prefetch is
+        off / missed), never concurrently with itself — max_workers=1 and
+        the consume-then-resubmit protocol guarantee that.
+        """
+        shard_idx, pos = cursor
+        B, T = self.B, self.T
+        self._open_shard(shard_idx)
+        x, y = self._slice(pos)
+        next_pos = pos + B * T * self.num_processes
+        # advance when the *next* strided window would overrun the shard
+        # (same guard as reference dataloader.py:46-51 — tails are dropped)
+        if next_pos + (B * T * self.num_processes + 1) > self._shard_len:
+            shard_idx = (shard_idx + 1) % len(self.shards)
+            next_pos = B * T * self.process_rank
+        return (x, y), (shard_idx, next_pos)
+
     # --- reference API ---
 
     def reset(self) -> None:
-        self.current_shard = 0
-        self._open_shard(0)
-        self.current_position = self.B * self.T * self.process_rank
+        self._cancel_pending()
+        self._cursor = (0, self.B * self.T * self.process_rank)
 
     def next_batch(self) -> tuple[np.ndarray, np.ndarray]:
-        B, T = self.B, self.T
-        x, y = self._slice(self.current_position)
-        self.current_position += B * T * self.num_processes
-        # advance when the *next* strided window would overrun the shard
-        # (same guard as reference dataloader.py:46-51 — tails are dropped)
-        if self.current_position + (B * T * self.num_processes + 1) > self._shard_len:
-            self.current_shard = (self.current_shard + 1) % len(self.shards)
-            self._open_shard(self.current_shard)
-            self.current_position = B * T * self.process_rank
+        if self._pending is not None and self._pending[0] == self._cursor:
+            fut = self._pending[1]
+            # clear BEFORE result(): if the worker raised (e.g. transient
+            # I/O), the exception propagates once and the next call retries
+            # inline instead of re-raising the cached failure forever
+            self._pending = None
+            (x, y), self._cursor = fut.result()
+        else:
+            self._cancel_pending()
+            (x, y), self._cursor = self._compute(self._cursor)
+        if self._pool is not None:
+            cur = self._cursor
+            self._pending = (cur, self._pool.submit(self._compute, cur))
         return x, y
+
+    def _cancel_pending(self) -> None:
+        if getattr(self, "_pending", None) is not None:
+            # the worker may be mid-_compute; wait it out so shard state
+            # is quiescent before we move the cursor under it
+            try:
+                self._pending[1].result()
+            except Exception:
+                pass
+            self._pending = None
 
     # --- exact-resume support (absent from the reference) ---
 
+    @property
+    def current_shard(self) -> int:
+        return self._cursor[0]
+
+    @property
+    def current_position(self) -> int:
+        return self._cursor[1]
+
     def state(self) -> dict:
         return {
-            "current_shard": self.current_shard,
-            "current_position": self.current_position,
+            "current_shard": self._cursor[0],
+            "current_position": self._cursor[1],
         }
 
     def restore(self, state: dict) -> None:
-        self.current_shard = int(state["current_shard"]) % len(self.shards)
-        self._open_shard(self.current_shard)
-        self.current_position = int(state["current_position"])
+        self._cancel_pending()
+        self._cursor = (
+            int(state["current_shard"]) % len(self.shards),
+            int(state["current_position"]),
+        )

@@ -122,3 +122,33 @@ def test_synthetic_generation(tmp_path):
     assert (a == b).all()
     # idempotent: calling again doesn't rewrite
     assert ensure_synthetic_shards(d) == d
+
+
+def test_prefetch_is_transparent(tmp_path):
+    """Prefetching must not change the batch sequence, the reported
+    state, or restore determinism (the cursor model is pure)."""
+    d = ensure_synthetic_shards(
+        str(tmp_path / "syn"), vocab_size=500, tokens_per_shard=4096,
+        num_shards=3,
+    )
+    kw = dict(B=2, T=16, data_dir=d, split="train", master_process=False)
+    pre = ShardedTokenLoader(prefetch=True, **kw)
+    syn = ShardedTokenLoader(prefetch=False, **kw)
+    for i in range(300):  # crosses shard boundaries repeatedly
+        xa, ya = pre.next_batch()
+        xb, yb = syn.next_batch()
+        assert (xa == xb).all() and (ya == yb).all(), i
+        assert pre.state() == syn.state(), i
+    # restore while a prefetched batch is in flight
+    st = pre.state()
+    first = [pre.next_batch()[0].copy() for _ in range(5)]
+    pre.restore(st)
+    again = [pre.next_batch()[0].copy() for _ in range(5)]
+    for a, b in zip(first, again):
+        assert (a == b).all()
+    # reset with a pending prefetch rewinds to the start
+    pre.reset()
+    syn.reset()
+    xa, _ = pre.next_batch()
+    xb, _ = syn.next_batch()
+    assert (xa == xb).all()
