@@ -46,12 +46,12 @@ def _progress(msg: str) -> None:
     print(f"[bench +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def time_config(spec: dict, iters: int = 10) -> dict:
-    """Time the jitted train step for one configuration on the local chip.
+def build_step(spec: dict):
+    """Build the single-chip jitted train step for one configuration.
 
-    spec keys (all optional): preset, B, T, ssm_impl, remat, remat_policy.
-    Returns {**spec, tok_per_sec, mfu, step_ms} or {**spec, error} on
-    failure (e.g. OOM at large batch) so sweeps can continue.
+    Shared by time_config and scripts/profile_step.py so the measured and
+    profiled setup can never diverge.  Returns (cfg, step, params,
+    opt_state, x, y) with x/y carrying the (1, B, T) accum axis.
     """
     import jax
     import jax.numpy as jnp
@@ -65,7 +65,6 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     )
     from mamba_distributed_tpu.training.optimizer import make_optimizer
     from mamba_distributed_tpu.training.train_step import make_train_step
-    from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
     B = spec.get("B", DEFAULT_B)
     T = spec.get("T", DEFAULT_T)
@@ -101,8 +100,23 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     y = jax.device_put(
         jax.random.randint(ky, (1, B, T), 0, cfg.model.vocab_size, jnp.int32)
     )
+    return cfg, step, params, opt_state, x, y
+
+
+def time_config(spec: dict, iters: int = 10) -> dict:
+    """Time the jitted train step for one configuration on the local chip.
+
+    spec keys (all optional): preset, B, T, ssm_impl, remat, remat_policy.
+    Returns {**spec, tok_per_sec, mfu, step_ms} or {**spec, error} on
+    failure (e.g. OOM at large batch) so sweeps can continue.
+    """
+    from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
+
+    B = spec.get("B", DEFAULT_B)
+    T = spec.get("T", DEFAULT_T)
 
     try:
+        cfg, step, params, opt_state, x, y = build_step(spec)
         # warmup (compile + 2 steps); float() forces a host transfer because
         # block_until_ready is a no-op on some experimental platforms
         for i in range(3):

@@ -166,13 +166,33 @@ class ShardedTokenLoader:
 
     def _cancel_pending(self) -> None:
         if getattr(self, "_pending", None) is not None:
-            # the worker may be mid-_compute; wait it out so shard state
-            # is quiescent before we move the cursor under it
-            try:
-                self._pending[1].result()
-            except Exception:
-                pass
+            fut = self._pending[1]
+            # usually the prefetch hasn't started yet — cancel() skips the
+            # wasted shard read; if it IS mid-_compute, wait it out so shard
+            # state is quiescent before we move the cursor under it
+            if not fut.cancel():
+                try:
+                    fut.result()
+                except Exception:
+                    pass
             self._pending = None
+
+    def close(self) -> None:
+        """Stop the prefetch worker (joins any in-flight compute)."""
+        self._cancel_pending()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool = None
+        if self._shard is not None:
+            self._shard.close()
+            self._shard = None
+        self._open_idx = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # --- exact-resume support (absent from the reference) ---
 
