@@ -46,6 +46,24 @@ def _progress(msg: str) -> None:
     print(f"[bench +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def init_backend():
+    """Force BENCH_PLATFORM if set, then initialize and report the backend.
+
+    The env var JAX_PLATFORMS alone is not enough on axon-site machines
+    (the site plugin overrides it programmatically), so the config is set
+    too.  Shared by bench.py and scripts/profile_step.py so the measured
+    and profiled backends can never diverge.
+    """
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    _progress(f"jax {jax.__version__} imported; initializing backend...")
+    dev = jax.devices()[0]
+    _progress(f"backend up: {len(jax.devices())}x {dev.device_kind or dev.platform}")
+    return dev
+
+
 def build_step(spec: dict):
     """Build the single-chip jitted train step for one configuration.
 
@@ -112,11 +130,9 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     """
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
-    B = spec.get("B", DEFAULT_B)
-    T = spec.get("T", DEFAULT_T)
-
     try:
         cfg, step, params, opt_state, x, y = build_step(spec)
+        B, T = cfg.micro_batch_size, cfg.seq_len
         # warmup (compile + 2 steps); float() forces a host transfer because
         # block_until_ready is a no-op on some experimental platforms
         for i in range(3):
@@ -166,18 +182,7 @@ def _env_spec() -> dict:
 
 
 def main() -> None:
-    import jax
-
-    # BENCH_PLATFORM=cpu forces the CPU backend for harness testing.  The
-    # env var JAX_PLATFORMS alone is not enough on axon-site machines (the
-    # site plugin overrides it programmatically), so set the config too.
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-
-    _progress(f"jax {jax.__version__} imported; initializing backend...")
-    dev = jax.devices()[0]
-    _progress(f"backend up: {len(jax.devices())}x {dev.device_kind or dev.platform}")
-
+    dev = init_backend()
     spec = _env_spec()
     iters = int(os.environ.get("BENCH_ITERS", "10"))
     r = time_config(spec, iters=iters)

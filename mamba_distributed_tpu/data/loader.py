@@ -186,6 +186,7 @@ class ShardedTokenLoader:
         if self._shard is not None:
             self._shard.close()
             self._shard = None
+        self.tokens = None  # numpy backend holds the whole shard in RAM
         self._open_idx = None
 
     def __del__(self):

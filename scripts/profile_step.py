@@ -19,20 +19,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _env_spec, _progress, build_step  # noqa: E402
+from bench import _env_spec, _progress, build_step, init_backend  # noqa: E402
 
 
 def main() -> None:
-    import jax
-
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    init_backend()
 
     from mamba_distributed_tpu.utils.profiling import trace
-
-    _progress("initializing backend...")
-    dev = jax.devices()[0]
-    _progress(f"backend up: {dev.device_kind or dev.platform}")
 
     _, step, params, opt_state, x, y = build_step(_env_spec())
 

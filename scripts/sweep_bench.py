@@ -17,7 +17,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _progress, time_config  # noqa: E402
+from bench import init_backend, time_config  # noqa: E402
 
 DEFAULT_CONFIGS = [
     {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
@@ -34,11 +34,7 @@ DEFAULT_CONFIGS = [
 
 
 def main() -> None:
-    import jax
-
-    _progress("initializing backend...")
-    dev = jax.devices()[0]
-    _progress(f"backend up: {dev.device_kind or dev.platform}")
+    init_backend()
 
     configs = (
         json.loads(os.environ["SWEEP_CONFIGS"])

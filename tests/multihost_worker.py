@@ -1,0 +1,65 @@
+"""Worker process for the multi-host simulation test.
+
+Spawned by tests/test_multihost.py: each worker is one "TPU-VM host" —
+it joins the jax.distributed rendezvous, owns a rank-strided slice of the
+data stream, contributes its local batch rows via
+``make_array_from_process_local_data``, and runs the same jitted DP train
+step.  Usage: python multihost_worker.py <pid> <nprocs> <port> <data_dir>
+<out_file>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+data_dir, out_file = sys.argv[4], sys.argv[5]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
+)
+assert jax.process_count() == nprocs
+assert jax.local_device_count() == 2
+
+from mamba_distributed_tpu.config import (  # noqa: E402
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from mamba_distributed_tpu.training import Trainer  # noqa: E402
+
+model = ModelConfig(
+    d_model=32, n_layer=2, vocab_size=128, ssm_layer="mamba2", headdim=8,
+    chunk_size=16, d_state=16, compute_dtype="float32",
+)
+cfg = TrainConfig(
+    model=model,
+    mesh=MeshConfig(data=nprocs * 2),
+    data=DataConfig(data_dir=data_dir, allow_synthetic=False),
+    micro_batch_size=4,
+    seq_len=32,
+    total_batch_size=4 * 32 * nprocs * 2 * 2,  # accum 2
+    log_dir=os.path.join(os.path.dirname(out_file), f"log{pid}"),
+    warmup_steps=2,
+    max_steps=100,
+    val_every=1000,
+)
+t = Trainer(cfg, verbose=False)
+losses = []
+for _ in range(3):
+    x, y = t._global_batch(cfg.grad_accum_steps, t.train_loader)
+    t.params, t.opt_state, loss, _ = t.train_step(t.params, t.opt_state, x, y)
+    losses.append(float(loss))
+
+with open(out_file, "w") as f:
+    f.write(" ".join(f"{l:.8f}" for l in losses))
+print(f"proc {pid}: {losses}", flush=True)
