@@ -35,21 +35,30 @@ def test_two_host_training_agrees(tmp_path):
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
 
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), data_dir, outs[i]],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
+    # worker output goes to files, not pipes: on a hang/crash the other
+    # side's traceback survives the kill (and nobody can stall on a full
+    # pipe buffer)
+    log_files = [str(tmp_path / f"worker{i}.log") for i in range(2)]
+    procs = []
+    for i in range(2):
+        with open(log_files[i], "w") as lf:
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, worker, str(i), "2", str(port),
+                     data_dir, outs[i]],
+                    env=env, stdout=lf, stderr=subprocess.STDOUT,
+                )
+            )
     try:
-        logs = [p.communicate(timeout=540)[0].decode() for p in procs]
+        for p in procs:
+            p.wait(timeout=540)
     finally:
         # one worker dying leaves the other blocked in the rendezvous —
         # never leak it past the test
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    logs = [open(f).read() for f in log_files]
     for p, log in zip(procs, logs):
         assert p.returncode == 0, log[-2000:]
 
