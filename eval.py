@@ -70,6 +70,8 @@ def main():
                    help="local HF directory (config.json + pytorch_model.bin)")
     p.add_argument("--data-file", default="hellaswag/hellaswag_val.jsonl")
     p.add_argument("--limit", type=int, default=2000)
+    p.add_argument("--example-batch", type=int, default=8,
+                   help="examples packed per device call (scores unchanged)")
     p.add_argument("--log-file", default="log/hellaswag_eval.txt")
     args = p.parse_args()
 
@@ -89,6 +91,7 @@ def main():
         limit=args.limit,
         log_path=args.log_file,
         verbose=True,
+        example_batch=args.example_batch,
     )
     print(result)
 

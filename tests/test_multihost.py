@@ -49,16 +49,23 @@ def test_two_host_training_agrees(tmp_path):
                     env=env, stdout=lf, stderr=subprocess.STDOUT,
                 )
             )
+    timed_out = False
     try:
         for p in procs:
             p.wait(timeout=540)
+    except subprocess.TimeoutExpired:
+        timed_out = True
     finally:
         # one worker dying leaves the other blocked in the rendezvous —
         # never leak it past the test
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                p.wait()
     logs = [open(f).read() for f in log_files]
+    assert not timed_out, "worker hang; logs:\n" + "\n---\n".join(
+        log[-2000:] for log in logs
+    )
     for p, log in zip(procs, logs):
         assert p.returncode == 0, log[-2000:]
 

@@ -38,6 +38,9 @@ def parse_args():
     p.add_argument("--mesh-fsdp", type=int, default=None)
     p.add_argument("--mesh-seq", type=int, default=None)
     p.add_argument("--mesh-tensor", type=int, default=None)
+    p.add_argument("--ssm-impl", choices=["xla", "pallas"], default=None,
+                   help="kernel backend for the SSM scan")
+    p.add_argument("--remat-policy", choices=["all", "dots"], default=None)
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first (TPU pods)")
     return p.parse_args()
@@ -64,6 +67,13 @@ def build_config(args):
     }
     if mesh_over:
         overrides["mesh"] = dataclasses.replace(cfg.mesh, **mesh_over)
+    model_over = {
+        k: v for k, v in [
+            ("ssm_impl", args.ssm_impl), ("remat_policy", args.remat_policy),
+        ] if v is not None
+    }
+    if model_over:
+        overrides["model"] = dataclasses.replace(cfg.model, **model_over)
     if args.data_dir is not None:
         overrides["data"] = dataclasses.replace(cfg.data, data_dir=args.data_dir)
     if args.log_dir is not None:
