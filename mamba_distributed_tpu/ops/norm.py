@@ -4,8 +4,10 @@ Equivalent of the reference dependency's fused Triton layernorm kernels
 (``mamba_ssm/ops/triton/layernorm.py`` and ``layernorm_gated.py``, used via
 ``fused_add_norm=True`` — the MambaConfig default the reference runs with).
 On TPU we express the math in plain JAX and let XLA fuse the residual add,
-the normalization, and the neighbouring matmul prologue; measurements on the
-280M block showed no win from a hand-written Pallas kernel for this op.
+the normalization, and the neighbouring matmul prologue — elementwise
+chains like these are exactly what the XLA fusion pass exists for, so a
+hand-written Pallas kernel is deliberately not used unless a profile
+(scripts/profile_step.py) ever shows the fusion breaking.
 
 Matches the reference semantics: the residual stream is carried in fp32
 (``residual_in_fp32=True``), normalization statistics are computed in fp32,

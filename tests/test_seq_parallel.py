@@ -250,6 +250,23 @@ def test_full_model_hybrid_seq_sharded_matches(ctx, rng):
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
 
 
+def test_long_context_seq_sharded_matches(ctx, rng):
+    """Config-4 regime: T=8192 sharded 4-way; chunked SSD + halo exchange
+    reproduce the full-sequence loss (memory stays O(T/devices) on chip)."""
+    cfg = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2", headdim=8,
+        chunk_size=64, d_state=16, compute_dtype="float32",
+    )
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 8192), 0, 64)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 8192), 0, 64)
+    ref = jax.jit(lm_loss, static_argnums=1)(params, cfg, x, y)
+    got = jax.jit(
+        lambda p, a, b: lm_loss(p, cfg, a, b, seq_ctx=ctx)
+    )(params, x, y)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
 def test_trainer_seq_parallel_matches_single_device(tmp_path):
     """Config-4 style run (data x seq mesh) reproduces the single-device
     loss trajectory."""
