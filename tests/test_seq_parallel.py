@@ -152,20 +152,25 @@ def test_sp_selective_scan_grads_match(ctx, rng):
                                    atol=2e-3, rtol=2e-3)
 
 
-def test_full_model_mamba1_seq_sharded_matches(ctx, rng):
-    """End-to-end: the mamba1 LM under sequence parallelism == single-device."""
-    cfg = ModelConfig(
-        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba1",
-        d_state=8, compute_dtype="float32",
-    )
+def _assert_sp_loss_matches(ctx, cfg, b=4, t=64):
+    """Shared scaffold: lm_loss seq-sharded over ctx == single-device."""
     params = init_lm_params(jax.random.PRNGKey(0), cfg)
-    x = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0, 64)
-    y = jax.random.randint(jax.random.PRNGKey(2), (4, 64), 0, 64)
+    V = cfg.vocab_size
+    x = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, V)
+    y = jax.random.randint(jax.random.PRNGKey(2), (b, t), 0, V)
     ref = jax.jit(lm_loss, static_argnums=1)(params, cfg, x, y)
     got = jax.jit(
-        lambda p, a, b: lm_loss(p, cfg, a, b, seq_ctx=ctx)
+        lambda p, a, b_: lm_loss(p, cfg, a, b_, seq_ctx=ctx)
     )(params, x, y)
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
+def test_full_model_mamba1_seq_sharded_matches(ctx):
+    """End-to-end: the mamba1 LM under sequence parallelism == single-device."""
+    _assert_sp_loss_matches(ctx, ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba1",
+        d_state=8, compute_dtype="float32",
+    ))
 
 
 def test_ring_attention_matches_sdpa(ctx, rng):
@@ -215,56 +220,32 @@ def test_sp_conv1d_width1(ctx, rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
-def test_full_model_loss_seq_sharded_matches(ctx, rng):
+def test_full_model_loss_seq_sharded_matches(ctx):
     """End-to-end: lm_loss under sequence parallelism == single-device."""
-    cfg = ModelConfig(
+    _assert_sp_loss_matches(ctx, ModelConfig(
         d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2", headdim=8,
         chunk_size=16, d_state=16, compute_dtype="float32",
-    )
-    params = init_lm_params(jax.random.PRNGKey(0), cfg)
-    x = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0, 64)
-    y = jax.random.randint(jax.random.PRNGKey(2), (4, 64), 0, 64)
-    ref = jax.jit(lm_loss, static_argnums=1)(params, cfg, x, y)
-    got = jax.jit(
-        lambda p, a, b: lm_loss(p, cfg, a, b, seq_ctx=ctx)
-    )(params, x, y)
-    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    ))
 
 
-def test_full_model_hybrid_seq_sharded_matches(ctx, rng):
+def test_full_model_hybrid_seq_sharded_matches(ctx):
     """Config-5 shape: SSM blocks + interleaved attention (ring under SP)
     reproduces the single-device loss."""
-    cfg = ModelConfig(
+    _assert_sp_loss_matches(ctx, ModelConfig(
         d_model=32, n_layer=4, vocab_size=64, ssm_layer="mamba2", headdim=8,
         chunk_size=16, d_state=16, compute_dtype="float32",
         attn_layer_idx=(1, 3), attn_num_heads=4, attn_num_kv_heads=2,
         d_intermediate=48,
-    )
-    params = init_lm_params(jax.random.PRNGKey(0), cfg)
-    x = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0, 64)
-    y = jax.random.randint(jax.random.PRNGKey(2), (4, 64), 0, 64)
-    ref = jax.jit(lm_loss, static_argnums=1)(params, cfg, x, y)
-    got = jax.jit(
-        lambda p, a, b: lm_loss(p, cfg, a, b, seq_ctx=ctx)
-    )(params, x, y)
-    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    ))
 
 
-def test_long_context_seq_sharded_matches(ctx, rng):
+def test_long_context_seq_sharded_matches(ctx):
     """Config-4 regime: T=8192 sharded 4-way; chunked SSD + halo exchange
     reproduce the full-sequence loss (memory stays O(T/devices) on chip)."""
-    cfg = ModelConfig(
+    _assert_sp_loss_matches(ctx, ModelConfig(
         d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2", headdim=8,
         chunk_size=64, d_state=16, compute_dtype="float32",
-    )
-    params = init_lm_params(jax.random.PRNGKey(0), cfg)
-    x = jax.random.randint(jax.random.PRNGKey(1), (2, 8192), 0, 64)
-    y = jax.random.randint(jax.random.PRNGKey(2), (2, 8192), 0, 64)
-    ref = jax.jit(lm_loss, static_argnums=1)(params, cfg, x, y)
-    got = jax.jit(
-        lambda p, a, b: lm_loss(p, cfg, a, b, seq_ctx=ctx)
-    )(params, x, y)
-    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+    ), b=2, t=8192)
 
 
 def test_trainer_seq_parallel_matches_single_device(tmp_path):
