@@ -39,7 +39,7 @@ from mamba_distributed_tpu.models.mamba2 import (
     mamba2_mixer,
     mamba2_mixer_step,
 )
-from mamba_distributed_tpu.ops.norm import add_rms_norm, rms_norm
+from mamba_distributed_tpu.ops.norm import add_rms_norm
 
 
 def _init_mixer(key: jax.Array, cfg: ModelConfig) -> dict:

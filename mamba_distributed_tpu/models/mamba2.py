@@ -25,7 +25,6 @@ from mamba_distributed_tpu.models.common import (
     init_dt_bias,
     init_linear,
     linear,
-    uniform_fan_in,
 )
 from mamba_distributed_tpu.ops.conv import causal_conv1d, causal_conv1d_update
 from mamba_distributed_tpu.ops.norm import rms_norm_gated

@@ -26,7 +26,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from mamba_distributed_tpu.ops.scan import _divisor_chunk
 from mamba_distributed_tpu.ops.ssd import state_passing

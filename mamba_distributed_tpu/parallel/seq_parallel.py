@@ -24,7 +24,6 @@ tolerance (pinned by tests/test_seq_parallel.py).
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp

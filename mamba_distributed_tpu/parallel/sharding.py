@@ -29,7 +29,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mamba_distributed_tpu.config import ModelConfig
 
 
 # (path-suffix pattern, axis-from-end carrying the d_inner/head dimension)

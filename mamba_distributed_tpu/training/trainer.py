@@ -14,7 +14,6 @@ assembles the global batch; single-host this degenerates to a device_put.
 
 from __future__ import annotations
 
-import os
 import time
 
 import jax
