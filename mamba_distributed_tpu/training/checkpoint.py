@@ -24,18 +24,43 @@ def _manager(directory: str) -> ocp.CheckpointManager:
     )
 
 
+class Checkpointer:
+    """Async checkpointing: save() returns as soon as the on-device state
+    is snapshotted; the write proceeds in Orbax's background thread while
+    training continues.  ``wait()`` (or close()) joins the last write —
+    the trainer calls it before the process exits so no checkpoint is
+    ever truncated.  The function-level save_checkpoint below stays fully
+    synchronous for one-shot use.
+    """
+
+    def __init__(self, directory: str):
+        self._mngr = _manager(directory)
+
+    def save(self, step, params, opt_state, loader_state, rng) -> None:
+        state = {
+            "params": params,
+            "opt_state": opt_state,
+            "loader": {k: np.asarray(v) for k, v in loader_state.items()},
+            "rng": rng,
+            "step": np.asarray(step),
+        }
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
 def save_checkpoint(directory, step, params, opt_state, loader_state, rng) -> None:
-    mngr = _manager(directory)
-    state = {
-        "params": params,
-        "opt_state": opt_state,
-        "loader": {k: np.asarray(v) for k, v in loader_state.items()},
-        "rng": rng,
-        "step": np.asarray(step),
-    }
-    mngr.save(step, args=ocp.args.StandardSave(state))
-    mngr.wait_until_finished()
-    mngr.close()
+    """One-shot synchronous save (delegates to Checkpointer)."""
+    ckpt = Checkpointer(directory)
+    try:
+        ckpt.save(step, params, opt_state, loader_state, rng)
+    finally:
+        ckpt.close()
 
 
 def restore_params_only(directory: str, step: int | None = None):

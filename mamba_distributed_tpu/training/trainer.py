@@ -115,6 +115,8 @@ class Trainer:
 
         self.logger = MetricsLogger(cfg.log_dir, self.verbose)
         self.step = 0
+        self._ckpt = None  # async Checkpointer, created on first save
+        self._ckpt_dir = None
         # in-training sampling (reference train.py:166-199): every
         # sample_every steps generate 4 continuations of the prompt.
         # Token ids are injected (no tokenizer download in zero-egress
@@ -160,6 +162,18 @@ class Trainer:
         tokens_per_step = cfg.total_batch_size
         last = min(max_steps if max_steps is not None else cfg.max_steps, cfg.max_steps)
 
+        try:
+            self._run_loop(last, accum, tokens_per_step, checkpoint_dir)
+        finally:
+            # join any in-flight async checkpoint write even when the loop
+            # raises (a checkpoint must never outlive the process
+            # half-written after save() reported success)
+            if self._ckpt is not None:
+                self._ckpt.wait()
+        return self
+
+    def _run_loop(self, last, accum, tokens_per_step, checkpoint_dir):
+        cfg = self.cfg
         while self.step < last:
             step = self.step
             if step % cfg.val_every == 0 or step == last - 1:
@@ -188,7 +202,6 @@ class Trainer:
                 dt, tok_per_sec, mfu,
             )
             self.step += 1
-        return self
 
     def sample(self, num_return: int = 4, max_new_tokens: int = 32,
                top_k: int = 50):
@@ -215,18 +228,33 @@ class Trainer:
                 print(f"sample: {text}")
         return out
 
-    # --- checkpointing (training/checkpoint.py; full-state, exact resume) ---
+    # --- checkpointing (training/checkpoint.py; full-state, exact resume;
+    # async: the write overlaps the next training steps) ---
 
     def save_checkpoint(self, directory: str) -> None:
-        from mamba_distributed_tpu.training.checkpoint import save_checkpoint
+        from mamba_distributed_tpu.training.checkpoint import Checkpointer
 
-        save_checkpoint(
-            directory, self.step, self.params, self.opt_state,
+        if self._ckpt is None or self._ckpt_dir != directory:
+            if self._ckpt is not None:
+                self._ckpt.close()
+            self._ckpt = Checkpointer(directory)
+            self._ckpt_dir = directory
+        self._ckpt.save(
+            self.step, self.params, self.opt_state,
             self.train_loader.state(), self.rng,
         )
 
+    def finish(self) -> None:
+        """Join any in-flight async checkpoint write (call before exit)."""
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
+
     def restore_checkpoint(self, directory: str, step: int | None = None) -> None:
         from mamba_distributed_tpu.training.checkpoint import restore_checkpoint
+
+        if self._ckpt is not None:
+            self._ckpt.wait()  # never restore past an uncommitted write
 
         self.step, self.params, self.opt_state, loader_state, self.rng = (
             restore_checkpoint(directory, self.params, self.opt_state, step)

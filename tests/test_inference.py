@@ -168,6 +168,7 @@ def test_eval_cli_restores_own_checkpoints(tmp_path):
     t.run(max_steps=1)
     ckpt = str(tmp_path / "ckpt")
     t.save_checkpoint(ckpt)
+    t.finish()  # join the async write before an external-style read
     params = restore_params_only(ckpt)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(t.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

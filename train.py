@@ -98,7 +98,10 @@ def main():
             print(f"resumed from step {trainer.step}")
         except FileNotFoundError:
             print("no checkpoint found; starting fresh")
-    trainer.run(max_steps=args.max_steps, checkpoint_dir=args.checkpoint_dir)
+    try:
+        trainer.run(max_steps=args.max_steps, checkpoint_dir=args.checkpoint_dir)
+    finally:
+        trainer.finish()
 
 
 if __name__ == "__main__":
