@@ -26,9 +26,15 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from mamba_distributed_tpu.ops.scan import _divisor_chunk
 from mamba_distributed_tpu.ops.ssd import state_passing
+
+# every grid cell is independent — let both megacore TensorCores split it
+_PARALLEL3 = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel"),
+)
 
 
 def _chunk_states_kernel(x_ref, dt_ref, acum_ref, B_ref, out_ref, *, compute_dtype):
@@ -139,6 +145,7 @@ def _ssd_pallas_fwd_impl(
         out_specs=pl.BlockSpec(
             (1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
         ),
+        compiler_params=_PARALLEL3,
         interpret=interpret,
     )(xr, dtr, a_cum, Br)
 
@@ -153,6 +160,7 @@ def _ssd_pallas_fwd_impl(
             pl.BlockSpec((1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
         ],
         out_specs=x_spec,
+        compiler_params=_PARALLEL3,
         interpret=interpret,
     )(xr, dtr, a_cum, Br, Cr, prev_states)
 
