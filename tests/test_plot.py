@@ -1,0 +1,41 @@
+"""Loss-curve plotting: the reference plot.ipynb equivalent parses our
+logs (and the reference's) and renders a png."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from plot import parse_log  # noqa: E402
+
+LOG = """0 val 10.9578
+0 train 11.018519
+1 train 10.998294
+garbage line that is ignored
+2 val 10.9295
+2 train 10.955
+"""
+
+
+def test_parse_log(tmp_path):
+    p = tmp_path / "log.txt"
+    p.write_text(LOG)
+    train, val = parse_log(str(p))
+    assert train == [(0, 11.018519), (1, 10.998294), (2, 10.955)]
+    assert val == [(0, 10.9578), (2, 10.9295)]
+
+
+def test_plot_cli_writes_png(tmp_path):
+    log = tmp_path / "log.txt"
+    log.write_text(LOG)
+    out = tmp_path / "curve.png"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "plot.py"),
+         "--log", str(log), "--out", str(out),
+         "--ref-log", str(log)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert out.exists() and out.stat().st_size > 1000
