@@ -1,40 +1,53 @@
-"""Metrics logging, reference-text-format compatible.
+"""Metrics logging: reference-text-format log + structured jsonl.
 
-The log file carries exactly the reference's 3-field lines
+``log.txt`` carries exactly the reference's 3-field lines
 (``"{step} train {loss:.6f}"`` / ``"{step} val {loss:.4f}"``,
 /root/reference/train.py:124,150,240) so its plot tooling (plot.ipynb)
-parses ours unchanged; the console line additionally carries lr, grad
-norm, step time, tokens/sec, and MFU (the reference printed the first
-four, train.py:237-239; MFU is new).
+parses ours unchanged.  ``metrics.jsonl`` carries the structured record
+SURVEY.md §5 calls for — step, loss, lr, grad norm, step time,
+tokens/sec, MFU — one JSON object per line, machine-parseable.  The
+console line shows both worlds (the reference printed step/loss/lr/
+norm/dt/tok-sec, train.py:237-239; MFU is new).
 """
 
 from __future__ import annotations
 
+import json
 import os
 
 
 class MetricsLogger:
     def __init__(self, log_dir: str, master_process: bool = True,
-                 filename: str = "log.txt"):
+                 filename: str = "log.txt",
+                 jsonl_filename: str = "metrics.jsonl"):
         self.master = master_process
         self.log_file = None
+        self.jsonl_file = None
         # truncation (reference train.py:122) is deferred to the first write
         # so a checkpoint resume can preserve the pre-crash history
         self._truncate_pending = True
         if master_process:
             os.makedirs(log_dir, exist_ok=True)
             self.log_file = os.path.join(log_dir, filename)
+            self.jsonl_file = os.path.join(log_dir, jsonl_filename)
 
     def preserve_history(self) -> None:
-        """Keep the existing log file (called on checkpoint resume)."""
+        """Keep the existing log files (called on checkpoint resume)."""
         self._truncate_pending = False
 
-    def _append(self, line: str) -> None:
+    def _append(self, line: str, record: dict | None = None) -> None:
         if self.log_file:
             mode = "w" if self._truncate_pending else "a"
+            if self._truncate_pending:
+                # truncate BOTH files together so a record-less first write
+                # can never leave a previous run's jsonl to interleave with
+                open(self.jsonl_file, "w").close()
             self._truncate_pending = False
             with open(self.log_file, mode) as f:
                 f.write(line + "\n")
+            if record is not None:
+                with open(self.jsonl_file, "a") as f:
+                    f.write(json.dumps(record) + "\n")
 
     def train_step(self, step: int, loss: float, lr: float, grad_norm: float,
                    dt_s: float, tokens_per_sec: float, mfu: float) -> None:
@@ -45,10 +58,22 @@ class MetricsLogger:
             f"norm: {grad_norm:.4f} | dt: {dt_s * 1000:.2f}ms | "
             f"tok/sec: {tokens_per_sec:.2f} | mfu: {mfu * 100:.1f}%"
         )
-        self._append(f"{step} train {loss:.6f}")
+        self._append(
+            f"{step} train {loss:.6f}",
+            {
+                "step": step, "kind": "train", "loss": round(loss, 6),
+                "lr": lr, "grad_norm": round(grad_norm, 4),
+                "step_ms": round(dt_s * 1000, 2),
+                "tokens_per_sec": round(tokens_per_sec, 1),
+                "mfu": round(mfu, 4),
+            },
+        )
 
     def val(self, step: int, loss: float) -> None:
         if not self.master:
             return
         print(f"validation loss: {loss:.4f}")
-        self._append(f"{step} val {loss:.4f}")
+        self._append(
+            f"{step} val {loss:.4f}",
+            {"step": step, "kind": "val", "loss": round(loss, 4)},
+        )

@@ -123,6 +123,27 @@ def test_log_format_matches_reference(tmp_path):
     )
 
 
+def test_structured_metrics_jsonl(tmp_path):
+    """Alongside the reference-format log.txt, metrics.jsonl carries the
+    structured per-step record (SURVEY.md §5)."""
+    import json
+
+    from mamba_distributed_tpu.training import Trainer
+
+    t = Trainer(make_cfg(tmp_path), verbose=True)
+    t.run(max_steps=2)
+    lines = [
+        json.loads(ln)
+        for ln in open(os.path.join(str(tmp_path), "log", "metrics.jsonl"))
+    ]
+    train = [r for r in lines if r["kind"] == "train"]
+    val = [r for r in lines if r["kind"] == "val"]
+    assert len(train) == 2 and len(val) >= 1
+    for r in train:
+        assert {"step", "loss", "lr", "grad_norm", "step_ms",
+                "tokens_per_sec", "mfu"} <= set(r)
+
+
 def test_in_loop_sampling(tmp_path, capsys):
     """Reference-style in-training sampling (train.py:166-199): 4 rows of
     prompt + 32 new tokens, decoded via the injected decode_fn."""
