@@ -1,17 +1,25 @@
 """Device-mesh parallelism: mesh construction, sharding rules, sequence
-parallelism, ring attention.
+parallelism, ring attention, pipeline prototype.
 
 Replaces the reference's NCCL/DDP runtime (/root/reference/train.py:27,86,
 221) with XLA SPMD: shardings on a `jax.sharding.Mesh` drive compiler-
 inserted collectives over ICI/DCN; explicit `shard_map`+`ppermute` only
-where control matters (sequence-parallel state passing, ring attention).
+where control matters (sequence-parallel state passing, ring attention,
+the pipelined layer schedule).
 """
 
 from mamba_distributed_tpu.parallel.mesh import build_mesh
+from mamba_distributed_tpu.parallel.pipeline import pipelined_layers
 from mamba_distributed_tpu.parallel.sharding import (
     batch_sharding,
     param_shardings,
     shard_params,
 )
 
-__all__ = ["build_mesh", "batch_sharding", "param_shardings", "shard_params"]
+__all__ = [
+    "build_mesh",
+    "batch_sharding",
+    "param_shardings",
+    "pipelined_layers",
+    "shard_params",
+]

@@ -1,0 +1,125 @@
+"""Pipeline parallelism over the layer stack (prototype).
+
+Neither the reference nor any BASELINE configuration uses pipeline
+parallelism (SURVEY.md §2.3 lists it "out of scope"); this module exists
+so the framework covers the full parallelism menu.  It is deliberately
+standalone — nothing in the trainer depends on it.
+
+TPU-idiomatic formulation: the scan-over-layers parameter stack is
+sharded on its *layer* axis over a ``stage`` mesh axis, and a GPipe-style
+schedule runs as a ``lax.scan`` over clock ticks inside ``shard_map``.
+At tick t, stage s runs its local layers on the activation of microbatch
+``t - s`` (bubble ticks compute on garbage and are masked out — uniform
+compute, no divergent control flow, which is what the TPU wants), then
+``ppermute``s the activation to stage s+1.  Total ticks =
+``n_micro + n_stages - 1``; bubble fraction ``(S-1)/T`` exactly as in
+the GPipe paper.
+
+The schedule is exact: outputs equal running every layer locally
+(tests/test_pipeline.py pins equality on the virtual mesh, including the
+real Mamba-2 block body with its (hidden, residual) carry).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def pipelined_layers(
+    body_fn: Callable,
+    stacked_params,
+    xs,
+    mesh: Mesh,
+    axis: str = "stage",
+):
+    """Run ``scan(body_fn)`` over layer-stacked params, pipelined over
+    ``axis``.
+
+    Args:
+      body_fn: ``(activation, layer_params) -> activation`` — one layer.
+        The activation may be any pytree of arrays (e.g. the block
+        pipeline's (hidden, residual) pair).
+      stacked_params: pytree whose leaves carry a leading ``n_layer``
+        axis; n_layer % n_stages must be 0 (sharded over ``axis``).
+      xs: activation pytree whose leaves carry a leading (n_micro, ...)
+        microbatch axis (replicated over the mesh).
+      mesh: mesh containing ``axis``.
+
+    Returns the output pytree with the same (n_micro, ...) leading axis —
+    identical to an unpipelined ``lax.scan`` of ``body_fn`` over all
+    layers for each microbatch.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = jax.tree.leaves(xs)[0].shape[0]
+    n_ticks = n_micro + n_stages - 1
+
+    def local(params_local, xs_local):
+        s = jax.lax.axis_index(axis)
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def run_stage(act):
+            def layer(carry, p):
+                return body_fn(carry, p), None
+
+            out, _ = jax.lax.scan(layer, act, params_local)
+            return out
+
+        buf = jax.tree.map(lambda x: jnp.zeros_like(x[0]), xs_local)
+        outs = jax.tree.map(jnp.zeros_like, xs_local)
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 ingests microbatch t while t < n_micro
+            inject = jax.tree.map(
+                lambda x: x[jnp.clip(t, 0, n_micro - 1)], xs_local
+            )
+            take_inject = jnp.logical_and(s == 0, t < n_micro)
+            buf = _tree_where(take_inject, inject, buf)
+            y = run_stage(buf)
+            # the last stage finished microbatch m = t - (S-1) this tick
+            m = t - (n_stages - 1)
+            write = jnp.logical_and(s == n_stages - 1, m >= 0)
+            idx = jnp.clip(m, 0, n_micro - 1)
+            outs = jax.tree.map(
+                lambda o, y_leaf: jax.lax.dynamic_update_index_in_dim(
+                    o, jnp.where(write, y_leaf, o[idx]), idx, axis=0
+                ),
+                outs,
+                y,
+            )
+            # activations advance one stage per tick
+            buf = jax.lax.ppermute(y, axis, perm) if perm else y
+            return (buf, outs), None
+
+        (buf, outs), _ = jax.lax.scan(tick, (buf, outs), jnp.arange(n_ticks))
+        # only the last stage holds real outputs; share them with everyone
+        outs = jax.tree.map(
+            lambda o: jax.lax.psum(
+                jnp.where(s == n_stages - 1, o, jnp.zeros_like(o)), axis
+            ),
+            outs,
+        )
+        return outs
+
+    # params shard their leading layer axis over the stage axis; activations
+    # are replicated on it
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *(None,) * (jnp.ndim(p) - 1)), stacked_params
+    )
+    xs_specs = jax.tree.map(lambda x: P(*(None,) * jnp.ndim(x)), xs)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, xs_specs),
+        out_specs=xs_specs,
+        check_vma=False,
+    )
+    return fn(stacked_params, xs)

@@ -13,7 +13,18 @@ norm/dt/tok-sec, train.py:237-239; MFU is new).
 from __future__ import annotations
 
 import json
+import math
 import os
+
+
+def _jsonable(record: dict) -> dict:
+    """NaN/Inf are not valid JSON (json.dumps emits bare NaN tokens strict
+    parsers reject — exactly in the diverged-run case where the structured
+    log matters most); serialize them as null."""
+    return {
+        k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+        for k, v in record.items()
+    }
 
 
 class MetricsLogger:
@@ -47,7 +58,7 @@ class MetricsLogger:
                 f.write(line + "\n")
             if record is not None:
                 with open(self.jsonl_file, "a") as f:
-                    f.write(json.dumps(record) + "\n")
+                    f.write(json.dumps(_jsonable(record)) + "\n")
 
     def train_step(self, step: int, loss: float, lr: float, grad_norm: float,
                    dt_s: float, tokens_per_sec: float, mfu: float) -> None:

@@ -1,0 +1,95 @@
+"""Pipeline-parallel prototype: pipelined schedule == plain layer scan.
+
+On the virtual mesh a ``stage`` axis is borrowed from the ``data`` axis
+name by building a dedicated mesh here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from mamba_distributed_tpu.parallel.pipeline import pipelined_layers
+
+
+@pytest.fixture(scope="module")
+def stage_mesh():
+    devs = np.array(jax.devices()[:4])
+    return Mesh(devs, ("stage",))
+
+
+def _ref_scan(body_fn, stacked_params, xs):
+    def per_micro(x):
+        def layer(c, p):
+            return body_fn(c, p), None
+
+        out, _ = jax.lax.scan(layer, x, stacked_params)
+        return out
+
+    return jax.vmap(per_micro)(xs)
+
+
+def test_pipeline_matches_scan_affine(stage_mesh, rng):
+    """8 affine layers over 4 stages x 6 microbatches, array activation."""
+    n_layer, mb, d = 8, 6, 16
+    k1, k2, k3 = jax.random.split(rng, 3)
+    params = {
+        "w": jax.random.normal(k1, (n_layer, d, d)) * 0.2,
+        "b": jax.random.normal(k2, (n_layer, d)),
+    }
+    xs = jax.random.normal(k3, (mb, 4, d))
+
+    def body(x, p):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    ref = _ref_scan(body, params, xs)
+    got = jax.jit(
+        lambda p, x: pipelined_layers(body, p, x, stage_mesh)
+    )(params, xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_matches_scan_mamba2_blocks(stage_mesh, rng):
+    """The real Mamba-2 block body with its (hidden, residual) pytree
+    carry, pipelined over 4 stages."""
+    from mamba_distributed_tpu.config import ModelConfig
+    from mamba_distributed_tpu.models.lm import _block_fwd, init_lm_params
+
+    cfg = ModelConfig(
+        d_model=32, n_layer=8, vocab_size=64, ssm_layer="mamba2", headdim=8,
+        chunk_size=16, d_state=16, compute_dtype="float32",
+    )
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)["blocks"]
+    mb, b, t = 3, 2, 32
+    hidden = jax.random.normal(rng, (mb, b, t, cfg.d_model), jnp.float32)
+    xs = (hidden, jnp.zeros_like(hidden))
+
+    def body(carry, bp):
+        h, r = carry
+        return _block_fwd(bp, cfg, h, r, False)
+
+    ref_h, ref_r = _ref_scan(body, params, xs)
+    got_h, got_r = jax.jit(
+        lambda p, x: pipelined_layers(body, p, x, stage_mesh)
+    )(params, xs)
+    np.testing.assert_allclose(np.asarray(got_h), np.asarray(ref_h),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_r), np.asarray(ref_r),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_single_stage(rng):
+    """Degenerate 1-stage mesh: the schedule reduces to the plain scan."""
+    mesh = Mesh(np.array(jax.devices()[:1]), ("stage",))
+    params = {"w": jax.random.normal(rng, (4, 8, 8)) * 0.3}
+    xs = jax.random.normal(jax.random.fold_in(rng, 1), (2, 3, 8))
+
+    def body(x, p):
+        return x @ p["w"]
+
+    ref = _ref_scan(body, params, xs)
+    got = pipelined_layers(body, params, xs, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
