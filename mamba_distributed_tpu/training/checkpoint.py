@@ -4,7 +4,7 @@ The reference saves model-only every 1,000 steps and cannot resume
 (/root/reference/train.py:152-163, acknowledged in-code at 161-162).  Here
 a checkpoint restores the *exact* training trajectory: restoring and
 stepping reproduces the same losses bit-for-bit (pinned by
-tests/test_checkpoint.py).  Sharded arrays save/restore distributed-aware
+tests/test_training.py).  Sharded arrays save/restore distributed-aware
 through Orbax's TypeHandlers — each host writes its own shards.
 """
 
