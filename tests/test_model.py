@@ -50,6 +50,22 @@ def test_280m_preset_param_count():
     assert get_preset("mamba2-280m").model.num_params() == 279_614_720
 
 
+def test_all_presets_param_trees_match_analytic():
+    """Every BASELINE preset (incl. 1.3B/2.8B/7B-hybrid) builds a param
+    tree whose total size equals the analytic count — via eval_shape, so
+    nothing is materialized."""
+    from mamba_distributed_tpu.config import PRESETS
+
+    for name, cfg in PRESETS.items():
+        shapes = jax.eval_shape(
+            lambda k, m=cfg.model: init_lm_params(k, m), jax.random.PRNGKey(0)
+        )
+        import math
+
+        total = sum(int(math.prod(s.shape)) for s in jax.tree.leaves(shapes))
+        assert total == cfg.model.num_params(), name
+
+
 @pytest.mark.parametrize("name", CFGS)
 def test_init_loss_near_ln_vocab(name):
     cfg = CFGS[name]
