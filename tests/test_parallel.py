@@ -94,6 +94,28 @@ def test_fsdp8_matches_single_device(tmp_path):
     assert sharded, "no parameter actually sharded under FSDP"
 
 
+HYBRID_OVER = dict(
+    n_layer=4, attn_layer_idx=(1, 3), attn_num_heads=4, attn_num_kv_heads=2,
+    d_intermediate=48,
+)
+
+
+def test_hybrid_fsdp8_matches_single_device(tmp_path):
+    """Config-5 shape (SSM + attention + gated MLP) under FSDP sharding:
+    the attn_blocks/mlp sharding rules reproduce single-device losses."""
+    ref, _ = losses_of(tmp_path / "a", micro=8, model_over=HYBRID_OVER)
+    fsdp, tr = losses_of(
+        tmp_path / "b", mesh=MeshConfig(fsdp=8), micro=1, shard=True,
+        model_over=HYBRID_OVER,
+    )
+    np.testing.assert_allclose(ref, fsdp, rtol=2e-4)
+    sharded = [
+        p for p in jax.tree.leaves(tr.params)
+        if any(s is not None for s in p.sharding.spec)
+    ]
+    assert sharded, "no parameter actually sharded under FSDP"
+
+
 def test_fsdp_shards_opt_state(tmp_path):
     tr = Trainer(
         make_cfg(tmp_path, mesh=MeshConfig(fsdp=8), shard=True, micro=1),
