@@ -116,6 +116,18 @@ def test_hybrid_fsdp8_matches_single_device(tmp_path):
     assert sharded, "no parameter actually sharded under FSDP"
 
 
+def test_hybrid_tp_fsdp_dp_matches_single_device(tmp_path):
+    """Hybrid blocks under tensor x fsdp x data all at once: the
+    wqkv/mlp TP rules and attn param sharding reproduce the single-device
+    trajectory."""
+    ref, _ = losses_of(tmp_path / "a", micro=8, model_over=HYBRID_OVER)
+    tp, _ = losses_of(
+        tmp_path / "b", mesh=MeshConfig(data=2, fsdp=2, tensor=2), micro=2,
+        shard=True, model_over=HYBRID_OVER,
+    )
+    np.testing.assert_allclose(ref, tp, rtol=2e-4)
+
+
 def test_fsdp_shards_opt_state(tmp_path):
     tr = Trainer(
         make_cfg(tmp_path, mesh=MeshConfig(fsdp=8), shard=True, micro=1),
