@@ -124,3 +124,48 @@ def test_config_from_hf_json_mamba1_default():
                                "vocab_size": 50277})
     assert cfg.ssm_layer == "mamba1"  # empty ssm_cfg builds Mamba-1
     assert cfg.effective_d_state == 16
+
+
+M1_CFG = ModelConfig(d_model=32, n_layer=2, vocab_size=61, ssm_layer="mamba1",
+                     d_state=8, compute_dtype="float32")
+
+
+def m1_synthetic_state_dict(cfg: ModelConfig, seed=0) -> dict:
+    g = torch.Generator().manual_seed(seed)
+    di = cfg.d_inner
+    ds = cfg.effective_d_state
+    dtr = cfg.effective_dt_rank
+    r = lambda *s: torch.randn(*s, generator=g) * 0.05
+    sd = {"backbone.embedding.weight": r(cfg.vocab_size, cfg.d_model)}
+    for i in range(cfg.n_layer):
+        pre = f"backbone.layers.{i}."
+        sd[pre + "norm.weight"] = torch.ones(cfg.d_model)
+        sd[pre + "mixer.in_proj.weight"] = r(2 * di, cfg.d_model)
+        sd[pre + "mixer.conv1d.weight"] = r(di, 1, cfg.d_conv)
+        sd[pre + "mixer.conv1d.bias"] = r(di)
+        sd[pre + "mixer.x_proj.weight"] = r(dtr + 2 * ds, di)
+        sd[pre + "mixer.dt_proj.weight"] = r(di, dtr)
+        sd[pre + "mixer.dt_proj.bias"] = r(di)
+        sd[pre + "mixer.A_log"] = torch.zeros(di, ds)
+        sd[pre + "mixer.D"] = torch.ones(di)
+        sd[pre + "mixer.out_proj.weight"] = r(cfg.d_model, di)
+    sd["backbone.norm_f.weight"] = torch.ones(cfg.d_model)
+    sd["lm_head.weight"] = sd["backbone.embedding.weight"]
+    return sd
+
+
+def test_import_mamba1_runs():
+    """The mamba1 branch (x_proj/dt_proj layout) imports and forwards."""
+    import jax
+
+    sd = m1_synthetic_state_dict(M1_CFG)
+    params = import_state_dict(sd, M1_CFG)
+    assert count_params(params) == M1_CFG.num_params()
+    np.testing.assert_array_equal(
+        np.asarray(params["blocks"]["mixer"]["dt_proj"]["kernel"][0]),
+        sd["backbone.layers.0.mixer.dt_proj.weight"].numpy().T,
+    )
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 61)
+    logits = lm_forward(params, M1_CFG, x)
+    assert logits.shape == (2, 16, M1_CFG.vocab_size_padded)
+    assert bool(np.isfinite(np.asarray(logits)).all())
