@@ -1,0 +1,34 @@
+"""bench.py harness contracts (no device work — config/error paths only)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_time_config_reports_errors_instead_of_raising():
+    """Sweeps must survive a bad configuration (e.g. OOM on hardware);
+    the error comes back as data."""
+    r = bench.time_config({"ssm_impl": "bogus"}, iters=1)
+    assert "error" in r and "ValueError" in r["error"]
+    assert r["ssm_impl"] == "bogus"  # spec echoed for attribution
+
+
+def test_env_spec_rejects_bad_remat(monkeypatch):
+    monkeypatch.setenv("BENCH_REMAT", "yes")
+    with pytest.raises(SystemExit, match="BENCH_REMAT"):
+        bench._env_spec()
+
+
+def test_env_spec_defaults_are_baseline_recipe(monkeypatch):
+    for var in ("BENCH_B", "BENCH_T", "BENCH_PRESET", "BENCH_SSM_IMPL",
+                "BENCH_REMAT", "BENCH_REMAT_POLICY"):
+        monkeypatch.delenv(var, raising=False)
+    spec = bench._env_spec()
+    assert spec["preset"] == bench.BASELINE_PRESET
+    assert spec["T"] == bench.BASELINE_T
