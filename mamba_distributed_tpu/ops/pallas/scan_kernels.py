@@ -17,10 +17,15 @@ the VPU/VMEM model rather than translated:
   * batch and d-block grid dimensions are marked parallel (megacore);
     state math is fp32 like the CUDA kernel.
 
-Training uses ``jax.custom_vjp``: the backward runs the chunked
-associative-scan formulation (ops/scan.selective_scan; same math, XLA
-autodiff), so gradients are identical to the XLA path — pinned by
-tests/test_pallas.py.
+Training uses ``jax.custom_vjp`` with a **Pallas backward** (counterpart
+of the reference dep's fused CUDA backward in
+``mamba_ssm/csrc/selective_scan/selective_scan_bwd_*.cu``): a first
+kernel re-runs the forward storing only per-tile entry states, then a
+reverse-time kernel walks the t-tiles backwards, rebuilds the in-tile
+states in a VMEM scratch from the tile's entry state (the same
+recompute-per-chunk trade the CUDA kernel makes with shared memory),
+and accumulates du/ddt/dA/dB/dC as it sweeps.  Gradient parity vs the
+XLA associative-scan path is pinned by tests/test_pallas.py.
 """
 
 from __future__ import annotations
@@ -132,6 +137,193 @@ def _m1_pallas_fwd(uf, df, Af, Bf, Cf, h0, interpret):
     return y, jnp.swapaxes(hT, 1, 2)
 
 
+# ---------------------------------------------------------------------------
+# Backward pass.  Recurrence (per batch, channel, state lane n):
+#     h_i = h_{i-1} * e_i + dt_i u_i B_i,   e_i = exp(A dt_i)
+#     y_i = <h_i, C_i>
+# Reverse sweep with gh = dL/dh_i accumulated right-to-left:
+#     gh   += C_i (x) dy_i
+#     dC_i  = sum_d h_i dy_i            dB_i = sum_d gh dt_i u_i
+#     ddt_i = sum_n gh (h_{i-1} A e_i + u_i B_i)
+#     du_i  = dt_i sum_n gh B_i         dA  += gh e_i h_{i-1} dt_i
+#     gh   *= e_i
+# h_{i-1} is rebuilt per tile from a stored tile-entry state, so the
+# backward's HBM footprint stays O(t/t_blk) states, not O(t).
+# ---------------------------------------------------------------------------
+
+
+def _m1_entry_states_kernel(
+    u_ref, dt_ref, At_ref, B_ref, h0_ref, st_ref, h_scratch
+):
+    """Forward recompute writing each t-tile's *entry* state."""
+    ti = pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _():
+        h_scratch[...] = h0_ref[0]
+
+    st_ref[0, 0] = h_scratch[...]
+    At = At_ref[...]
+    tb = u_ref.shape[1]
+
+    def body(i, h):
+        dt_t = dt_ref[0, pl.ds(i, 1)]
+        u_t = u_ref[0, pl.ds(i, 1)]
+        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)
+        return h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+
+    h_scratch[...] = jax.lax.fori_loop(0, tb, body, h_scratch[...])
+
+
+def _m1_bwd_kernel(
+    u_ref, dt_ref, At_ref, B_ref, C_ref, hin_ref, dy_ref,
+    du_ref, ddt_ref, dA_ref, dB_ref, dC_ref,
+    gh_scratch, hbuf, dA_scratch, *, nt: int,
+):
+    """Reverse sweep over one (batch, d-block, reversed t-tile) cell.
+
+    hbuf[i] holds h_{i-1} (the state *entering* step i), rebuilt from the
+    tile's entry state; gh and the dA accumulator persist across the
+    sequential (reversed) tile dimension in scratch.
+    """
+    ti = pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _():
+        gh_scratch[...] = jnp.zeros_like(gh_scratch)
+        dA_scratch[...] = jnp.zeros_like(dA_scratch)
+
+    At = At_ref[...]          # (n, dblk)
+    tb = u_ref.shape[1]
+
+    # forward in-tile recompute: hbuf[i] = state before step i
+    def fwd_body(i, h):
+        hbuf[pl.ds(i, 1)] = h[None]
+        dt_t = dt_ref[0, pl.ds(i, 1)]
+        u_t = u_ref[0, pl.ds(i, 1)]
+        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)
+        return h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+
+    jax.lax.fori_loop(0, tb, fwd_body, hin_ref[0, 0])
+
+    # reverse sweep
+    def rev_body(k, carry):
+        gh, dA = carry
+        i = tb - 1 - k
+        dt_t = dt_ref[0, pl.ds(i, 1)]              # (1, dblk)
+        u_t = u_ref[0, pl.ds(i, 1)]
+        dy_t = dy_ref[0, pl.ds(i, 1)]
+        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)  # (n, 1)
+        Cn = C_ref[0, pl.ds(i, 1)].reshape(-1, 1)
+        hprev = hbuf[i]                            # (n, dblk)
+
+        e_t = jnp.exp(At * dt_t)
+        gh = gh + Cn * dy_t
+        hcur = hprev * e_t + (dt_t * u_t) * Bn
+        dC_ref[0, 0, pl.ds(i, 1)] = jnp.sum(hcur * dy_t, axis=1)[None]
+        dB_ref[0, 0, pl.ds(i, 1)] = jnp.sum(gh * (dt_t * u_t), axis=1)[None]
+        ddt_ref[0, pl.ds(i, 1)] = jnp.sum(
+            gh * (hprev * At * e_t + u_t * Bn), axis=0, keepdims=True
+        )
+        du_ref[0, pl.ds(i, 1)] = dt_t * jnp.sum(gh * Bn, axis=0, keepdims=True)
+        ghe = gh * e_t
+        dA = dA + ghe * hprev * dt_t
+        return ghe, dA
+
+    gh, dA = jax.lax.fori_loop(
+        0, tb, rev_body, (gh_scratch[...], dA_scratch[...])
+    )
+    gh_scratch[...] = gh
+    dA_scratch[...] = dA
+
+    @pl.when(ti == nt - 1)
+    def _():
+        dA_ref[0] = dA_scratch[...]
+
+
+def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
+    """Entry-state recompute + reverse kernel + tiny XLA reductions."""
+    b, t, d = uf.shape
+    n = Af.shape[-1]
+    t_blk, dblk = _pick_blocks(t, d)
+    # the reverse kernel keeps (t_blk, n, dblk) rebuilt states in VMEM;
+    # shrink the tile if that buffer would exceed ~4 MB
+    cap = max(1, (4 << 20) // (4 * n * dblk))
+    if t_blk > cap:
+        t_blk = _divisor_up_to(t, cap)
+    nt = t // t_blk
+    nd = d // dblk
+    grid = (b, nd, nt)
+    At = Af.T
+    h0 = jnp.zeros((b, n, d), jnp.float32)
+
+    io_spec = pl.BlockSpec((1, t_blk, dblk), lambda bi, di, ti: (bi, ti, di))
+    bc_spec = pl.BlockSpec((1, t_blk, n), lambda bi, di, ti: (bi, ti, 0))
+    A_spec = pl.BlockSpec((n, dblk), lambda bi, di, ti: (0, di))
+    seq_semantics = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+    entry_states = pl.pallas_call(
+        _m1_entry_states_kernel,
+        grid=grid,
+        in_specs=[
+            io_spec, io_spec, A_spec, bc_spec,
+            pl.BlockSpec((1, n, dblk), lambda bi, di, ti: (bi, 0, di)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, n, dblk), lambda bi, di, ti: (bi, ti, 0, di)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, nt, n, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((n, dblk), jnp.float32)],
+        compiler_params=seq_semantics,
+        interpret=interpret,
+    )(uf, df, At, Bf, h0)
+
+    # reversed sequential tile order via the index maps
+    rio_spec = pl.BlockSpec(
+        (1, t_blk, dblk), lambda bi, di, ti: (bi, nt - 1 - ti, di)
+    )
+    rbc_spec = pl.BlockSpec(
+        (1, t_blk, n), lambda bi, di, ti: (bi, nt - 1 - ti, 0)
+    )
+    du, ddt, dA_part, dB_part, dC_part = pl.pallas_call(
+        functools.partial(_m1_bwd_kernel, nt=nt),
+        grid=grid,
+        in_specs=[
+            rio_spec, rio_spec, A_spec, rbc_spec, rbc_spec,
+            pl.BlockSpec((1, 1, n, dblk), lambda bi, di, ti: (bi, nt - 1 - ti, 0, di)),
+            rio_spec,
+        ],
+        out_specs=[
+            rio_spec,
+            rio_spec,
+            pl.BlockSpec((1, n, dblk), lambda bi, di, ti: (bi, 0, di)),
+            pl.BlockSpec((1, 1, t_blk, n), lambda bi, di, ti: (bi, di, nt - 1 - ti, 0)),
+            pl.BlockSpec((1, 1, t_blk, n), lambda bi, di, ti: (bi, di, nt - 1 - ti, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, nd, t, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, nd, t, n), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((n, dblk), jnp.float32),
+            pltpu.VMEM((t_blk, n, dblk), jnp.float32),
+            pltpu.VMEM((n, dblk), jnp.float32),
+        ],
+        compiler_params=seq_semantics,
+        interpret=interpret,
+    )(uf, df, At, Bf, Cf, entry_states, dy)
+
+    dAf = jnp.sum(dA_part, axis=0).T           # (d, n)
+    dBf = jnp.sum(dB_part, axis=1)             # (b, t, n)
+    dCf = jnp.sum(dC_part, axis=1)
+    return du, ddt, dAf, dBf, dCf
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def _m1_core(uf, df, Af, Bf, Cf, interpret):
     b, _, d = uf.shape
@@ -145,17 +337,9 @@ def _m1_core_fwd(uf, df, Af, Bf, Cf, interpret):
 
 
 def _m1_core_bwd(interpret, res, dy):
-    """Backward through the chunked associative-scan formulation."""
-    from mamba_distributed_tpu.ops.scan import selective_scan
-
+    """Pallas backward (see the backward section above)."""
     uf, df, Af, Bf, Cf = res
-
-    def f(u, dt, A, B, C):
-        # inputs are already fp32 + softplus-ed; no D/z (applied outside)
-        return selective_scan(u, dt, A, B, C)
-
-    _, vjp = jax.vjp(f, uf, df, Af, Bf, Cf)
-    return vjp(dy.astype(jnp.float32))
+    return _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy.astype(jnp.float32), interpret)
 
 
 _m1_core.defvjp(_m1_core_fwd, _m1_core_bwd)
@@ -179,8 +363,14 @@ def selective_scan_pallas(
 
     With ``initial_state``/``return_final_state`` (decode prefill / SP)
     the non-custom-vjp path runs; the plain training path gets the custom
-    VJP with an XLA backward.  ``interpret=None`` auto-selects the Pallas
+    VJP with a Pallas backward.  ``interpret=None`` auto-selects the Pallas
     interpreter off-TPU (CPU tests run the same kernel code).
+
+    The channel axis is padded to a multiple of the 128-lane vreg width
+    and t to a multiple of 8 sublanes, so Mosaic only ever sees aligned
+    BlockSpecs; the padding is numerically inert (u=dt=A=0 channels/steps
+    carry zero state and are sliced off), autodiff handles the pad/slice,
+    and interpret mode takes the identical path so CPU tests exercise it.
     """
     if interpret is None:
         kind = getattr(jax.devices()[0], "device_kind", "").lower()
@@ -189,16 +379,34 @@ def selective_scan_pallas(
     b, t, d = u.shape
     uf, df, Af, Bf, Cf, Df = _prep(u, delta, A, B, C, D, delta_bias, delta_softplus)
 
+    pad_d = -d % 128
+    pad_t = -t % 8
+    if pad_d or pad_t:
+        pt, pd = (0, pad_t), (0, pad_d)
+        uf = jnp.pad(uf, ((0, 0), pt, pd))
+        df = jnp.pad(df, ((0, 0), pt, pd))
+        Af = jnp.pad(Af, (pd, (0, 0)))
+        Bf = jnp.pad(Bf, ((0, 0), pt, (0, 0)))
+        Cf = jnp.pad(Cf, ((0, 0), pt, (0, 0)))
+
     if initial_state is None and not return_final_state:
         y = _m1_core(uf, df, Af, Bf, Cf, interpret)
         h_last = None
     else:
         h0 = (
-            jnp.zeros((b, d, Af.shape[-1]), jnp.float32)
+            jnp.zeros((b, d + pad_d, Af.shape[-1]), jnp.float32)
             if initial_state is None
             else initial_state.astype(jnp.float32)
         )
+        if pad_d and initial_state is not None:
+            h0 = jnp.pad(h0, ((0, 0), (0, pad_d), (0, 0)))
         y, h_last = _m1_pallas_fwd(uf, df, Af, Bf, Cf, h0, interpret)
+        if pad_d and h_last is not None:
+            h_last = h_last[:, :d]
+
+    if pad_d or pad_t:
+        y = y[:, :t, :d]
+        uf = uf[:, :t, :d]
 
     if Df is not None:
         y = y + uf * Df[None, None, :]

@@ -14,9 +14,15 @@ re-derived for the MXU/VMEM model, not translated:
   * heads are processed ``hb = 128 // headdim`` at a time so the lane
     dimension of the y/x tiles stays full.
 
-Training uses ``jax.custom_vjp``: the backward runs the einsum
-formulation (exact same math; XLA autodiff), so gradients are identical
-to ``ssd_chunked`` — pinned by tests/test_pallas.py.
+Training uses ``jax.custom_vjp`` with a **Pallas backward** (the analogue
+of ``_mamba_chunk_scan_combined_bwd`` in the reference dep's
+``mamba_ssm/ops/triton/ssd_combined.py``): activations are recomputed
+chunk-locally (same remat trade the Triton path makes), the direct
+state gradient and the dx/ddt/dB/dC/dA cell gradients each come from a
+Pallas kernel that rebuilds the (l x l) decay matrices in VMEM, and only
+the tiny inter-chunk pieces (reverse associative scan over chunk states,
+the cumsum-chain dt/A grads) stay at the XLA level.  Gradient parity vs
+the XLA autodiff of ``ssd_chunked`` is pinned by tests/test_pallas.py.
 """
 
 from __future__ import annotations
@@ -103,20 +109,28 @@ def _heads_per_block(h: int, p: int, g: int) -> int:
     return max(hb, 1)
 
 
-def _ssd_pallas_fwd_impl(
-    x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
-):
-    """Forward via the two kernels + XLA state passing.
+def _cell_specs(h: int, hb: int, l: int, p: int, n: int, g: int):
+    """Grid-cell BlockSpecs shared by the fwd and bwd kernels.
 
-    Shapes: x (b,t,h,p); dt (b,t,h) [bias-added+softplused]; A (h,);
-    B/C (b,t,g,n).  Returns (y_no_D (b,t,h,p) fp32-accurate, final_state).
+    Index maps: (bi, ci, hi) -> block indices; B/C pick the head-block's
+    group, states pick the head-block.
     """
+    x_spec = pl.BlockSpec((1, 1, l, hb, p), lambda bi, ci, hi: (bi, ci, 0, hi, 0))
+    dt_spec = pl.BlockSpec((1, 1, l, hb), lambda bi, ci, hi: (bi, ci, 0, hi))
+    bc_spec = pl.BlockSpec(
+        (1, 1, l, 1, n), lambda bi, ci, hi: (bi, ci, 0, (hi * hb * g) // h, 0)
+    )
+    st_spec = pl.BlockSpec((1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0))
+    return x_spec, dt_spec, bc_spec, st_spec
+
+
+def _chunked_inputs(x, dt, A, B, C, chunk_size):
+    """Shared fwd/bwd preprocessing: chunk reshapes + in-chunk log-decay."""
     b, t, h, p = x.shape
     g, n = B.shape[2], B.shape[3]
     l = _divisor_chunk(t, chunk_size)
     nc = t // l
     hb = _heads_per_block(h, p, g)
-    nhb = h // hb
 
     dtf = dt.astype(jnp.float32)
     dA = dtf * A.astype(jnp.float32)                 # (b, t, h)
@@ -128,23 +142,33 @@ def _ssd_pallas_fwd_impl(
     dtr = dtf.reshape(b, nc, l, h)
     Br = B.reshape(b, nc, l, g, n)
     Cr = C.reshape(b, nc, l, g, n)
+    return xr, dtr, a_cum, chunk_decay, Br, Cr, (b, nc, l, h, hb, p, g, n)
+
+
+def _ssd_pallas_fwd_impl(
+    x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
+):
+    """Forward via the two kernels + XLA state passing.
+
+    Shapes: x (b,t,h,p); dt (b,t,h) [bias-added+softplused]; A (h,);
+    B/C (b,t,g,n).  Returns (y_no_D (b,t,h,p) fp32-accurate, final_state).
+    """
+    xr, dtr, a_cum, chunk_decay, Br, Cr, dims = _chunked_inputs(
+        x, dt, A, B, C, chunk_size
+    )
+    b, nc, l, h, hb, p, g, n = dims
+    t = nc * l
+    nhb = h // hb
 
     grid = (b, nc, nhb)
-    # index maps: (bi, ci, hi) -> block indices; B/C pick the head-block's group
-    x_spec = pl.BlockSpec((1, 1, l, hb, p), lambda bi, ci, hi: (bi, ci, 0, hi, 0))
-    dt_spec = pl.BlockSpec((1, 1, l, hb), lambda bi, ci, hi: (bi, ci, 0, hi))
-    bc_spec = pl.BlockSpec(
-        (1, 1, l, 1, n), lambda bi, ci, hi: (bi, ci, 0, (hi * hb * g) // h, 0)
-    )
+    x_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
 
     states = pl.pallas_call(
         functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
         grid=grid,
         in_specs=[x_spec, dt_spec, dt_spec, bc_spec],
-        out_specs=pl.BlockSpec(
-            (1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
-        ),
+        out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
     )(xr, dtr, a_cum, Br)
@@ -155,16 +179,240 @@ def _ssd_pallas_fwd_impl(
         functools.partial(_chunk_output_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, l, h, p), x.dtype),
         grid=grid,
-        in_specs=[
-            x_spec, dt_spec, dt_spec, bc_spec, bc_spec,
-            pl.BlockSpec((1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
-        ],
+        in_specs=[x_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec],
         out_specs=x_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
     )(xr, dtr, a_cum, Br, Cr, prev_states)
 
     return y.reshape(b, t, h, p), final_state
+
+
+# ---------------------------------------------------------------------------
+# Backward pass (training path): Pallas kernels + tiny XLA glue.
+#
+# Forward decomposition per chunk (head h, in-chunk log-decay a = cumsum(dt*A)):
+#   y_diag = (G .* L) @ (dt*x)      G[i,j] = <C_i, B_j>, L[i,j] = e^{a_i-a_j}
+#   S      = sum_j e^{a_L-a_j} dt_j x_j (x) B_j     (per-chunk state summary)
+#   P_{c+1} = gamma_c P_c + S_c,  gamma_c = e^{a_L}  (inter-chunk recurrence)
+#   y_off  = diag(e^a) C @ P_c^T
+# The backward mirrors it: (1) Pallas kernel for the direct state gradient
+# dP_c = dY^T (e^a .* C); (2) XLA *reverse* associative scan for
+# gP_c = dP_c + gamma_c gP_{c+1} (=> dS_c = gP_{c+1}, dgamma_c = <dS_c, P_c>);
+# (3) one Pallas cell kernel for dx/ddt/da/dB/dC with L rebuilt in VMEM;
+# (4) XLA epilogue pushing the in-chunk log-decay gradient `da` through the
+# cumsum chain into ddt and dA.
+# ---------------------------------------------------------------------------
+
+
+def _dstate_direct_kernel(dy_ref, acum_ref, C_ref, out_ref, *, compute_dtype):
+    """Direct gradient of the chunk-entering state: dP = dY^T @ (e^a .* C)."""
+    a = acum_ref[0, 0]                               # (l, hb) fp32
+    Cb = C_ref[0, 0, :, 0]                           # (l, n)
+    dy = dy_ref[0, 0]                                # (l, hb, p)
+
+    e = jnp.exp(a)                                   # (l, hb), <= 1
+    eC = e.T[:, :, None] * Cb[None].astype(jnp.float32)          # (hb, l, n)
+    dyt = jnp.transpose(dy, (1, 2, 0)).astype(compute_dtype)     # (hb, p, l)
+    out_ref[0, 0] = jax.lax.dot_general(
+        dyt, eC.astype(compute_dtype), (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                                # (hb, p, n)
+
+
+def _ssd_bwd_cell_kernel(
+    x_ref, dt_ref, acum_ref, B_ref, C_ref, prev_ref, dy_ref, dS_ref,
+    dx_ref, ddt_ref, da_ref, dB_ref, dC_ref, *, compute_dtype,
+):
+    """All per-cell input gradients for one (batch, chunk, head-block).
+
+    Outputs: dx (l,hb,p); ddt_direct (l,hb) [the dt*x product-rule term];
+    da (l,hb) [grad wrt the in-chunk cumulative log-decay, pushed through
+    the cumsum chain by the XLA epilogue]; dB/dC (l,n) per head-block
+    [summed over a group's head-blocks outside].
+    """
+    cd = compute_dtype
+    a = acum_ref[0, 0]                               # (l, hb) fp32
+    dt = dt_ref[0, 0]                                # (l, hb) fp32
+    x = x_ref[0, 0].astype(jnp.float32)              # (l, hb, p)
+    Bb = B_ref[0, 0, :, 0]                           # (l, n)
+    Cb = C_ref[0, 0, :, 0]                           # (l, n)
+    P = prev_ref[0, 0]                               # (hb, p, n) fp32
+    dy = dy_ref[0, 0].astype(jnp.float32)            # (l, hb, p)
+    dS = dS_ref[0, 0]                                # (hb, p, n) fp32
+    l = a.shape[0]
+
+    e = jnp.exp(a)                                   # (l, hb)
+    d = jnp.exp(a[-1:, :] - a)                       # (l, hb) decay-to-end
+    u = x * dt[:, :, None]                           # (l, hb, p)
+    ut = jnp.transpose(u, (1, 0, 2))                 # (hb, l, p)
+    dyt = jnp.transpose(dy, (1, 0, 2))               # (hb, l, p)
+
+    # --- intra-chunk: y_diag = (G .* L) @ u -------------------------------
+    G = jnp.dot(Cb.astype(cd), Bb.astype(cd).T,
+                preferred_element_type=jnp.float32)  # (l, l) group-shared
+    ii = jax.lax.broadcasted_iota(jnp.int32, (l, l), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (l, l), 1)
+    tril = ii >= jj
+    diff = a.T[:, :, None] - a.T[:, None, :]         # (hb, l, l)
+    Lm = jnp.exp(jnp.where(tril[None], diff, -jnp.inf))          # (hb, l, l)
+    M = G[None] * Lm                                 # (hb, l, l) fp32
+
+    dM = jax.lax.dot_general(                        # dM = dY @ u^T
+        dyt.astype(cd), ut.astype(cd), (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                                # (hb, l, l)
+    du = jax.lax.dot_general(                        # du = M^T @ dY
+        jnp.transpose(M, (0, 2, 1)).astype(cd), dyt.astype(cd),
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                                # (hb, l, p)
+
+    dMM = dM * M                                     # = dL .* L .* G
+    da = (jnp.sum(dMM, axis=2) - jnp.sum(dMM, axis=1)).T         # (l, hb)
+    dG = jnp.sum(dM * Lm, axis=0)                    # (l, l), masked by Lm
+    dB_acc = jnp.dot(dG.T.astype(cd), Cb.astype(cd),
+                     preferred_element_type=jnp.float32)         # (l, n)
+    dC_acc = jnp.dot(dG.astype(cd), Bb.astype(cd),
+                     preferred_element_type=jnp.float32)         # (l, n)
+
+    # --- off-diagonal: y_off = diag(e) C @ P^T ----------------------------
+    T = jax.lax.dot_general(                         # T = dY @ P
+        dyt.astype(cd), P.astype(cd), (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )                                                # (hb, l, n)
+    dC_acc = dC_acc + jnp.sum(e.T[:, :, None] * T, axis=0)
+    de = jnp.sum(T * Cb[None].astype(jnp.float32), axis=2)       # (hb, l)
+    da = da + de.T * e
+
+    # --- state summary: S = sum_j d_j u_j (x) B_j -------------------------
+    dwt = jnp.transpose(                             # dw = dS @ B^T per head
+        jax.lax.dot_general(
+            dS.astype(cd), Bb.astype(cd), (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),                                           # (hb, p, l)
+        (0, 2, 1),
+    )                                                # (hb, l, p)
+    dT = d.T                                         # (hb, l)
+    wt = ut * dT[:, :, None]                         # (hb, l, p)
+    dB_acc = dB_acc + jnp.sum(
+        jax.lax.dot_general(
+            jnp.transpose(wt, (0, 2, 1)).astype(cd), dS.astype(cd),
+            (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ),
+        axis=0,
+    )                                                # (l, n)
+    du = du + dT[:, :, None] * dwt
+    dd = jnp.sum(ut * dwt, axis=2)                   # (hb, l)
+    ddd = dd * dT                                    # chain through exp
+    da = da - ddd.T
+    da = da.at[-1].add(jnp.sum(ddd, axis=1))
+
+    # --- u = dt * x product rule ------------------------------------------
+    du_l = jnp.transpose(du, (1, 0, 2))              # (l, hb, p)
+    dx_ref[0, 0] = (dt[:, :, None] * du_l).astype(dx_ref.dtype)
+    ddt_ref[0, 0] = jnp.sum(x * du_l, axis=2)
+    da_ref[0, 0] = da
+    dB_ref[0, 0, 0] = dB_acc
+    dC_ref[0, 0, 0] = dC_acc
+
+
+def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret):
+    """Full backward: recompute chunk states, reverse-scan, cell kernel."""
+    xr, dtr, a_cum, chunk_decay, Br, Cr, dims = _chunked_inputs(
+        x, dt, A, B, C, chunk_size
+    )
+    b, nc, l, h, hb, p, g, n = dims
+    t = nc * l
+    nhb = h // hb
+    grid = (b, nc, nhb)
+    x_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
+    dyr = dy.reshape(b, nc, l, h, p)
+
+    # recompute the chunk summaries + entering states (remat, like the
+    # reference dep's Triton backward which re-derives chunk states)
+    states = pl.pallas_call(
+        functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
+        out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
+        grid=grid,
+        in_specs=[x_spec, dt_spec, dt_spec, bc_spec],
+        out_specs=st_spec,
+        compiler_params=_PARALLEL3,
+        interpret=interpret,
+    )(xr, dtr, a_cum, Br)
+    prev_states, _ = state_passing(states, chunk_decay)
+
+    # direct state gradient from each chunk's off-diagonal output
+    dP = pl.pallas_call(
+        functools.partial(_dstate_direct_kernel, compute_dtype=compute_dtype),
+        out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
+        grid=grid,
+        in_specs=[x_spec, dt_spec, bc_spec],
+        out_specs=st_spec,
+        compiler_params=_PARALLEL3,
+        interpret=interpret,
+    )(dyr, a_cum, Cr)
+
+    # reverse associative scan: gP_c = dP_c + gamma_c * gP_{c+1}
+    decay = chunk_decay[..., None, None]             # (b, nc, h, 1, 1)
+
+    def combine(left, right):
+        a_l, s_l = left
+        a_r, s_r = right
+        return a_l * a_r, s_l * a_r + s_r
+
+    _, gP_rev = jax.lax.associative_scan(
+        combine, (jnp.flip(decay, 1), jnp.flip(dP, 1)), axis=1
+    )
+    gP = jnp.flip(gP_rev, 1)
+    dS = jnp.concatenate([gP[:, 1:], jnp.zeros_like(gP[:, :1])], axis=1)
+    dgamma = jnp.sum(dS * prev_states, axis=(3, 4))  # (b, nc, h)
+
+    dx_c, ddt_dir, da, dB_cell, dC_cell = pl.pallas_call(
+        functools.partial(_ssd_bwd_cell_kernel, compute_dtype=compute_dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, nc, l, h, p), x.dtype),
+            jax.ShapeDtypeStruct((b, nc, l, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, l, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, nhb, l, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, nhb, l, n), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[x_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec,
+                  x_spec, st_spec],
+        out_specs=(
+            x_spec,
+            dt_spec,
+            dt_spec,
+            pl.BlockSpec((1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
+        ),
+        compiler_params=_PARALLEL3,
+        interpret=interpret,
+    )(xr, dtr, a_cum, Br, Cr, prev_states, dyr, dS)
+
+    # --- XLA epilogue: push `da` through the cumsum chain -----------------
+    da = da.at[:, :, -1, :].add(dgamma * chunk_decay)
+    ddA = jnp.flip(jnp.cumsum(jnp.flip(da, 2), axis=2), 2)       # (b, nc, l, h)
+    Af = A.astype(jnp.float32)
+    ddt = (ddt_dir + ddA * Af[None, None, None]).reshape(b, t, h)
+    dA = jnp.sum(ddA * dtr, axis=(0, 1, 2))
+
+    # group-sum the per-head-block B/C gradients (blocks are head-ordered,
+    # so a group's nhb/g blocks are consecutive)
+    dB_g = dB_cell.reshape(b, nc, g, nhb // g, l, n).sum(axis=3)
+    dC_g = dC_cell.reshape(b, nc, g, nhb // g, l, n).sum(axis=3)
+    dB = jnp.transpose(dB_g, (0, 1, 3, 2, 4)).reshape(b, t, g, n)
+    dC = jnp.transpose(dC_g, (0, 1, 3, 2, 4)).reshape(b, t, g, n)
+
+    return (
+        dx_c.reshape(b, t, h, p),
+        ddt.astype(dt.dtype),
+        dA.astype(A.dtype),
+        dB.astype(B.dtype),
+        dC.astype(C.dtype),
+    )
 
 
 def _add_D(y, x, D):
@@ -193,20 +441,11 @@ def _core_fwd(x, dt, A, B, C, chunk_size, compute_dtype, interpret):
 
 
 def _core_bwd(chunk_size, compute_dtype, interpret, res, dy):
-    """Backward through the einsum formulation — same math, XLA autodiff."""
-    from mamba_distributed_tpu.ops.ssd import ssd_chunked
-
+    """Pallas backward (see the backward section above)."""
     x, dt, A, B, C = res
-
-    def f(x, dt, A, B, C):
-        # dt here is already softplus-ed; ssd_chunked takes it as-is
-        return ssd_chunked(
-            x, dt, A, B, C, chunk_size=chunk_size, D=None,
-            compute_dtype=compute_dtype,
-        )
-
-    _, vjp = jax.vjp(f, x, dt, A, B, C)
-    return vjp(dy)
+    return _ssd_pallas_bwd_impl(
+        x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret
+    )
 
 
 _ssd_pallas_core.defvjp(_core_fwd, _core_bwd)

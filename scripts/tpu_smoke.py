@@ -82,6 +82,52 @@ def main() -> None:
         _progress("m1 scan pallas compiled+ran on hardware")
         report("m1_scan_pallas_fwd_vs_xla_fp32", got, ref, atol=5e-3)
 
+        # --- odd d: lane-pad fallback must lower on real Mosaic ---
+        do = 96
+        ref = jax.jit(lambda *a: selective_scan(*a, delta_softplus=True))(
+            u[..., :do], delta[..., :do], A1[:do], B1, C1
+        )
+        got = jax.jit(lambda *a: selective_scan_pallas(*a, delta_softplus=True))(
+            u[..., :do], delta[..., :do], A1[:do], B1, C1
+        )
+        jax.block_until_ready(got)
+        _progress("m1 odd-d (96) pallas compiled+ran on hardware")
+        report("m1_scan_pallas_odd_d_fwd", got, ref, atol=5e-3)
+
+        # --- backward kernels: Mosaic-lower the full custom-vjp path ---
+        def ssd_loss(fn, **kw):
+            return lambda *a: jnp.sum(
+                fn(*a, chunk_size=256, D=D, compute_dtype=jnp.float32, **kw)
+                ** 2
+            )
+
+        g_ref = jax.jit(jax.grad(ssd_loss(ssd_chunked), (0, 1, 2, 3, 4)))(
+            x, dt, A, B, C
+        )
+        g_pal = jax.jit(jax.grad(ssd_loss(ssd_chunked_pallas), (0, 1, 2, 3, 4)))(
+            x, dt, A, B, C
+        )
+        jax.block_until_ready(g_pal)
+        _progress("ssd pallas BACKWARD compiled+ran on hardware")
+        for name, a, bb in zip("x dt A B C".split(), g_ref, g_pal):
+            scale = float(jnp.max(jnp.abs(a))) or 1.0
+            report(f"ssd_pallas_bwd_d{name}", bb / scale, a / scale, atol=2e-2)
+
+        def m1_loss(fn):
+            return lambda *a: jnp.sum(fn(*a, delta_softplus=True) ** 2)
+
+        g_ref = jax.jit(jax.grad(m1_loss(selective_scan), (0, 1, 2, 3, 4)))(
+            u, delta, A1, B1, C1
+        )
+        g_pal = jax.jit(jax.grad(m1_loss(selective_scan_pallas), (0, 1, 2, 3, 4)))(
+            u, delta, A1, B1, C1
+        )
+        jax.block_until_ready(g_pal)
+        _progress("m1 scan pallas BACKWARD compiled+ran on hardware")
+        for name, a, bb in zip("u dt A B C".split(), g_ref, g_pal):
+            scale = float(jnp.max(jnp.abs(a))) or 1.0
+            report(f"m1_pallas_bwd_d{name}", bb / scale, a / scale, atol=2e-2)
+
     raise SystemExit(0 if ok else 1)
 
 
