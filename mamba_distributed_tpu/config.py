@@ -65,7 +65,10 @@ class ModelConfig:
     attn_layer_idx: tuple[int, ...] = ()
     attn_num_heads: int = 0  # 0 => auto: d_model // 64
     attn_num_kv_heads: int = 0  # 0 => same as attn_num_heads (MHA)
-    attn_rotary_dim: int = 0  # 0 => full head dim
+    attn_head_dim: int = 0  # 0 => auto: d_model // num_heads
+    # -1 => full head dim; 0 => NO rotary (mamba_ssm MHA's rotary_emb_dim
+    # convention, so imported hybrid configs keep their semantics)
+    attn_rotary_dim: int = -1
     rope_theta: float = 10000.0
 
     # --- precision policy (reference: bf16 autocast + fp32 master weights,
@@ -135,6 +138,10 @@ class ModelConfig:
     def effective_attn_num_kv_heads(self) -> int:
         return self.attn_num_kv_heads or self.effective_attn_num_heads
 
+    @property
+    def effective_attn_head_dim(self) -> int:
+        return self.attn_head_dim or self.d_model // self.effective_attn_num_heads
+
     def num_params(self) -> int:
         """Analytic parameter count (used for MFU and sanity checks)."""
         d, v = self.d_model, self.vocab_size_padded
@@ -148,7 +155,7 @@ class ModelConfig:
             if i in self.attn_layer_idx:
                 nh = self.effective_attn_num_heads
                 nkv = self.effective_attn_num_kv_heads
-                hd = d // nh
+                hd = self.effective_attn_head_dim
                 n += d * (nh * hd) + 2 * d * (nkv * hd) + (nh * hd) * d
             elif self.ssm_layer == "mamba1":
                 dtr = self.effective_dt_rank
@@ -185,24 +192,27 @@ class MeshConfig:
     fsdp  - data parallel + param/optimizer-state sharding (ZeRO-3 style)
     seq   - sequence/context parallelism (SSD chunk-state passing, ring attn)
     tensor- tensor parallelism over d_inner/heads
+    pipe  - GPipe pipeline stages over the layer stack (the grad-accum
+            microbatches feed the pipeline; parallel/pipeline.py)
     """
 
     data: int = 1
     fsdp: int = 1
     seq: int = 1
     tensor: int = 1
+    pipe: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.seq * self.tensor
+        return self.data * self.fsdp * self.seq * self.tensor * self.pipe
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("data", "fsdp", "seq", "tensor")
+        return ("data", "fsdp", "seq", "tensor", "pipe")
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.seq, self.tensor)
+        return (self.data, self.fsdp, self.seq, self.tensor, self.pipe)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -250,6 +260,25 @@ class TrainConfig:
     # FSDP / remat
     shard_params: bool = False  # shard params+opt state over the fsdp axis
     remat: bool = True  # per-block activation checkpointing
+
+    def __post_init__(self):
+        m = self.mesh
+        if m.pipe > 1 and (m.data * m.fsdp * m.seq * m.tensor) > 1:
+            # the GPipe schedule declares activations replicated over every
+            # non-pipe axis, so composing would silently all-gather the
+            # batch/params instead of parallelizing — reject loudly
+            raise ValueError(
+                f"mesh.pipe={m.pipe} cannot yet combine with other mesh "
+                f"axes (data={m.data}, fsdp={m.fsdp}, seq={m.seq}, "
+                f"tensor={m.tensor}); use pipe alone or pipe=1"
+            )
+        if m.pipe > 1 and self.model.attn_layer_idx:
+            raise ValueError("pipeline parallelism needs a uniform layer stack")
+        if m.pipe > 1 and self.model.n_layer % m.pipe != 0:
+            raise ValueError(
+                f"n_layer={self.model.n_layer} must divide over "
+                f"mesh.pipe={m.pipe} stages"
+            )
 
     @property
     def grad_accum_steps(self) -> int:

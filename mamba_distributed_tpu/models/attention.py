@@ -24,8 +24,9 @@ from mamba_distributed_tpu.models.common import init_linear, linear
 def _attn_dims(cfg: ModelConfig):
     nh = cfg.effective_attn_num_heads
     nkv = cfg.effective_attn_num_kv_heads
-    hd = cfg.d_model // nh
-    rot = cfg.attn_rotary_dim or hd
+    hd = cfg.effective_attn_head_dim
+    # -1 => full head dim; 0 => no rotary (mamba_ssm's rotary_emb_dim)
+    rot = hd if cfg.attn_rotary_dim < 0 else cfg.attn_rotary_dim
     return nh, nkv, hd, rot
 
 
@@ -55,18 +56,22 @@ def rope_angles(positions: jax.Array, rotary_dim: int, theta: float) -> jax.Arra
 def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     """Rotate the leading ``2*angles.shape[-1]`` channels of each head.
 
-    x (b, t, h, hd); angles (t, rot/2).  Interleaved (GPT-NeoX "rotate
-    half") convention on the rotary slice; the tail passes through.
+    x (b, t, h, hd); angles (t, rot/2).  Rotate-half (GPT-NeoX,
+    non-interleaved) convention on the rotary slice — pairs are
+    (x[i], x[i + rot/2]) — matching the flash-attn RotaryEmbedding
+    default (``interleaved=False``) that mamba_ssm's MHA layers use, so
+    hybrid checkpoints import with bit-compatible attention semantics.
+    The tail past the rotary slice passes through.
     """
     rot = 2 * angles.shape[-1]
     xr, x_pass = x[..., :rot], x[..., rot:]
-    xf = xr.astype(jnp.float32).reshape(*xr.shape[:-1], rot // 2, 2)
-    x1, x2 = xf[..., 0], xf[..., 1]
+    xf = xr.astype(jnp.float32)
+    x1, x2 = xf[..., : rot // 2], xf[..., rot // 2 :]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     o1 = x1 * cos - x2 * sin
     o2 = x1 * sin + x2 * cos
-    out = jnp.stack([o1, o2], axis=-1).reshape(xr.shape).astype(x.dtype)
+    out = jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
     return jnp.concatenate([out, x_pass], axis=-1) if x_pass.size else out
 
 
@@ -120,9 +125,10 @@ def attention_mixer(
 
     qkv = linear(params["wqkv"], u, compute_dtype)
     q, k, v = _split_qkv(qkv, cfg)
-    angles = rope_angles(jnp.arange(t), rot, cfg.rope_theta)
-    q = apply_rope(q, angles)
-    k = apply_rope(k, angles)
+    if rot > 0:
+        angles = rope_angles(jnp.arange(t), rot, cfg.rope_theta)
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
 
     if seq_ctx is not None:
         from mamba_distributed_tpu.parallel.ring_attention import ring_attention
@@ -160,9 +166,10 @@ def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array, state):
 
     qkv = linear(params["wqkv"], u_t[:, None, :], compute_dtype)
     q, k, v = _split_qkv(qkv, cfg)
-    angles = rope_angles(length[None], rot, cfg.rope_theta)
-    q = apply_rope(q, angles)
-    k = apply_rope(k, angles)
+    if rot > 0:
+        angles = rope_angles(length[None], rot, cfg.rope_theta)
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
 
     k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), length, axis=1)
     v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), length, axis=1)

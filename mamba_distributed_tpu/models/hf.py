@@ -46,6 +46,21 @@ def config_from_hf_json(config_data: dict) -> ModelConfig:
     ]:
         if src in ssm_cfg:
             kw[dst] = ssm_cfg[src]
+    # hybrid (Jamba-style): MambaConfig.attn_layer_idx + attn_cfg
+    # (mamba_ssm MHA naming: num_heads / num_heads_kv / head_dim /
+    # rotary_emb_dim — whose default 0 means NO rotary, matching our
+    # attn_rotary_dim=0; our "full head dim" is -1)
+    attn_idx = config_data.get("attn_layer_idx") or []
+    if attn_idx:
+        attn_cfg = config_data.get("attn_cfg") or {}
+        kw["attn_layer_idx"] = tuple(attn_idx)
+        if "num_heads" in attn_cfg:
+            kw["attn_num_heads"] = attn_cfg["num_heads"]
+        if "num_heads_kv" in attn_cfg:
+            kw["attn_num_kv_heads"] = attn_cfg["num_heads_kv"]
+        if "head_dim" in attn_cfg:
+            kw["attn_head_dim"] = attn_cfg["head_dim"]
+        kw["attn_rotary_dim"] = attn_cfg.get("rotary_emb_dim", 0)
     return ModelConfig(**kw)
 
 
@@ -57,11 +72,43 @@ def _np(t) -> np.ndarray:
 
 
 def import_state_dict(state_dict: dict, cfg: ModelConfig) -> dict:
-    """torch MambaLMHeadModel state dict -> layer-stacked JAX param tree."""
+    """torch MambaLMHeadModel state dict -> layer-stacked JAX param tree.
+
+    Hybrid (Jamba-style) checkpoints interleave MHA mixers at
+    ``attn_layer_idx`` (mamba_ssm's ``MHA`` module: packed ``Wqkv`` +
+    ``out_proj``); those layers land in the separately-stacked
+    ``attn_blocks`` tree, matching ``init_lm_params``'s split.
+    """
     sd = {k: _np(v) for k, v in state_dict.items()}
     n = cfg.n_layer
-    if cfg.attn_layer_idx:
-        raise NotImplementedError("hybrid HF import not supported yet")
+    attn_idx = set(cfg.attn_layer_idx or ())
+
+    def attn_layer(i: int) -> dict:
+        pre = f"backbone.layers.{i}."
+        wqkv = sd[pre + "mixer.Wqkv.weight"]
+        nh = cfg.effective_attn_num_heads
+        nkv = cfg.effective_attn_num_kv_heads
+        hd = cfg.effective_attn_head_dim
+        want = (nh + 2 * nkv) * hd
+        if wqkv.shape[0] != want:
+            raise ValueError(
+                f"layer {i}: Wqkv rows {wqkv.shape[0]} != "
+                f"(nh={nh} + 2*nkv={nkv}) * head_dim={hd} = {want}; "
+                "check attn_cfg (num_heads/num_heads_kv/head_dim)"
+            )
+        mixer = {"wqkv": {"kernel": wqkv.T},
+                 "out_proj": {"kernel": sd[pre + "mixer.out_proj.weight"].T}}
+        for name, ours in [("Wqkv", "wqkv"), ("out_proj", "out_proj")]:
+            if pre + f"mixer.{name}.bias" in sd:
+                mixer[ours]["bias"] = sd[pre + f"mixer.{name}.bias"]
+        block = {"norm": {"weight": sd[pre + "norm.weight"]}, "mixer": mixer}
+        if cfg.d_intermediate > 0:
+            block["norm2"] = {"weight": sd[pre + "norm2.weight"]}
+            block["mlp"] = {
+                "fc1": {"kernel": sd[pre + "mlp.fc1.weight"].T},
+                "fc2": {"kernel": sd[pre + "mlp.fc2.weight"].T},
+            }
+        return block
 
     def layer(i: int) -> dict:
         pre = f"backbone.layers.{i}."
@@ -96,10 +143,17 @@ def import_state_dict(state_dict: dict, cfg: ModelConfig) -> dict:
             }
         return block
 
-    layers = [layer(i) for i in range(n)]
     import jax
 
-    blocks = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *layers)
+    def stack(trees):
+        return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *trees)
+
+    blocks = stack([layer(i) for i in range(n) if i not in attn_idx])
+    attn_blocks = (
+        stack([attn_layer(i) for i in range(n) if i in attn_idx])
+        if attn_idx
+        else None
+    )
 
     emb = sd["backbone.embedding.weight"]
     vp = cfg.vocab_size_padded
@@ -112,6 +166,8 @@ def import_state_dict(state_dict: dict, cfg: ModelConfig) -> dict:
         "blocks": blocks,
         "norm_f": {"weight": jnp.asarray(sd["backbone.norm_f.weight"])},
     }
+    if attn_blocks is not None:
+        params["attn_blocks"] = attn_blocks
     if not cfg.tie_embeddings and "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": jnp.asarray(sd["lm_head.weight"].T)}
     return params

@@ -6,6 +6,8 @@ the layout transforms: transposes, conv squeeze, layer stacking, vocab
 padding, tied-head drop.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -169,3 +171,91 @@ def test_import_mamba1_runs():
     logits = lm_forward(params, M1_CFG, x)
     assert logits.shape == (2, 16, M1_CFG.vocab_size_padded)
     assert bool(np.isfinite(np.asarray(logits)).all())
+
+
+HYBRID_CFG = ModelConfig(d_model=32, n_layer=3, vocab_size=61,
+                         ssm_layer="mamba2", headdim=8, chunk_size=16,
+                         d_state=16, attn_layer_idx=(1,), attn_num_heads=4,
+                         attn_num_kv_heads=2, compute_dtype="float32")
+
+
+def hybrid_synthetic_state_dict(cfg: ModelConfig, seed=0) -> dict:
+    g = torch.Generator().manual_seed(seed)
+    r = lambda *s: torch.randn(*s, generator=g) * 0.05
+    sd = synthetic_state_dict(cfg, seed)
+    nh, nkv = cfg.effective_attn_num_heads, cfg.effective_attn_num_kv_heads
+    hd = cfg.d_model // nh
+    for i in cfg.attn_layer_idx:
+        pre = f"backbone.layers.{i}."
+        # replace the mamba mixer keys with mamba_ssm MHA naming
+        for k in list(sd):
+            if k.startswith(pre + "mixer."):
+                del sd[k]
+        sd[pre + "mixer.Wqkv.weight"] = r((nh + 2 * nkv) * hd, cfg.d_model)
+        sd[pre + "mixer.out_proj.weight"] = r(cfg.d_model, nh * hd)
+    return sd
+
+
+def test_hybrid_import_roundtrip():
+    """Wqkv/out_proj transposes, attn_blocks split + stacking order."""
+    import jax
+
+    from mamba_distributed_tpu.models import init_lm_params
+
+    sd = hybrid_synthetic_state_dict(HYBRID_CFG)
+    params = import_state_dict(sd, HYBRID_CFG)
+    ref = init_lm_params(jax.random.PRNGKey(0), HYBRID_CFG)
+    # structural match with the initializer's tree (same stacking split)
+    assert jax.tree.structure(params) == jax.tree.structure(ref)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(ref)):
+        assert a.shape == b.shape, (a.shape, b.shape)
+    np.testing.assert_allclose(
+        np.asarray(params["attn_blocks"]["mixer"]["wqkv"]["kernel"][0]),
+        sd["backbone.layers.1.mixer.Wqkv.weight"].numpy().T,
+    )
+    # mamba layers 0 and 2 stack into blocks[0], blocks[1]
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["mixer"]["in_proj"]["kernel"][1]),
+        sd["backbone.layers.2.mixer.in_proj.weight"].numpy().T,
+    )
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 61)
+    logits = lm_forward(params, HYBRID_CFG, x)
+    assert bool(np.isfinite(np.asarray(logits, dtype=np.float32)).all())
+
+
+def test_hybrid_config_from_json():
+    cfg = config_from_hf_json({
+        "d_model": 64, "n_layer": 4, "vocab_size": 61,
+        "ssm_cfg": {"layer": "Mamba2", "headdim": 8},
+        "attn_layer_idx": [1, 3],
+        "attn_cfg": {"num_heads": 8, "num_heads_kv": 2,
+                     "rotary_emb_dim": 4, "causal": True},
+    })
+    assert cfg.attn_layer_idx == (1, 3)
+    assert cfg.effective_attn_num_heads == 8
+    assert cfg.effective_attn_num_kv_heads == 2
+    assert cfg.attn_rotary_dim == 4
+
+
+def test_hybrid_head_dim_and_rotary_semantics():
+    """mamba_ssm attn_cfg semantics: head_dim may differ from
+    d_model//num_heads, and rotary_emb_dim's default 0 means NO rotary."""
+    cfg = config_from_hf_json({
+        "d_model": 64, "n_layer": 4, "vocab_size": 61,
+        "ssm_cfg": {"layer": "Mamba2", "headdim": 8},
+        "attn_layer_idx": [1],
+        "attn_cfg": {"num_heads": 4, "head_dim": 32},  # 4*32 != 64
+    })
+    assert cfg.effective_attn_head_dim == 32
+    assert cfg.attn_rotary_dim == 0  # absent => no rotary, not full-dim
+
+    # a mis-sized Wqkv is rejected with a clear error, not garbage
+    bad = ModelConfig(d_model=32, n_layer=2, vocab_size=61, ssm_layer="mamba2",
+                      headdim=8, chunk_size=16, d_state=16,
+                      attn_layer_idx=(1,), attn_num_heads=4,
+                      compute_dtype="float32")
+    sd = hybrid_synthetic_state_dict(
+        dataclasses.replace(bad, attn_num_kv_heads=2)
+    )
+    with pytest.raises(ValueError, match="Wqkv rows"):
+        import_state_dict(sd, bad)  # bad expects MHA (nkv=4), sd packs nkv=2
