@@ -12,10 +12,10 @@ direction XLA's sharding propagation can't infer):
     device, which uses them as ``initial_state`` — exactly the decode-cache
     hook `ops/conv.py` exposes.
   * SSD state passing: each device computes its local per-chunk states and
-    a (decay, final_state) summary; summaries are all-gathered over the seq
-    axis (S entries of (b,h)+(b,h,p,n) — tiny), every device combines the
-    prefix before it into its incoming state, and re-runs the local
-    associative state pass seeded with it.
+    a (decay, final_state) summary; an exclusive prefix scan over the seq
+    axis (log2(S) distance-doubling ppermute rounds, O(d_state) traffic
+    each) hands every device its incoming state, and the local associative
+    state pass re-runs seeded with it.
 
 Both transforms are exact: sharded output == single-device output to fp32
 tolerance (pinned by tests/test_seq_parallel.py).
@@ -152,29 +152,42 @@ def _incoming_state(ctx: SeqContext, decay_total, final_local):
     """Combine per-rank (decay, final-state) summaries into each rank's
     incoming state: sum over ranks j < idx of final_j * prod_{j<m<idx} decay_m.
 
-    decay_total/final_local have matching shapes (decay broadcastable over
-    final); both are all-gathered over the seq axis (tiny: O(state), not
-    O(T)).  Shared by the SSD and selective-scan SP paths.
+    Implemented as an **exclusive prefix scan over the seq axis** via
+    log2(S) distance-doubling ``ppermute`` rounds (Hillis-Steele on the
+    associative pair combine (a, s) o (a', s') = (a a', s a' + s')),
+    followed by a single shift-by-one.  Per round each rank moves one
+    O(state) summary over ICI — total O(log S) latency and O(log S *
+    state) traffic, vs the O(S * state) every-rank footprint of an
+    all-gather formulation; nothing of size S is ever resident.
+    ``ppermute`` delivers zeros to ranks with no sender, which is the
+    combine's identity for ``s`` but not for ``a`` — those lanes are
+    patched to the identity (a=1) by rank index.  ``decay_total`` must be
+    broadcastable over ``final_local``.  Shared by the SSD and
+    selective-scan SP paths.
     """
     n = ctx.size
-    idx = jax.lax.axis_index(ctx.axis)
-    decays = jax.lax.all_gather(decay_total, ctx.axis)  # (S, ...)
-    finals = jax.lax.all_gather(final_local, ctx.axis)  # (S, ...)
-    ranks = jnp.arange(n)
-    extra = (1,) * (decays.ndim - 1)
+    if n == 1:
+        return jnp.zeros_like(final_local)
+    axis = ctx.axis
+    idx = jax.lax.axis_index(axis)
 
-    def suffix_prod(j):
-        mask = ((ranks > j) & (ranks < idx)).astype(decays.dtype)
-        mask = mask.reshape(n, *extra)
-        return jnp.prod(decays * mask + (1.0 - mask), axis=0)
+    a = decay_total
+    s = final_local
+    bcast = lambda v: v.reshape(v.shape + (1,) * (s.ndim - v.ndim))
 
-    suffixes = jax.vmap(suffix_prod)(ranks)  # (S, ...)
-    contrib = (ranks < idx).astype(decays.dtype).reshape(n, *extra)
-    scale = suffixes * contrib
-    # broadcast decay-shaped scale up to the final-state shape
-    while scale.ndim < finals.ndim:
-        scale = scale[..., None]
-    return jnp.sum(finals * scale, axis=0)
+    d = 1
+    while d < n:
+        perm = [(i, i + d) for i in range(n - d)]
+        a_in = jax.lax.ppermute(a, axis, perm)
+        s_in = jax.lax.ppermute(s, axis, perm)
+        a_in = jnp.where(idx >= d, a_in, jnp.ones_like(a_in))
+        # left-prefix (received) combined into the local value
+        s = s_in * bcast(a) + s
+        a = a_in * a
+        d *= 2
+
+    # inclusive -> exclusive: state entering rank r = prefix through r-1
+    return jax.lax.ppermute(s, axis, [(i, i + 1) for i in range(n - 1)])
 
 
 def sp_selective_scan(

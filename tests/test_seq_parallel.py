@@ -259,3 +259,45 @@ def test_trainer_seq_parallel_matches_single_device(tmp_path):
         mesh=MeshConfig(data=2, seq=4),
     )
     np.testing.assert_allclose(ref, sp, rtol=2e-4)
+
+
+@pytest.fixture
+def ctx8():
+    """All 8 virtual devices on the seq axis: 3 doubling rounds + shift."""
+    from mamba_distributed_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(MeshConfig(seq=8))
+    return SeqContext(mesh, "seq")
+
+
+def test_sp_ssd_seq8_matches_full(ctx8, rng):
+    """seq=8: the exclusive-prefix ppermute chain must stay exact through
+    multiple doubling distances (1, 2, 4)."""
+    x, dt, A, B, C, D = _ssd_inputs(rng, t=128)
+    ref = ssd_chunked(x, dt, A, B, C, chunk_size=16, D=D,
+                      compute_dtype=jnp.float32)
+    got, _ = jax.jit(
+        lambda *a: sp_ssd(ctx8, *a, chunk_size=16, D=D,
+                          compute_dtype=jnp.float32)
+    )(x, dt, A, B, C)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_sp_selective_scan_seq8_matches_full(ctx8, rng):
+    from mamba_distributed_tpu.ops.scan import selective_scan
+    from mamba_distributed_tpu.parallel.seq_parallel import sp_selective_scan
+
+    b, t, d, n = 2, 64, 16, 8
+    ks = jax.random.split(rng, 5)
+    u = jax.random.normal(ks[0], (b, t, d))
+    dt = jax.random.normal(ks[1], (b, t, d)) * 0.5
+    A = -jnp.exp(jax.random.normal(ks[2], (d, n)) * 0.3)
+    B = jax.random.normal(ks[3], (b, t, n))
+    C = jax.random.normal(ks[4], (b, t, n))
+    ref = selective_scan(u, dt, A, B, C, delta_softplus=True)
+    got, _ = jax.jit(
+        lambda *a: sp_selective_scan(ctx8, *a, delta_softplus=True)
+    )(u, dt, A, B, C)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
