@@ -248,6 +248,49 @@ def lm_loss(
     return jnp.mean(lse - tgt)
 
 
+def lm_loss_pipelined(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,
+    targets: jax.Array,
+    mesh,
+    axis: str = "pipe",
+) -> jax.Array:
+    """``lm_loss`` averaged over grad-accum microbatches, with the layer
+    stack pipelined over the mesh's ``axis`` (GPipe).
+
+    The grad-accum microbatches ARE the pipeline microbatches:
+    input_ids/targets carry a leading (accum, B, T) axis, embedding and
+    LM head run batched over it, and the block stack streams the
+    microbatches through ``parallel/pipeline.pipelined_layers`` — whose
+    schedule is differentiable (ppermute/scan/where all transpose), so
+    one ``jax.grad`` trains through the pipeline.  Uniform stacks only
+    (the hybrid's interleaved attention layers don't shard evenly).
+    """
+    from mamba_distributed_tpu.parallel.pipeline import pipelined_layers
+
+    assert not cfg.attn_layer_idx, "pipeline parallelism needs a uniform stack"
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    hidden = params["embedding"][input_ids].astype(compute_dtype)  # (mb,b,t,d)
+    residual = jnp.zeros_like(
+        hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+    )
+
+    def body(carry, bp):
+        h, r = carry
+        return _block_fwd(bp, cfg, h, r, False)
+
+    if cfg.remat:
+        body = _remat(body, cfg)
+    hidden, residual = pipelined_layers(
+        body, params["blocks"], (hidden, residual), mesh, axis=axis
+    )
+    lf = _final_logits(params, cfg, hidden, residual)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    tgt = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
+
+
 def count_params(params) -> int:
     return sum(int(p.size) for p in jax.tree.leaves(params))
 

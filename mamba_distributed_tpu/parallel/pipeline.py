@@ -58,6 +58,12 @@ def pipelined_layers(
     layers for each microbatch.
     """
     n_stages = mesh.shape[axis]
+    n_layer = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layer % n_stages != 0:
+        raise ValueError(
+            f"pipelined_layers: n_layer ({n_layer}) must divide evenly "
+            f"over the {n_stages} pipeline stages of mesh axis {axis!r}"
+        )
     n_micro = jax.tree.leaves(xs)[0].shape[0]
     n_ticks = n_micro + n_stages - 1
 

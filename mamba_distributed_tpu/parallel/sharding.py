@@ -88,26 +88,35 @@ def _spec_for(names: list[str], shape: tuple[int, ...], fsdp_size: int,
     return P(*spec)
 
 
-def param_specs(params, shard: bool, fsdp_size: int, tensor_size: int = 1):
+def param_specs(params, shard: bool, fsdp_size: int, tensor_size: int = 1,
+                pipe_size: int = 1):
     """PartitionSpec pytree matching ``params``.
 
     ``shard=False`` disables FSDP; tensor parallelism applies whenever
     ``tensor_size > 1`` (it is a layout requirement, not an option).
+    With ``pipe_size > 1`` the stacked blocks' leading layer axis shards
+    over the pipe axis — each stage holds exactly its own layers, the
+    layout ``parallel/pipeline.pipelined_layers`` consumes directly.
     """
     def leaf_spec(path, leaf):
         names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
         stacked = "blocks" in names or "attn_blocks" in names
-        return _spec_for(
+        spec = _spec_for(
             names, np.shape(leaf),
             fsdp_size if shard else 1, tensor_size, stacked,
         )
+        if pipe_size > 1 and stacked and np.ndim(leaf) > 0:
+            rest = tuple(spec)[1:]  # layer axis -> pipe; keep fsdp/tp tail
+            spec = P("pipe", *rest)
+        return spec
 
     return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
 
 def param_shardings(params, mesh: Mesh, shard: bool):
     specs = param_specs(
-        params, shard, mesh.shape["fsdp"], mesh.shape["tensor"]
+        params, shard, mesh.shape["fsdp"], mesh.shape["tensor"],
+        dict(mesh.shape).get("pipe", 1),
     )
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))

@@ -17,6 +17,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mamba_distributed_tpu.config import TrainConfig
 from mamba_distributed_tpu.models import lm_loss
+from mamba_distributed_tpu.models.lm import lm_loss_pipelined
 from mamba_distributed_tpu.parallel.sharding import batch_sharding
 
 
@@ -41,9 +42,17 @@ def make_train_step(
     def loss_fn(p, x, y):
         return lm_loss(p, model_cfg, x, y, seq_ctx=seq_ctx)
 
+    pipe = cfg.mesh.pipe
+
     def step_fn(params, opt_state, x, y):
         accum = x.shape[0]
-        if accum == 1:
+        if pipe > 1:
+            # GPipe: the accum microbatches stream through the pipeline
+            # in ONE differentiable schedule — no lax.scan accumulation
+            loss, grads = jax.value_and_grad(
+                lambda p, x, y: lm_loss_pipelined(p, model_cfg, x, y, mesh)
+            )(params, x, y)
+        elif accum == 1:
             loss, grads = jax.value_and_grad(loss_fn)(params, x[0], y[0])
         else:
             def micro(carry, xs):

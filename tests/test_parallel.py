@@ -196,5 +196,7 @@ def test_tp_with_fsdp_and_dp(tmp_path):
 
 def test_mesh_axis_order():
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, seq=2, tensor=1))
-    assert mesh.axis_names == ("data", "fsdp", "seq", "tensor")
-    assert mesh.shape == {"data": 2, "fsdp": 2, "seq": 2, "tensor": 1}
+    assert mesh.axis_names == ("data", "fsdp", "seq", "tensor", "pipe")
+    assert mesh.shape == {
+        "data": 2, "fsdp": 2, "seq": 2, "tensor": 1, "pipe": 1,
+    }
