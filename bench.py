@@ -56,8 +56,12 @@ def init_backend():
     """
     import jax
 
+    from mamba_distributed_tpu.utils.platform import honor_jax_platforms_env
+
     if os.environ.get("BENCH_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    else:
+        honor_jax_platforms_env()
     _progress(f"jax {jax.__version__} imported; initializing backend...")
     dev = jax.devices()[0]
     _progress(f"backend up: {len(jax.devices())}x {dev.device_kind or dev.platform}")
@@ -126,9 +130,18 @@ def time_config(spec: dict, iters: int = 10) -> dict:
 
     spec keys (all optional): preset, B, T, ssm_impl, remat, remat_policy.
     Returns {**spec, tok_per_sec, mfu, step_ms} or {**spec, error} on
-    failure (e.g. OOM at large batch) so sweeps can continue.
+    failure (e.g. OOM at large batch) so sweeps can continue.  Unknown
+    spec keys raise immediately — a typo in a sweep config is a bug, not
+    a data point.
     """
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
+
+    known = {"preset", "B", "T", "ssm_impl", "remat", "remat_policy"}
+    unknown = set(spec) - known
+    if unknown:
+        raise KeyError(
+            f"unknown bench spec keys {sorted(unknown)}; known: {sorted(known)}"
+        )
 
     try:
         cfg, step, params, opt_state, x, y = build_step(spec)

@@ -75,6 +75,10 @@ def main():
     p.add_argument("--log-file", default="log/hellaswag_eval.txt")
     args = p.parse_args()
 
+    from mamba_distributed_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
     from mamba_distributed_tpu.eval import evaluate_hellaswag, iterate_examples
     from mamba_distributed_tpu.models import lm_forward
 

@@ -1,0 +1,19 @@
+"""Backend-selection workaround shared by every CLI entry point.
+
+On axon-site machines the site plugin overrides ``JAX_PLATFORMS``
+programmatically, so the env var alone does not pick the backend; the
+config must be set too, *before* the backend initializes.  Used by
+train.py, eval.py and bench.py so a CPU run requested via
+``JAX_PLATFORMS=cpu`` can never silently queue on the TPU pool.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

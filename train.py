@@ -43,7 +43,37 @@ def parse_args():
     p.add_argument("--remat-policy", choices=["all", "dots"], default=None)
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first (TPU pods)")
+    p.add_argument("--sample-prompt", default=None, metavar="TEXT",
+                   help="sample 4x32-token continuations of TEXT every "
+                        "sample_every steps, like the reference's in-loop "
+                        "sampling (needs tiktoken's GPT-2 BPE)")
+    p.add_argument("--sample-prompt-ids", default=None, metavar="IDS",
+                   help="same, but the prompt as comma-separated token ids "
+                        "(no tokenizer needed)")
     return p.parse_args()
+
+
+def resolve_sampling(args):
+    """-> (prompt_ids | None, decode_fn | None).
+
+    The reference hardcodes tiktoken-GPT2("Hello, I'm a language model,")
+    (/root/reference/train.py:170-171); here the prompt is a flag, and a
+    zero-egress environment can pass raw ids instead.
+    """
+    if args.sample_prompt_ids is not None:
+        return [int(t) for t in args.sample_prompt_ids.split(",")], None
+    if args.sample_prompt is None:
+        return None, None
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+    except Exception as e:  # no tiktoken / no cached BPE in this env
+        raise SystemExit(
+            f"--sample-prompt needs tiktoken's gpt2 encoding ({e}); "
+            "pass --sample-prompt-ids instead"
+        )
+    return enc.encode(args.sample_prompt), enc.decode
 
 
 def build_config(args):
@@ -85,13 +115,17 @@ def build_config(args):
 
 def main():
     args = parse_args()
+    from mamba_distributed_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     if args.multihost:
         jax.distributed.initialize()
     cfg = build_config(args)
 
     from mamba_distributed_tpu.training import Trainer
 
-    trainer = Trainer(cfg)
+    prompt_ids, decode_fn = resolve_sampling(args)
+    trainer = Trainer(cfg, sample_prompt_ids=prompt_ids, decode_fn=decode_fn)
     if args.resume and args.checkpoint_dir:
         try:
             trainer.restore_checkpoint(args.checkpoint_dir)
