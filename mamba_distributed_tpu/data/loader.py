@@ -173,8 +173,17 @@ class ShardedTokenLoader:
             if not fut.cancel():
                 try:
                     fut.result()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # discarded on purpose (the cursor is being moved), but
+                    # a persistent shard I/O failure should be visible HERE,
+                    # not one batch later via the inline retry
+                    import warnings
+
+                    warnings.warn(
+                        f"discarding failed prefetch during reset: {e!r}",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
             self._pending = None
 
     def close(self) -> None:
