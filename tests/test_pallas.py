@@ -88,6 +88,7 @@ def test_model_with_pallas_impl_matches_xla(rng):
                                    atol=1e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_pallas_under_sharded_train_step(tmp_path):
     """ssm_impl='pallas' inside the dp8-sharded jitted train step computes
     the same losses as the single-device XLA path."""

@@ -70,6 +70,7 @@ def test_eight_devices_present():
 
 
 @pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+@pytest.mark.slow
 def test_dp8_matches_single_device(tmp_path, layer):
     """Batch-sharded step over 8 devices == single-device step (config 2)."""
     ref, _ = losses_of(tmp_path / "a", micro=8, layer=layer)
@@ -79,6 +80,7 @@ def test_dp8_matches_single_device(tmp_path, layer):
     np.testing.assert_allclose(ref, dp, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_fsdp8_matches_single_device(tmp_path):
     """Param/opt-state sharding over 8 devices == single device (config 3)."""
     ref, _ = losses_of(tmp_path / "a", micro=8)
@@ -100,6 +102,7 @@ HYBRID_OVER = dict(
 )
 
 
+@pytest.mark.slow
 def test_hybrid_fsdp8_matches_single_device(tmp_path):
     """Config-5 shape (SSM + attention + gated MLP) under FSDP sharding:
     the attn_blocks/mlp sharding rules reproduce single-device losses."""
@@ -116,6 +119,7 @@ def test_hybrid_fsdp8_matches_single_device(tmp_path):
     assert sharded, "no parameter actually sharded under FSDP"
 
 
+@pytest.mark.slow
 def test_hybrid_tp_fsdp_dp_matches_single_device(tmp_path):
     """Hybrid blocks under tensor x fsdp x data all at once: the
     wqkv/mlp TP rules and attn param sharding reproduce the single-device
@@ -165,6 +169,7 @@ def test_replicated_specs_when_not_sharding():
 
 
 @pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+@pytest.mark.slow
 def test_tp_matches_single_device(tmp_path, layer):
     """Megatron-style tensor parallelism over the tensor axis is a pure
     layout change: same losses as single device."""
@@ -181,6 +186,7 @@ def test_tp_matches_single_device(tmp_path, layer):
     assert sharded, "no parameter actually tensor-sharded"
 
 
+@pytest.mark.slow
 def test_tp_with_fsdp_and_dp(tmp_path):
     """All three weight-parallelism axes compose: (data=2, fsdp=2, tensor=2)."""
     ref, _ = losses_of(tmp_path / "a", steps=2, micro=8)

@@ -122,6 +122,7 @@ def test_pipeline_grads_match_scan(stage_mesh, rng):
                                    atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_trainer_pipeline_matches_single_device(tmp_path):
     """mesh.pipe=4 training (stacked blocks sharded over stages, accum
     microbatches streamed through the schedule) == single-device losses."""

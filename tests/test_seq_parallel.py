@@ -165,6 +165,7 @@ def _assert_sp_loss_matches(ctx, cfg, b=4, t=64):
     np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_full_model_mamba1_seq_sharded_matches(ctx):
     """End-to-end: the mamba1 LM under sequence parallelism == single-device."""
     _assert_sp_loss_matches(ctx, ModelConfig(
@@ -220,6 +221,7 @@ def test_sp_conv1d_width1(ctx, rng):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_full_model_loss_seq_sharded_matches(ctx):
     """End-to-end: lm_loss under sequence parallelism == single-device."""
     _assert_sp_loss_matches(ctx, ModelConfig(
@@ -228,6 +230,7 @@ def test_full_model_loss_seq_sharded_matches(ctx):
     ))
 
 
+@pytest.mark.slow
 def test_full_model_hybrid_seq_sharded_matches(ctx):
     """Config-5 shape: SSM blocks + interleaved attention (ring under SP)
     reproduces the single-device loss."""
@@ -239,6 +242,7 @@ def test_full_model_hybrid_seq_sharded_matches(ctx):
     ))
 
 
+@pytest.mark.slow
 def test_long_context_seq_sharded_matches(ctx):
     """Config-4 regime: T=8192 sharded 4-way; chunked SSD + halo exchange
     reproduce the full-sequence loss (memory stays O(T/devices) on chip)."""
@@ -248,6 +252,7 @@ def test_long_context_seq_sharded_matches(ctx):
     ), b=2, t=8192)
 
 
+@pytest.mark.slow
 def test_trainer_seq_parallel_matches_single_device(tmp_path):
     """Config-4 style run (data x seq mesh) reproduces the single-device
     loss trajectory."""
