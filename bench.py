@@ -208,6 +208,10 @@ def main() -> None:
         "value": r["tok_per_sec"],
         "unit": "tokens/sec/chip",
         "mfu": r["mfu"],
+        # hardware-FLOPs convention: counts the chunked algorithm's
+        # Gram/decay matmuls, not a 6ND model-FLOPs estimate
+        # (docs/KERNELS.md "MFU accounting convention")
+        "mfu_convention": "hardware_flops",
         "step_ms": r["step_ms"],
         "device": dev.device_kind,
         "batch": [spec["B"], spec["T"]],

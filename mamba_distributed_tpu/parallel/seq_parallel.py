@@ -19,6 +19,17 @@ direction XLA's sharding propagation can't infer):
 
 Both transforms are exact: sharded output == single-device output to fp32
 tolerance (pinned by tests/test_seq_parallel.py).
+
+Compute/communication overlap (SURVEY §7 hard-part 3): the expensive
+intra-chunk work — the Gram/decay matmuls behind ``y_diag`` and the
+off-diagonal context — has no data dependence on the cross-device state
+exchange (only the cheap final ``combine_chunk_outputs`` consumes both),
+so the XLA scheduler is free to run the ppermute chain concurrently with
+the local matmuls; nothing in the program order forces the exchange onto
+the critical path.  Whether the scheduler actually hides the (tiny,
+O(d_state)) exchange is a hardware-profile question — measure with
+``scripts/profile_step.py`` on a seq-sharded config before tuning
+further.
 """
 
 from __future__ import annotations
