@@ -40,6 +40,11 @@ from jax.experimental.pallas import tpu as pltpu
 from mamba_distributed_tpu.ops.scan import _prep
 
 
+def _m1_step(h, At, dt_t, u_t, Bn):
+    """One recurrence step: h' = h * exp(A dt) + (dt u) B (all per-lane)."""
+    return h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+
+
 def _m1_scan_kernel(
     u_ref, dt_ref, At_ref, B_ref, C_ref, h0_ref, y_ref, hT_ref, h_scratch,
     *, nt: int
@@ -64,7 +69,7 @@ def _m1_scan_kernel(
         u_t = u_ref[0, pl.ds(i, 1)]                # (1, dblk)
         Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)  # (n, 1)
         Cn = C_ref[0, pl.ds(i, 1)].reshape(-1, 1)  # (n, 1)
-        h = h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+        h = _m1_step(h, At, dt_t, u_t, Bn)
         y_ref[0, pl.ds(i, 1)] = jnp.sum(h * Cn, axis=0, keepdims=True)
         return h
 
@@ -170,7 +175,7 @@ def _m1_entry_states_kernel(
         dt_t = dt_ref[0, pl.ds(i, 1)]
         u_t = u_ref[0, pl.ds(i, 1)]
         Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)
-        return h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+        return _m1_step(h, At, dt_t, u_t, Bn)
 
     h_scratch[...] = jax.lax.fori_loop(0, tb, body, h_scratch[...])
 
@@ -202,7 +207,7 @@ def _m1_bwd_kernel(
         dt_t = dt_ref[0, pl.ds(i, 1)]
         u_t = u_ref[0, pl.ds(i, 1)]
         Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)
-        return h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+        return _m1_step(h, At, dt_t, u_t, Bn)
 
     jax.lax.fori_loop(0, tb, fwd_body, hin_ref[0, 0])
 
@@ -219,7 +224,7 @@ def _m1_bwd_kernel(
 
         e_t = jnp.exp(At * dt_t)
         gh = gh + Cn * dy_t
-        hcur = hprev * e_t + (dt_t * u_t) * Bn
+        hcur = _m1_step(hprev, At, dt_t, u_t, Bn)
         dC_ref[0, 0, pl.ds(i, 1)] = jnp.sum(hcur * dy_t, axis=1)[None]
         dB_ref[0, 0, pl.ds(i, 1)] = jnp.sum(gh * (dt_t * u_t), axis=1)[None]
         ddt_ref[0, pl.ds(i, 1)] = jnp.sum(
