@@ -304,8 +304,21 @@ def _mk(model: Mapping[str, Any], train: Mapping[str, Any]) -> TrainConfig:
     )
 
 
-# The five BASELINE.json configurations.
+# The five BASELINE.json configurations (plus a CPU-runnable smoke preset).
 PRESETS: dict[str, TrainConfig] = {
+    # 0. quick-start: minutes on a CPU, for smoke runs and demos
+    "mamba2-tiny": _mk(
+        dict(d_model=128, n_layer=4, ssm_layer="mamba2", headdim=32,
+             d_state=64, chunk_size=64, vocab_size=4096),
+        dict(
+            seq_len=256,
+            micro_batch_size=8,
+            total_batch_size=4096,
+            max_steps=300,
+            warmup_steps=20,
+            val_every=25,
+        ),
+    ),
     # 1. repo default: Mamba-2 280M, seq 1024, single chip
     "mamba2-280m": _mk(
         dict(d_model=768, n_layer=64, ssm_layer="mamba2"),
