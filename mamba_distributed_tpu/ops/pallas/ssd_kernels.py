@@ -45,10 +45,11 @@ _PARALLEL3 = pltpu.CompilerParams(
 
 def _chunk_states_kernel(x_ref, dt_ref, acum_ref, B_ref, out_ref, *, compute_dtype):
     """Per-chunk state contribution: out[hb, p, n] = sum_l decay*dt*x (x) B."""
-    a = acum_ref[0, 0]            # (l, hb) fp32, inclusive cumsum of dt*A
-    dt = dt_ref[0, 0]             # (l, hb) fp32
-    Bb = B_ref[0, 0, :, 0]        # (l, n)
-    x = x_ref[0, 0]               # (l, hb, p)
+    a = acum_ref[0, 0, 0]         # (l, hb) fp32, inclusive cumsum of dt*A
+    dt = dt_ref[0, 0, 0]          # (l, hb) fp32
+    Bb = B_ref[0, 0, 0]           # (l, n)
+    l, hb = a.shape
+    x = x_ref[0, 0, 0].reshape(l, hb, -1)   # (l, hb, p)
 
     decay = jnp.exp(a[-1:, :] - a) * dt            # (l, hb)
     Bd = Bb[:, None, :] * decay[:, :, None]        # (l, hb, n)
@@ -65,13 +66,13 @@ def _chunk_output_kernel(
     x_ref, dt_ref, acum_ref, B_ref, C_ref, prev_ref, y_ref, *, compute_dtype
 ):
     """y = (G odot L) @ (x*dt) + (C*exp(a)) @ prev_state^T for one cell."""
-    a = acum_ref[0, 0]            # (l, hb) fp32
-    dt = dt_ref[0, 0]             # (l, hb)
-    Bb = B_ref[0, 0, :, 0].astype(compute_dtype)   # (l, n)
-    Cb = C_ref[0, 0, :, 0].astype(compute_dtype)   # (l, n)
-    x = x_ref[0, 0]               # (l, hb, p)
+    a = acum_ref[0, 0, 0]         # (l, hb) fp32
+    dt = dt_ref[0, 0, 0]          # (l, hb)
+    Bb = B_ref[0, 0, 0].astype(compute_dtype)      # (l, n)
+    Cb = C_ref[0, 0, 0].astype(compute_dtype)      # (l, n)
+    l, hb = a.shape
+    x = x_ref[0, 0, 0].reshape(l, hb, -1)          # (l, hb, p)
     prev = prev_ref[0, 0]         # (hb, p, n) fp32
-    l = a.shape[0]
 
     # G is group-shared across the hb heads of this block
     G = jnp.dot(Cb, Bb.T, preferred_element_type=jnp.float32)  # (l, l)
@@ -98,7 +99,9 @@ def _chunk_output_kernel(
         (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
-    y_ref[0, 0] = jnp.transpose(y, (1, 0, 2)).astype(y_ref.dtype)  # (l, hb, p)
+    y_ref[0, 0, 0] = (
+        jnp.transpose(y, (1, 0, 2)).reshape(l, -1).astype(y_ref.dtype)
+    )  # (l, hb*p)
 
 
 def _heads_per_block(h: int, p: int, g: int) -> int:
@@ -112,37 +115,70 @@ def _heads_per_block(h: int, p: int, g: int) -> int:
 def _cell_specs(h: int, hb: int, l: int, p: int, n: int, g: int):
     """Grid-cell BlockSpecs shared by the fwd and bwd kernels.
 
-    Index maps: (bi, ci, hi) -> block indices; B/C pick the head-block's
-    group, states pick the head-block.
+    Every block spans the FULL trailing two array dims, which makes it
+    unconditionally legal under Mosaic's (8, 128)-or-full-dim tiling
+    rule — the head-block structure lives in a dedicated array axis
+    instead of a partial-dim block (layouts built by _chunked_inputs):
+      x/y/dy  (b, nc, nhb, l, hb*p)   one lane-filling head-block per cell
+      dt/a    (b, nc, nhb, l, hb)
+      B/C     (b, nc, g,   l, n)      cell's group via the index map
+      states  (b, nc, h, p, n)        (p, n) trailing dims; p % 8 asserted
     """
-    x_spec = pl.BlockSpec((1, 1, l, hb, p), lambda bi, ci, hi: (bi, ci, 0, hi, 0))
-    dt_spec = pl.BlockSpec((1, 1, l, hb), lambda bi, ci, hi: (bi, ci, 0, hi))
+    xhp_spec = pl.BlockSpec(
+        (1, 1, 1, l, hb * p), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+    )
+    dt_spec = pl.BlockSpec(
+        (1, 1, 1, l, hb), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+    )
     bc_spec = pl.BlockSpec(
-        (1, 1, l, 1, n), lambda bi, ci, hi: (bi, ci, 0, (hi * hb * g) // h, 0)
+        (1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, (hi * hb * g) // h, 0, 0)
     )
     st_spec = pl.BlockSpec((1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0))
-    return x_spec, dt_spec, bc_spec, st_spec
+    return xhp_spec, dt_spec, bc_spec, st_spec
+
+
+def _to_cells(v, b, nc, l, nhb, hb, tail):
+    """(b, t, h, *tail) -> (b, nc, nhb, l, hb*prod(tail))."""
+    v = v.reshape(b, nc, l, nhb, hb, *tail)
+    v = jnp.moveaxis(v, 3, 2)                        # (b, nc, nhb, l, hb, ...)
+    return v.reshape(b, nc, nhb, l, -1)
+
+
+def _from_cells(v, b, t, h, p):
+    """(b, nc, nhb, l, hb*p) -> (b, t, h, p)."""
+    nc, nhb = v.shape[1], v.shape[2]
+    l = v.shape[3]
+    hb = h // nhb
+    v = v.reshape(b, nc, nhb, l, hb, p)
+    v = jnp.moveaxis(v, 2, 3)                        # (b, nc, l, nhb, hb, p)
+    return v.reshape(b, t, h, p)
 
 
 def _chunked_inputs(x, dt, A, B, C, chunk_size):
-    """Shared fwd/bwd preprocessing: chunk reshapes + in-chunk log-decay."""
+    """Shared fwd/bwd preprocessing: chunk/cell layouts + in-chunk log-decay."""
     b, t, h, p = x.shape
     g, n = B.shape[2], B.shape[3]
     l = _divisor_chunk(t, chunk_size)
     nc = t // l
     hb = _heads_per_block(h, p, g)
+    nhb = h // hb
+    if p % 8 != 0:  # the (p, n)-trailing state blocks need 8-sublane tiles
+        raise ValueError(
+            f"ssm_impl='pallas' needs headdim % 8 == 0 for Mosaic tiling, "
+            f"got headdim={p}; use ssm_impl='xla' for this shape"
+        )
 
     dtf = dt.astype(jnp.float32)
     dA = dtf * A.astype(jnp.float32)                 # (b, t, h)
-    dAc = dA.reshape(b, nc, l, h)
-    a_cum = jnp.cumsum(dAc, axis=2)                  # (b, nc, l, h)
+    a_cum = jnp.cumsum(dA.reshape(b, nc, l, h), axis=2)          # (b, nc, l, h)
     chunk_decay = jnp.exp(a_cum[:, :, -1, :])        # (b, nc, h)
 
-    xr = x.reshape(b, nc, l, h, p)
-    dtr = dtf.reshape(b, nc, l, h)
-    Br = B.reshape(b, nc, l, g, n)
-    Cr = C.reshape(b, nc, l, g, n)
-    return xr, dtr, a_cum, chunk_decay, Br, Cr, (b, nc, l, h, hb, p, g, n)
+    xr = _to_cells(x, b, nc, l, nhb, hb, (p,))
+    dtr = _to_cells(dtf, b, nc, l, nhb, hb, ())
+    ar = _to_cells(a_cum.reshape(b, t, h), b, nc, l, nhb, hb, ())
+    Br = jnp.moveaxis(B.reshape(b, nc, l, g, n), 3, 2)           # (b, nc, g, l, n)
+    Cr = jnp.moveaxis(C.reshape(b, nc, l, g, n), 3, 2)
+    return xr, dtr, ar, chunk_decay, Br, Cr, (b, nc, l, h, hb, p, g, n)
 
 
 def _ssd_pallas_fwd_impl(
@@ -153,7 +189,7 @@ def _ssd_pallas_fwd_impl(
     Shapes: x (b,t,h,p); dt (b,t,h) [bias-added+softplused]; A (h,);
     B/C (b,t,g,n).  Returns (y_no_D (b,t,h,p) fp32-accurate, final_state).
     """
-    xr, dtr, a_cum, chunk_decay, Br, Cr, dims = _chunked_inputs(
+    xr, dtr, ar, chunk_decay, Br, Cr, dims = _chunked_inputs(
         x, dt, A, B, C, chunk_size
     )
     b, nc, l, h, hb, p, g, n = dims
@@ -161,31 +197,31 @@ def _ssd_pallas_fwd_impl(
     nhb = h // hb
 
     grid = (b, nc, nhb)
-    x_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
+    xhp_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
 
     states = pl.pallas_call(
         functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
         grid=grid,
-        in_specs=[x_spec, dt_spec, dt_spec, bc_spec],
+        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec],
         out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, a_cum, Br)
+    )(xr, dtr, ar, Br)
 
     prev_states, final_state = state_passing(states, chunk_decay, initial_state)
 
     y = pl.pallas_call(
         functools.partial(_chunk_output_kernel, compute_dtype=compute_dtype),
-        out_shape=jax.ShapeDtypeStruct((b, nc, l, h, p), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, nc, nhb, l, hb * p), x.dtype),
         grid=grid,
-        in_specs=[x_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec],
-        out_specs=x_spec,
+        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec],
+        out_specs=xhp_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, a_cum, Br, Cr, prev_states)
+    )(xr, dtr, ar, Br, Cr, prev_states)
 
-    return y.reshape(b, t, h, p), final_state
+    return _from_cells(y, b, t, h, p), final_state
 
 
 # ---------------------------------------------------------------------------
@@ -207,9 +243,10 @@ def _ssd_pallas_fwd_impl(
 
 def _dstate_direct_kernel(dy_ref, acum_ref, C_ref, out_ref, *, compute_dtype):
     """Direct gradient of the chunk-entering state: dP = dY^T @ (e^a .* C)."""
-    a = acum_ref[0, 0]                               # (l, hb) fp32
-    Cb = C_ref[0, 0, :, 0]                           # (l, n)
-    dy = dy_ref[0, 0]                                # (l, hb, p)
+    a = acum_ref[0, 0, 0]                            # (l, hb) fp32
+    Cb = C_ref[0, 0, 0]                              # (l, n)
+    l, hb = a.shape
+    dy = dy_ref[0, 0, 0].reshape(l, hb, -1)          # (l, hb, p)
 
     e = jnp.exp(a)                                   # (l, hb), <= 1
     eC = e.T[:, :, None] * Cb[None].astype(jnp.float32)          # (hb, l, n)
@@ -232,15 +269,15 @@ def _ssd_bwd_cell_kernel(
     [summed over a group's head-blocks outside].
     """
     cd = compute_dtype
-    a = acum_ref[0, 0]                               # (l, hb) fp32
-    dt = dt_ref[0, 0]                                # (l, hb) fp32
-    x = x_ref[0, 0].astype(jnp.float32)              # (l, hb, p)
-    Bb = B_ref[0, 0, :, 0]                           # (l, n)
-    Cb = C_ref[0, 0, :, 0]                           # (l, n)
+    a = acum_ref[0, 0, 0]                            # (l, hb) fp32
+    dt = dt_ref[0, 0, 0]                             # (l, hb) fp32
+    l, hb = a.shape
+    x = x_ref[0, 0, 0].reshape(l, hb, -1).astype(jnp.float32)    # (l, hb, p)
+    Bb = B_ref[0, 0, 0]                              # (l, n)
+    Cb = C_ref[0, 0, 0]                              # (l, n)
     P = prev_ref[0, 0]                               # (hb, p, n) fp32
-    dy = dy_ref[0, 0].astype(jnp.float32)            # (l, hb, p)
+    dy = dy_ref[0, 0, 0].reshape(l, hb, -1).astype(jnp.float32)  # (l, hb, p)
     dS = dS_ref[0, 0]                                # (hb, p, n) fp32
-    l = a.shape[0]
 
     e = jnp.exp(a)                                   # (l, hb)
     d = jnp.exp(a[-1:, :] - a)                       # (l, hb) decay-to-end
@@ -307,28 +344,30 @@ def _ssd_bwd_cell_kernel(
     dd = jnp.sum(ut * dwt, axis=2)                   # (hb, l)
     ddd = dd * dT                                    # chain through exp
     da = da - ddd.T
-    da = da.at[-1].add(jnp.sum(ddd, axis=1))
+    # += at the last row, as a mask-add (scatter has no Mosaic lowering)
+    last = (jax.lax.broadcasted_iota(jnp.int32, da.shape, 0) == l - 1)
+    da = da + jnp.where(last, jnp.sum(ddd, axis=1)[None, :], 0.0)
 
     # --- u = dt * x product rule ------------------------------------------
     du_l = jnp.transpose(du, (1, 0, 2))              # (l, hb, p)
-    dx_ref[0, 0] = (dt[:, :, None] * du_l).astype(dx_ref.dtype)
-    ddt_ref[0, 0] = jnp.sum(x * du_l, axis=2)
-    da_ref[0, 0] = da
+    dx_ref[0, 0, 0] = (dt[:, :, None] * du_l).reshape(l, -1).astype(dx_ref.dtype)
+    ddt_ref[0, 0, 0] = jnp.sum(x * du_l, axis=2)
+    da_ref[0, 0, 0] = da
     dB_ref[0, 0, 0] = dB_acc
     dC_ref[0, 0, 0] = dC_acc
 
 
 def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret):
     """Full backward: recompute chunk states, reverse-scan, cell kernel."""
-    xr, dtr, a_cum, chunk_decay, Br, Cr, dims = _chunked_inputs(
+    xr, dtr, ar, chunk_decay, Br, Cr, dims = _chunked_inputs(
         x, dt, A, B, C, chunk_size
     )
     b, nc, l, h, hb, p, g, n = dims
     t = nc * l
     nhb = h // hb
     grid = (b, nc, nhb)
-    x_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
-    dyr = dy.reshape(b, nc, l, h, p)
+    xhp_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
+    dyr = _to_cells(dy, b, nc, l, nhb, hb, (p,))
 
     # recompute the chunk summaries + entering states (remat, like the
     # reference dep's Triton backward which re-derives chunk states)
@@ -336,11 +375,11 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
         functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
         grid=grid,
-        in_specs=[x_spec, dt_spec, dt_spec, bc_spec],
+        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec],
         out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, a_cum, Br)
+    )(xr, dtr, ar, Br)
     prev_states, _ = state_passing(states, chunk_decay)
 
     # direct state gradient from each chunk's off-diagonal output
@@ -348,11 +387,11 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
         functools.partial(_dstate_direct_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
         grid=grid,
-        in_specs=[x_spec, dt_spec, bc_spec],
+        in_specs=[xhp_spec, dt_spec, bc_spec],
         out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(dyr, a_cum, Cr)
+    )(dyr, ar, Cr)
 
     # reverse associative scan: gP_c = dP_c + gamma_c * gP_{c+1}
     decay = chunk_decay[..., None, None]             # (b, nc, h, 1, 1)
@@ -369,20 +408,20 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
     dS = jnp.concatenate([gP[:, 1:], jnp.zeros_like(gP[:, :1])], axis=1)
     dgamma = jnp.sum(dS * prev_states, axis=(3, 4))  # (b, nc, h)
 
-    dx_c, ddt_dir, da, dB_cell, dC_cell = pl.pallas_call(
+    dx_c, ddt5, da5, dB_cell, dC_cell = pl.pallas_call(
         functools.partial(_ssd_bwd_cell_kernel, compute_dtype=compute_dtype),
         out_shape=(
-            jax.ShapeDtypeStruct((b, nc, l, h, p), x.dtype),
-            jax.ShapeDtypeStruct((b, nc, l, h), jnp.float32),
-            jax.ShapeDtypeStruct((b, nc, l, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, nhb, l, hb * p), x.dtype),
+            jax.ShapeDtypeStruct((b, nc, nhb, l, hb), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, nhb, l, hb), jnp.float32),
             jax.ShapeDtypeStruct((b, nc, nhb, l, n), jnp.float32),
             jax.ShapeDtypeStruct((b, nc, nhb, l, n), jnp.float32),
         ),
         grid=grid,
-        in_specs=[x_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec,
-                  x_spec, st_spec],
+        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec,
+                  xhp_spec, st_spec],
         out_specs=(
-            x_spec,
+            xhp_spec,
             dt_spec,
             dt_spec,
             pl.BlockSpec((1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
@@ -390,14 +429,19 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
         ),
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, a_cum, Br, Cr, prev_states, dyr, dS)
+    )(xr, dtr, ar, Br, Cr, prev_states, dyr, dS)
 
     # --- XLA epilogue: push `da` through the cumsum chain -----------------
+    def cells_to_blh(v):  # (b, nc, nhb, l, hb) -> (b, nc, l, h)
+        return jnp.moveaxis(v, 2, 3).reshape(b, nc, l, h)
+
+    da = cells_to_blh(da5)
+    ddt_dir = cells_to_blh(ddt5)
     da = da.at[:, :, -1, :].add(dgamma * chunk_decay)
     ddA = jnp.flip(jnp.cumsum(jnp.flip(da, 2), axis=2), 2)       # (b, nc, l, h)
     Af = A.astype(jnp.float32)
     ddt = (ddt_dir + ddA * Af[None, None, None]).reshape(b, t, h)
-    dA = jnp.sum(ddA * dtr, axis=(0, 1, 2))
+    dA = jnp.sum(ddA * cells_to_blh(dtr), axis=(0, 1, 2))
 
     # group-sum the per-head-block B/C gradients (blocks are head-ordered,
     # so a group's nhb/g blocks are consecutive)
@@ -407,7 +451,7 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
     dC = jnp.transpose(dC_g, (0, 1, 3, 2, 4)).reshape(b, t, g, n)
 
     return (
-        dx_c.reshape(b, t, h, p),
+        _from_cells(dx_c, b, t, h, p),
         ddt.astype(dt.dtype),
         dA.astype(A.dtype),
         dB.astype(B.dtype),

@@ -310,3 +310,51 @@ def test_pallas_grads_with_D_and_bf16(rng):
         a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
         scale = max(1.0, float(np.abs(a).max()))
         np.testing.assert_allclose(b / scale, a / scale, atol=4e-2)
+
+
+# ---------------------------------------------------------------------------
+# TPU-platform lowering (no chip needed): jax.export runs the REAL
+# Pallas->Mosaic lowering path, catching BlockSpec tiling violations and
+# unsupported-op errors that interpret mode never sees.
+# ---------------------------------------------------------------------------
+
+
+def _export_tpu(fn, *args):
+    return jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+@pytest.mark.parametrize("shapes", [
+    dict(),                                 # default: h=4, p=64, g=1
+    dict(h=8, p=32, n=64, g=2),             # grouped + small headdim
+    dict(h=6, p=64, g=2),                   # odd head count per group
+])
+def test_ssd_tpu_lowering_fwd_and_grad(rng, shapes):
+    x, dt, A, B, C, D = inputs(rng, t=128, **shapes)
+
+    def f(x, dt, A, B, C):
+        return ssd_chunked_pallas(x, dt, A, B, C, chunk_size=64, D=D,
+                                  compute_dtype=jnp.bfloat16, interpret=False)
+
+    _export_tpu(f, x, dt, A, B, C)
+    _export_tpu(
+        jax.grad(lambda *a: jnp.sum(f(*a).astype(jnp.float32) ** 2),
+                 (0, 1, 2, 3, 4)),
+        x, dt, A, B, C,
+    )
+
+
+def test_m1_tpu_lowering_fwd_and_grad(rng):
+    from mamba_distributed_tpu.ops.pallas import selective_scan_pallas
+
+    u, delta, A, B, C, D, z, bias = m1_inputs(rng, t=64, d=96)  # odd d: pad path
+
+    def f(u, delta, A, B, C):
+        return selective_scan_pallas(u, delta, A, B, C, D=D, z=z,
+                                     delta_bias=bias, delta_softplus=True,
+                                     interpret=False)
+
+    _export_tpu(f, u, delta, A, B, C)
+    _export_tpu(
+        jax.grad(lambda *a: jnp.sum(f(*a) ** 2), (0, 1, 2, 3, 4)),
+        u, delta, A, B, C,
+    )
