@@ -358,3 +358,24 @@ def test_m1_tpu_lowering_fwd_and_grad(rng):
         jax.grad(lambda *a: jnp.sum(f(*a) ** 2), (0, 1, 2, 3, 4)),
         u, delta, A, B, C,
     )
+
+
+@pytest.mark.parametrize("layer,kw", [
+    ("mamba2", dict(headdim=16, chunk_size=32, d_state=32)),
+    ("mamba1", dict(d_state=8)),
+])
+def test_full_model_grad_tpu_lowering_pallas(layer, kw):
+    """The COMPOSED training graph (embed -> blocks with pallas mixers ->
+    loss -> grad) lowers for the TPU platform end to end."""
+    from mamba_distributed_tpu.config import ModelConfig
+    from mamba_distributed_tpu.models import init_lm_params, lm_loss
+
+    cfg = ModelConfig(d_model=64, n_layer=2, vocab_size=256, ssm_layer=layer,
+                      ssm_impl="pallas", **kw)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.zeros((2, 64), jnp.int32)
+    y = jnp.zeros((2, 64), jnp.int32)
+    _export_tpu(
+        lambda p, x, y: jax.value_and_grad(lm_loss)(p, cfg, x, y),
+        params, x, y,
+    )
