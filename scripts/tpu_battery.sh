@@ -1,0 +1,49 @@
+#!/bin/bash
+# Full real-chip measurement battery, in dependency order.  Run this the
+# moment a TPU claim succeeds (a retry wrapper can loop it: each failed
+# claim blocks ~25 min in the axon relay, then sleep 60 and retry).
+# Writes per-stage results under $OUT (default /tmp) and assembles
+# MEASUREMENTS.md in the repo root.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp}"
+
+run() {  # run <timeout-s> <name> <outfile> <cmd...>
+  local t="$1" name="$2" out="$3"; shift 3
+  echo "$(date -u +%H:%M:%S) $name" >&2
+  if timeout "$t" "$@" > "$out" 2>>"$OUT/battery.log"; then
+    echo "$(date -u +%H:%M:%S) $name DONE" >&2
+  else
+    echo "$(date -u +%H:%M:%S) $name FAILED (see $OUT/battery.log)" >&2
+    return 1
+  fi
+}
+
+run 4500 smoke  "$OUT/tpu_smoke.jsonl"    python scripts/tpu_smoke.py || exit 1
+run 4500 sweep  "$OUT/sweep_results.jsonl" python scripts/sweep_bench.py
+run 2400 parity "$OUT/parity_run.log"      bash scripts/run_parity.sh 30
+run 2400 decode "$OUT/decode_result.json"  python scripts/bench_decode.py
+run 2400 bench  "$OUT/bench_result.json"   python bench.py
+
+{
+  echo "# Measurements (real chip, $(date -u +%Y-%m-%dT%H:%MZ))"
+  echo
+  echo "MFU convention: hardware-FLOPs (docs/KERNELS.md)."
+  echo
+  for section in \
+    "Pallas kernel parity on hardware (tpu_smoke):tpu_smoke.jsonl" \
+    "Train-step sweep (sweep_bench):sweep_results.jsonl" \
+    "bench.py (shipped default):bench_result.json" \
+    "Decode throughput (bench_decode):decode_result.json"; do
+    echo "## ${section%%:*}"
+    echo '```'
+    cat "$OUT/${section##*:}" 2>/dev/null
+    echo '```'
+    echo
+  done
+  echo "## Early loss curve, 280M reference recipe (run_parity.sh)"
+  echo '```'
+  tail -40 "$OUT/parity_run.log" 2>/dev/null
+  echo '```'
+} > MEASUREMENTS.md
+echo "$(date -u +%H:%M:%S) battery complete -> MEASUREMENTS.md" >&2
