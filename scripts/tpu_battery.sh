@@ -8,12 +8,16 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${OUT:-/tmp}"
 
+declare -A STATUS
+
 run() {  # run <timeout-s> <name> <outfile> <cmd...>
   local t="$1" name="$2" out="$3"; shift 3
   echo "$(date -u +%H:%M:%S) $name" >&2
   if timeout "$t" "$@" > "$out" 2>>"$OUT/battery.log"; then
+    STATUS[$name]=ok
     echo "$(date -u +%H:%M:%S) $name DONE" >&2
   else
+    STATUS[$name]=FAILED
     echo "$(date -u +%H:%M:%S) $name FAILED (see $OUT/battery.log)" >&2
     return 1
   fi

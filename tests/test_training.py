@@ -231,3 +231,50 @@ def test_cli_sampling_wiring(tmp_path, capsys):
     tr.run(max_steps=3)
     out = capsys.readouterr().out
     assert "sample:" in out, out
+
+
+def test_cli_auto_restart_recovers(tmp_path, capsys, monkeypatch):
+    """--auto-restart: a mid-run crash rebuilds the trainer from the
+    latest checkpoint and the run completes (restart-based failure
+    recovery; the reference's torchrun job just dies)."""
+    import dataclasses
+    import sys
+
+    import train as train_cli
+    from mamba_distributed_tpu.training import Trainer
+
+    cfg = make_cfg(tmp_path)
+    monkeypatch.setattr(
+        train_cli, "build_config",
+        lambda args: dataclasses.replace(cfg, checkpoint_every=2, max_steps=5),
+    )
+
+    # crash exactly once, at step 3 of the first trainer
+    orig_run = Trainer.run
+    state = {"crashed": False}
+
+    def crashing_run(self, max_steps=None, checkpoint_dir=None):
+        if not state["crashed"]:
+            orig = self.train_step
+
+            def stepper(params, opt, x, y):
+                if self.step >= 3:
+                    state["crashed"] = True
+                    raise RuntimeError("injected chip failure")
+                return orig(params, opt, x, y)
+
+            self.train_step = stepper
+        return orig_run(self, max_steps=max_steps, checkpoint_dir=checkpoint_dir)
+
+    monkeypatch.setattr(Trainer, "run", crashing_run)
+    ckpt = str(tmp_path / "ckpt")
+    monkeypatch.setattr(sys, "argv", [
+        "train.py", "--checkpoint-dir", ckpt, "--auto-restart", "1",
+    ])
+    train_cli.main()
+    out = capsys.readouterr().out
+    assert "restart 1/1" in out, out
+    assert "resumed from step 2" in out, out  # latest checkpoint (every 2)
+    # the run completed after recovery
+    log = (tmp_path / "log" / "log.txt").read_text()
+    assert "4 train" in log

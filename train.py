@@ -50,6 +50,10 @@ def parse_args():
     p.add_argument("--sample-prompt-ids", default=None, metavar="IDS",
                    help="same, but the prompt as comma-separated token ids "
                         "(no tokenizer needed)")
+    p.add_argument("--auto-restart", type=int, default=0, metavar="N",
+                   help="on a crash, rebuild the trainer from the latest "
+                        "checkpoint in --checkpoint-dir and continue, up to "
+                        "N times (restart-based failure recovery)")
     return p.parse_args()
 
 
@@ -125,17 +129,54 @@ def main():
     from mamba_distributed_tpu.training import Trainer
 
     prompt_ids, decode_fn = resolve_sampling(args)
-    trainer = Trainer(cfg, sample_prompt_ids=prompt_ids, decode_fn=decode_fn)
-    if args.resume and args.checkpoint_dir:
-        try:
-            trainer.restore_checkpoint(args.checkpoint_dir)
-            print(f"resumed from step {trainer.step}")
-        except FileNotFoundError:
-            print("no checkpoint found; starting fresh")
+    if args.auto_restart < 0:
+        raise SystemExit(f"--auto-restart must be >= 0, got {args.auto_restart}")
+    if args.auto_restart and not args.checkpoint_dir:
+        raise SystemExit("--auto-restart needs --checkpoint-dir to recover from")
+
+    def make_trainer(resume: bool):
+        trainer = Trainer(cfg, sample_prompt_ids=prompt_ids, decode_fn=decode_fn)
+        if resume and args.checkpoint_dir:
+            try:
+                trainer.restore_checkpoint(args.checkpoint_dir)
+                print(f"resumed from step {trainer.step}")
+            except FileNotFoundError:
+                print("no checkpoint found; starting fresh")
+        return trainer
+
+    # restart-based failure recovery (the reference has none: any crash
+    # kills the torchrun job, /root/reference/train.py): rebuild from the
+    # latest full-state checkpoint and continue, up to --auto-restart times
+    trainer = None
     try:
-        trainer.run(max_steps=args.max_steps, checkpoint_dir=args.checkpoint_dir)
+        for attempt in range(args.auto_restart + 1):
+            try:
+                # (re)build INSIDE the protected block, with the previous
+                # trainer's buffers already released: a failed restore or a
+                # rebuild OOM consumes restart budget instead of dying, and
+                # device memory never holds two full parameter sets
+                if trainer is None:
+                    trainer = make_trainer(
+                        resume=args.resume if attempt == 0 else True
+                    )
+                trainer.run(max_steps=args.max_steps,
+                            checkpoint_dir=args.checkpoint_dir)
+                break
+            except Exception as e:
+                if attempt == args.auto_restart:
+                    raise
+                print(f"run crashed ({type(e).__name__}: {e}); "
+                      f"restart {attempt + 1}/{args.auto_restart} "
+                      "from the latest checkpoint")
+                if trainer is not None:
+                    try:
+                        trainer.finish()
+                    except Exception:
+                        pass
+                trainer = None
     finally:
-        trainer.finish()
+        if trainer is not None:
+            trainer.finish()
 
 
 if __name__ == "__main__":
