@@ -164,11 +164,15 @@ def time_config(spec: dict, iters: int = 10) -> dict:
         return {**spec, "error": f"{type(e).__name__}: {str(e)[:200]}"}
 
     tok_per_sec = B * T / dt
-    fpt = flops_per_token(cfg.model, T, training=True)
+    peak = peak_flops_per_chip()
+    fpt_hw = flops_per_token(cfg.model, T, training=True, convention="hardware")
+    fpt_model = flops_per_token(cfg.model, T, training=True, convention="model")
     return {
         **spec,
         "tok_per_sec": round(tok_per_sec, 1),
-        "mfu": round(fpt * tok_per_sec / peak_flops_per_chip(), 4),
+        # the >=45% target is judged on mfu_model, the stricter convention
+        "mfu_model": round(fpt_model * tok_per_sec / peak, 4),
+        "mfu_hw": round(fpt_hw * tok_per_sec / peak, 4),
         "step_ms": round(dt * 1000, 2),
         "loss": round(final_loss, 4),
         "ssm_impl": cfg.model.ssm_impl,
@@ -194,24 +198,52 @@ def _env_spec() -> dict:
     return spec
 
 
+def _fail(stage: str, detail: str, device=None) -> None:
+    """Emit ONE parseable JSON error line and exit 1.
+
+    Every failure mode — above all backend init when the pooled TPU is
+    unclaimable — must leave the driver a structured record, never a raw
+    traceback with `parsed: null` (VERDICT r3 weak #1).
+    """
+    print(
+        json.dumps(
+            {
+                "metric": "train_tokens_per_sec_per_chip",
+                "value": None,
+                "unit": "tokens/sec/chip",
+                "error": f"{stage}: {detail[:300]}",
+                "device": device,
+            }
+        ),
+        flush=True,
+    )
+    raise SystemExit(1)
+
+
 def main() -> None:
-    dev = init_backend()
-    spec = _env_spec()
-    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    try:
+        dev = init_backend()
+    except Exception as e:
+        _fail("backend_unavailable", f"{type(e).__name__}: {e}")
+    try:
+        spec = _env_spec()
+        iters = int(os.environ.get("BENCH_ITERS", "10"))
+    except (SystemExit, ValueError) as e:
+        _fail("bad_env_spec", str(e), dev.device_kind)
     r = time_config(spec, iters=iters)
     if "error" in r:
-        print(json.dumps(r), flush=True)
+        print(json.dumps({"value": None, "device": dev.device_kind, **r}), flush=True)
         raise SystemExit(1)
 
     out = {
         "metric": f"train_tokens_per_sec_per_chip_{spec['preset'].replace('-', '_')}",
         "value": r["tok_per_sec"],
         "unit": "tokens/sec/chip",
-        "mfu": r["mfu"],
-        # hardware-FLOPs convention: counts the chunked algorithm's
-        # Gram/decay matmuls, not a 6ND model-FLOPs estimate
-        # (docs/KERNELS.md "MFU accounting convention")
-        "mfu_convention": "hardware_flops",
+        # two conventions (docs/KERNELS.md): the >=45% target is judged on
+        # mfu_model (parameter matmuls + recurrent state math); mfu_hw
+        # additionally counts the chunked algorithm's Gram/decay matmuls
+        "mfu_model": r["mfu_model"],
+        "mfu_hw": r["mfu_hw"],
         "step_ms": r["step_ms"],
         "device": dev.device_kind,
         "batch": [spec["B"], spec["T"]],

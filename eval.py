@@ -9,9 +9,11 @@ never constructed a model (SURVEY.md §3.4) both work here.
   python eval.py -m custom --checkpoint model.pt --preset mamba2-280m
   python eval.py -m hugging_face --hf-path <local HF dir>
 
-Needs a GPT-2 BPE tokenizer (tiktoken) and a local hellaswag_val.jsonl —
-both are downloads the reference does on the fly; this environment is
-zero-egress, so point the flags at local copies.
+Needs a GPT-2 BPE tokenizer and a local hellaswag_val.jsonl (a download
+the reference does on the fly).  Tokenization is zero-egress: the BPE
+algorithm is vendored (mamba_distributed_tpu/data/gpt2_bpe.py) and loads
+local encoder.json/vocab.bpe (or HF vocab.json/merges.txt) from
+--bpe-dir / $GPT2_BPE_DIR / ./gpt2_bpe, with tiktoken as a fallback.
 """
 
 from __future__ import annotations
@@ -25,17 +27,17 @@ class ModelType(str, enum.Enum):  # reference eval.py:22 had the bases reversed
     HF = "hugging_face"
 
 
-def get_encoder():
-    try:
-        import tiktoken
+def get_encoder(bpe_dir: str | None = None):
+    from mamba_distributed_tpu.data.gpt2_bpe import load_encoder
 
-        enc = tiktoken.get_encoding("gpt2")
-        return enc.encode
-    except Exception as e:  # no network / no cached BPE
+    try:
+        # vendored zero-egress BPE (local gpt2_bpe/ files), tiktoken fallback
+        encode, _ = load_encoder(bpe_dir)
+        return encode
+    except FileNotFoundError as e:
         raise SystemExit(
-            f"GPT-2 tokenizer unavailable ({e}); HellaSwag needs tiktoken's "
-            "gpt2 encoding (or inject your own via the library API "
-            "mamba_distributed_tpu.eval.evaluate_hellaswag)."
+            f"GPT-2 tokenizer unavailable: {e}\n(Or inject your own encode "
+            "via the library API mamba_distributed_tpu.eval.evaluate_hellaswag.)"
         )
 
 
@@ -73,6 +75,10 @@ def main():
     p.add_argument("--example-batch", type=int, default=8,
                    help="examples packed per device call (scores unchanged)")
     p.add_argument("--log-file", default="log/hellaswag_eval.txt")
+    p.add_argument("--bpe-dir", default=None,
+                   help="dir with GPT-2 encoder.json/vocab.bpe (or HF "
+                   "vocab.json/merges.txt); default $GPT2_BPE_DIR or "
+                   "./gpt2_bpe, falling back to tiktoken")
     args = p.parse_args()
 
     from mamba_distributed_tpu.utils.platform import honor_jax_platforms_env
@@ -91,7 +97,7 @@ def main():
     result = evaluate_hellaswag(
         lambda tokens: lm_forward(params, cfg, tokens),
         iterate_examples(args.data_file),
-        get_encoder(),
+        get_encoder(args.bpe_dir),
         limit=args.limit,
         log_path=args.log_file,
         verbose=True,

@@ -28,7 +28,9 @@ def parse_args():
     p.add_argument("--preset", default="mamba2-280m",
                    help="model preset (ignored for --hf-path dirs, which "
                         "carry their own config.json)")
-    p.add_argument("--prompt", default=None, help="text (needs tiktoken)")
+    p.add_argument("--prompt", default=None,
+                   help="text (tokenized by the vendored GPT-2 BPE from "
+                        "$GPT2_BPE_DIR / ./gpt2_bpe, tiktoken fallback)")
     p.add_argument("--prompt-ids", default=None,
                    help="comma-separated token ids (no tokenizer needed)")
     p.add_argument("--num-return", type=int, default=4)
@@ -50,20 +52,18 @@ def main():
     import jax.numpy as jnp
 
     # --- prompt ---
-    enc = None
+    decode_fn = None
     if args.prompt_ids is not None:
         ids = [int(t) for t in args.prompt_ids.split(",")]
     elif args.prompt is not None:
-        try:
-            import tiktoken
+        from mamba_distributed_tpu.data.gpt2_bpe import load_encoder
 
-            enc = tiktoken.get_encoding("gpt2")
-        except Exception as e:
-            raise SystemExit(
-                f"--prompt needs tiktoken's gpt2 encoding ({e}); "
-                "pass --prompt-ids instead"
-            )
-        ids = enc.encode(args.prompt)
+        try:
+            # vendored zero-egress BPE (local gpt2_bpe/), tiktoken fallback
+            encode, decode_fn = load_encoder()
+        except FileNotFoundError as e:
+            raise SystemExit(f"--prompt: {e}\nOr pass --prompt-ids instead.")
+        ids = encode(args.prompt)
     else:
         raise SystemExit("pass --prompt or --prompt-ids")
 
@@ -91,7 +91,7 @@ def main():
     import numpy as np
 
     for row in np.asarray(out):
-        text = enc.decode(row.tolist()) if enc else f"tokens {row.tolist()}"
+        text = decode_fn(row.tolist()) if decode_fn else f"tokens {row.tolist()}"
         print(f"> {text}")
 
 

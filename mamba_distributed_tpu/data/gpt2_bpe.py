@@ -1,0 +1,186 @@
+"""Zero-egress GPT-2 byte-level BPE tokenizer.
+
+The reference tokenizes with tiktoken's "gpt2" encoding fetched at
+runtime (/root/reference/model.py:20, train.py:41, eval.py:26) — a
+network download this environment cannot make.  The *algorithm* (byte →
+unicode table, regex pre-split, ranked-merge BPE) is vendored here in
+full; the *data* is the standard OpenAI release pair every GPT-2
+distribution ships (~1MB total), loaded from a local directory:
+
+    <dir>/encoder.json   token -> id map (50257 entries incl. <|endoftext|>)
+    <dir>/vocab.bpe      ranked merges, one pair per line (version header)
+
+HF checkpoints carry the same data as ``vocab.json``/``merges.txt``;
+both filename conventions are accepted.  Point ``GPT2_BPE_DIR`` (or the
+``bpe_dir`` argument) at the directory and ``eval.py`` /
+``train.py --sample-prompt`` run fully offline; without the files the
+CLIs fall back to tiktoken (if it can load) and then fail with a clear
+message, and the library APIs keep accepting injected ``encode``
+callables as before.
+
+Encoding matches tiktoken's "gpt2" exactly: same pre-split regex, same
+byte encoder, same merge ranks — pinned by tests/test_gpt2_bpe.py with a
+synthetic merge table (the real data files are not redistributable into
+this environment, but the algorithm is data-independent).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+import regex  # full \p{L}/\p{N} support (transformers dependency)
+
+ENDOFTEXT = "<|endoftext|>"
+ENDOFTEXT_ID = 50256
+
+# GPT-2's pre-tokenization pattern (contractions, letter runs, number
+# runs, punctuation runs, trailing-space handling)
+_PAT = regex.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+)
+
+
+@functools.lru_cache(maxsize=1)
+def bytes_to_unicode() -> dict[int, str]:
+    """The reversible byte -> printable-unicode table byte-level BPE uses.
+
+    Printable ASCII + two latin-1 ranges map to themselves; the remaining
+    68 bytes map to 256+offset codepoints so every byte has a visible,
+    non-whitespace character and merge files stay plain text.
+    """
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _find_file(bpe_dir: str, names: tuple[str, ...]) -> str | None:
+    for name in names:
+        p = os.path.join(bpe_dir, name)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+class GPT2BPE:
+    """Byte-level BPE with GPT-2 semantics over a loaded vocab."""
+
+    def __init__(self, encoder: dict[str, int], merges: list[tuple[str, str]]):
+        self.encoder = encoder
+        self.decoder = {v: k for k, v in encoder.items()}
+        self.ranks = {pair: i for i, pair in enumerate(merges)}
+        self.byte_enc = bytes_to_unicode()
+        self.byte_dec = {v: k for k, v in self.byte_enc.items()}
+        self._cache: dict[str, tuple[str, ...]] = {}
+
+    @classmethod
+    def from_dir(cls, bpe_dir: str) -> "GPT2BPE":
+        enc_path = _find_file(bpe_dir, ("encoder.json", "vocab.json"))
+        bpe_path = _find_file(bpe_dir, ("vocab.bpe", "merges.txt"))
+        if enc_path is None or bpe_path is None:
+            raise FileNotFoundError(
+                f"GPT-2 BPE data not found in {bpe_dir!r}: need "
+                "encoder.json (or vocab.json) + vocab.bpe (or merges.txt); "
+                "copy them from any GPT-2 distribution (module docstring)."
+            )
+        with open(enc_path, encoding="utf-8") as f:
+            encoder = json.load(f)
+        with open(bpe_path, encoding="utf-8") as f:
+            lines = f.read().split("\n")
+        merges = []
+        for line in lines:
+            parts = line.split()
+            if len(parts) == 2:
+                merges.append((parts[0], parts[1]))
+            # version headers / blank lines are skipped
+        return cls(encoder, merges)
+
+    def _bpe(self, token: str) -> tuple[str, ...]:
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            first, second = best
+            merged = []
+            i = 0
+            while i < len(word):
+                if (
+                    i < len(word) - 1
+                    and word[i] == first
+                    and word[i + 1] == second
+                ):
+                    merged.append(first + second)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str) -> list[int]:
+        ids: list[int] = []
+        for tok in _PAT.findall(text):
+            mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[piece] for piece in self._bpe(mapped))
+        return ids
+
+    def decode(self, ids) -> str:
+        # ids outside the vocab (e.g. the 50257..50303 padding range a
+        # model's padded head can emit) render as U+FFFD instead of raising
+        text = "".join(self.decoder.get(int(i), "�") for i in ids)
+        data = bytearray()
+        for c in text:
+            b = self.byte_dec.get(c)
+            if b is None:
+                data.extend("�".encode("utf-8"))
+            else:
+                data.append(b)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_encoder(bpe_dir: str | None = None):
+    """Best-effort zero-egress (encode, decode) pair.
+
+    Order: local BPE files (GPT2_BPE_DIR, default ./gpt2_bpe) -> tiktoken
+    (works only with a warm cache or network) -> raises with instructions.
+    """
+    bpe_dir = bpe_dir or os.environ.get("GPT2_BPE_DIR", "gpt2_bpe")
+    local_err = None
+    if os.path.isdir(bpe_dir):
+        try:
+            bpe = GPT2BPE.from_dir(bpe_dir)
+            return bpe.encode, bpe.decode
+        except FileNotFoundError as e:
+            # dir exists but lacks the data files — still try tiktoken
+            # (the promised fallback) before giving up
+            local_err = e
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+        return enc.encode, enc.decode
+    except Exception as e:
+        raise FileNotFoundError(
+            f"no GPT-2 BPE available: local dir {bpe_dir!r} "
+            f"{'incomplete (' + str(local_err) + ')' if local_err else 'absent'} "
+            f"and tiktoken failed ({type(e).__name__}: {e}). Drop "
+            "encoder.json/vocab.bpe (or vocab.json/merges.txt) into "
+            f"{bpe_dir!r} — see mamba_distributed_tpu/data/gpt2_bpe.py."
+        )

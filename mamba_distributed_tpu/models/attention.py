@@ -135,7 +135,13 @@ def attention_mixer(
 
         out = ring_attention(seq_ctx, q, k, v)
     else:
-        out = _sdpa_causal(q, k, v)
+        from mamba_distributed_tpu.ops.blockwise_attention import (
+            blockwise_sdpa_causal,
+        )
+
+        # O(t*block) memory — never materializes the (t, t) score tensor
+        # (config 5 at T=8192); the tiny-t decode path keeps _sdpa_causal
+        out = blockwise_sdpa_causal(q, k, v)
     y = linear(params["out_proj"], out.reshape(b, t, nh * hd), compute_dtype)
     if return_final_state:
         return y, (k, v, jnp.array(t, jnp.int32))

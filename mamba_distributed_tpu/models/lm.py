@@ -10,8 +10,13 @@ embeddings, fused add+RMSNorm between blocks, optional gated MLP when
 TPU-native structure: homogeneous stacks run as ``lax.scan`` over
 layer-stacked parameters (one compiled block body regardless of depth,
 which is also the FSDP-friendly layout — shard the non-layer axes and the
-scan slices locally); hybrid stacks interleave attention via a Python loop.
-Per-block ``jax.checkpoint`` implements activation rematerialization.
+scan slices locally).  Hybrid stacks with a *periodic* attention pattern
+(one attn layer every ``period`` layers — BASELINE config 5's shape) run
+as a scan over supersteps of ``[offset mamba] -> attn -> [rest mamba]``,
+so trace/compile cost is O(period), not O(n_layer); aperiodic patterns
+fall back to a per-layer Python unroll (compile-time bound pinned by
+tests/test_model.py).  Per-block ``jax.checkpoint`` implements activation
+rematerialization.
 """
 
 from __future__ import annotations
@@ -151,6 +156,36 @@ def _remat(fn, cfg: ModelConfig, static_argnums=()):
     return jax.checkpoint(fn, policy=policy, static_argnums=static_argnums)
 
 
+def _hybrid_period(cfg: ModelConfig):
+    """Detect a periodic hybrid pattern.
+
+    Returns (period, offset) when ``attn_layer_idx`` is exactly one
+    attention layer per ``period = n_layer / n_attn`` layers at a fixed
+    in-period ``offset`` (config 5: every 8th layer at offset 3); None
+    for aperiodic patterns (which take the unrolled path).
+    """
+    idx = cfg.attn_layer_idx
+    n_attn = len(idx)
+    if n_attn == 0 or cfg.n_layer % n_attn:
+        return None
+    p = cfg.n_layer // n_attn
+    r = idx[0]
+    if not 0 <= r < p:
+        return None
+    if tuple(idx) != tuple(r + g * p for g in range(n_attn)):
+        return None
+    return p, r
+
+
+def _group_mamba_stack(params, cfg: ModelConfig, period: int):
+    """(n_mamba, ...) stacked mamba blocks -> (n_attn, period-1, ...)."""
+    n_groups = len(cfg.attn_layer_idx)
+    return jax.tree.map(
+        lambda x: x.reshape((n_groups, period - 1) + x.shape[1:]),
+        params["blocks"],
+    )
+
+
 def init_lm_params(key: jax.Array, cfg: ModelConfig) -> dict:
     """Build the full parameter pytree (fp32 master weights)."""
     n = cfg.n_layer
@@ -192,7 +227,35 @@ def lm_forward(
     hidden = params["embedding"][input_ids].astype(compute_dtype)
     residual = None
 
-    if cfg.attn_layer_idx:
+    if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
+        # periodic hybrid: scan over supersteps — trace cost O(period)
+        p, r = per
+        residual = jnp.zeros_like(
+            hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+        )
+        mstack = _group_mamba_stack(params, cfg, p)
+
+        def mbody(carry, bp):
+            h, rs = carry
+            h, rs = _block_fwd(bp, cfg, h, rs, False, seq_ctx)
+            return (h, rs), None
+
+        abody = _block_fwd
+        if cfg.remat:
+            mbody = _remat(mbody, cfg)
+            abody = _remat(abody, cfg, static_argnums=(1, 4, 5))
+
+        def group(carry, xs):
+            mblk, ablk = xs
+            carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[:r], mblk))
+            carry = abody(ablk, cfg, *carry, True, seq_ctx)
+            carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[r:], mblk))
+            return carry, None
+
+        (hidden, residual), _ = jax.lax.scan(
+            group, (hidden, residual), (mstack, params["attn_blocks"])
+        )
+    elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
         mi = ai = 0
         for i in range(cfg.n_layer):
@@ -325,7 +388,46 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
         pad = [(0, 0), (0, max_len - k.shape[1]), (0, 0), (0, 0)]
         return jnp.pad(k, pad), jnp.pad(v, pad), length
 
-    if cfg.attn_layer_idx:
+    if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
+        # periodic hybrid: superstep scan mirroring lm_forward's
+        p, r = per
+        residual = jnp.zeros_like(
+            hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+        )
+        mstack = _group_mamba_stack(params, cfg, p)
+
+        def mbody(carry, bp):
+            h, rs = carry
+            h, rs, st = _block_fwd(bp, cfg, h, rs, False, return_state=True)
+            return (h, rs), st
+
+        def group(carry, xs):
+            mblk, ablk = xs
+            carry, st_pre = jax.lax.scan(
+                mbody, carry, jax.tree.map(lambda x: x[:r], mblk)
+            )
+            hidden, residual, a_st = _block_fwd(
+                ablk, cfg, *carry, True, return_state=True
+            )
+            carry, st_post = jax.lax.scan(
+                mbody, (hidden, residual), jax.tree.map(lambda x: x[r:], mblk)
+            )
+            m_st = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], axis=0), st_pre, st_post
+            )
+            return carry, (m_st, pad_attn(a_st))
+
+        (hidden, residual), (m_states, a_states) = jax.lax.scan(
+            group, (hidden, residual), (mstack, params["attn_blocks"])
+        )
+        state = {
+            # (n_attn, period-1, ...) -> (n_mamba, ...), global layer order
+            "blocks": jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), m_states
+            ),
+            "attn_blocks": a_states,
+        }
+    elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
         mi = ai = 0
         m_states, a_states = [], []
@@ -387,14 +489,73 @@ def init_lm_state(cfg: ModelConfig, batch: int, max_len: int = 0):
     }
 
 
+def _block_step(bp, cfg: ModelConfig, hidden, residual, st, attn: bool):
+    """One decode-step block (shared by the scan and unrolled paths)."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    normed, residual = add_rms_norm(
+        hidden, residual, bp["norm"]["weight"], cfg.norm_eps,
+    )
+    if attn:
+        hidden, st = attention_mixer_step(bp["mixer"], cfg, normed, st)
+    else:
+        mix_step = (
+            mamba2_mixer_step if cfg.ssm_layer == "mamba2" else mamba1_mixer_step
+        )
+        hidden, st = mix_step(bp["mixer"], cfg, normed, *st)
+    if cfg.d_intermediate > 0:
+        normed, residual = add_rms_norm(
+            hidden, residual, bp["norm2"]["weight"], cfg.norm_eps,
+        )
+        hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
+    return hidden, residual, st
+
+
 def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
     """One decode step.  token (b,) int32 -> (logits (b, V), new state)."""
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = params["embedding"][token].astype(compute_dtype)
     residual = None
-    mix_step = mamba2_mixer_step if cfg.ssm_layer == "mamba2" else mamba1_mixer_step
 
-    if cfg.attn_layer_idx:
+    def mbody(carry, xs):
+        h, rs = carry
+        bp, st = xs
+        h, rs, st = _block_step(bp, cfg, h, rs, st, False)
+        return (h, rs), st
+
+    if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
+        p, r = per
+        residual = jnp.zeros_like(hidden, dtype=jnp.float32)
+        mstack = _group_mamba_stack(params, cfg, p)
+        mstate = jax.tree.map(
+            lambda s: s.reshape((len(cfg.attn_layer_idx), p - 1) + s.shape[1:]),
+            state["blocks"],
+        )
+
+        def group(carry, xs):
+            mblk, ablk, mst, ast = xs
+            pre = lambda x: jax.tree.map(lambda v: v[:r], x)
+            post = lambda x: jax.tree.map(lambda v: v[r:], x)
+            carry, new_pre = jax.lax.scan(mbody, carry, (pre(mblk), pre(mst)))
+            hidden, residual, ast = _block_step(ablk, cfg, *carry, ast, True)
+            carry, new_post = jax.lax.scan(
+                mbody, (hidden, residual), (post(mblk), post(mst))
+            )
+            new_m = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], axis=0), new_pre, new_post
+            )
+            return carry, (new_m, ast)
+
+        (hidden, residual), (new_m, new_a) = jax.lax.scan(
+            group, (hidden, residual),
+            (mstack, params["attn_blocks"], mstate, state["attn_blocks"]),
+        )
+        new_state = {
+            "blocks": jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), new_m
+            ),
+            "attn_blocks": new_a,
+        }
+    elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
         mi = ai = 0
         new_m, new_a = [], []
@@ -406,43 +567,19 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
             else:
                 bp = jax.tree.map(lambda p, j=mi: p[j], params["blocks"])
                 st = jax.tree.map(lambda s, j=mi: s[j], state["blocks"])
-            normed, residual = add_rms_norm(
-                hidden, residual, bp["norm"]["weight"], cfg.norm_eps,
-            )
+            hidden, residual, st = _block_step(bp, cfg, hidden, residual, st, attn)
             if attn:
-                hidden, st = attention_mixer_step(bp["mixer"], cfg, normed, st)
                 new_a.append(st)
                 ai += 1
             else:
-                hidden, st = mix_step(bp["mixer"], cfg, normed, *st)
                 new_m.append(st)
                 mi += 1
-            if cfg.d_intermediate > 0:
-                normed, residual = add_rms_norm(
-                    hidden, residual, bp["norm2"]["weight"], cfg.norm_eps,
-                )
-                hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
         stack = lambda states: jax.tree.map(lambda *xs: jnp.stack(xs), *states)
         new_state = {"blocks": stack(new_m), "attn_blocks": stack(new_a)}
     else:
         residual = jnp.zeros_like(hidden, dtype=jnp.float32)
-
-        def body(carry, xs):
-            hidden, residual = carry
-            bp, st = xs
-            normed, residual = add_rms_norm(
-                hidden, residual, bp["norm"]["weight"], cfg.norm_eps,
-            )
-            hidden, st = mix_step(bp["mixer"], cfg, normed, *st)
-            if cfg.d_intermediate > 0:
-                normed, residual = add_rms_norm(
-                    hidden, residual, bp["norm2"]["weight"], cfg.norm_eps,
-                )
-                hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
-            return (hidden, residual), st
-
         (hidden, residual), new_blocks = jax.lax.scan(
-            body, (hidden, residual), (params["blocks"], state["blocks"])
+            mbody, (hidden, residual), (params["blocks"], state["blocks"])
         )
         new_state = {"blocks": new_blocks}
 

@@ -148,7 +148,7 @@ def mamba2_mixer(
 
         y, ssm_state = sp_ssd(
             seq_ctx, x, dtf, A, B, C, cfg.chunk_size, D,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, ssm_impl=cfg.ssm_impl,
         )
     elif cfg.ssm_impl == "pallas":
         from mamba_distributed_tpu.ops.pallas import ssd_chunked_pallas

@@ -1,0 +1,124 @@
+"""Blockwise (flash-style) causal attention — online softmax over KV blocks.
+
+Replaces the full (t, t) fp32 score tensor of a naive SDPA with an
+O(t * block) working set: the KV sequence is consumed block-by-block
+under ``lax.scan``, carrying the (running max, numerator, denominator)
+online-softmax state — the published blockwise/flash construction
+(Dao et al. 2022, Liu et al. 2023), built TPU-first:
+
+- the per-block update is two batched matmuls (MXU) with an elementwise
+  chain between them that XLA fuses; blocks are lane-aligned slabs, so a
+  Pallas kernel would only replicate what the scan already gives us
+  (measure-first rationale, docs/KERNELS.md);
+- compute is uniform across (q, kv) block pairs with masking — no
+  data-dependent control flow inside jit; fully-future pairs are
+  computed-and-masked, trading ~2x score FLOPs (attention is a small
+  slice of hybrid-layer FLOPs) for a branch-free schedule;
+- the same block update runs *inside each ring-attention hop*
+  (parallel/ring_attention.py), so the sharded path has the identical
+  memory profile.
+
+The reference's attention surface lives one dep down
+(``mamba_ssm.modules.mha.MHA``, flash-attn CUDA kernels); this is the
+TPU-native equivalent for BASELINE config 5 (T=8192 hybrid).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from mamba_distributed_tpu.ops.scan import _divisor_chunk
+
+DEFAULT_BLOCK = 256
+
+
+def ols_init(b: int, nkv: int, rep: int, tq: int, hd: int):
+    """Fresh online-softmax accumulator for a (b, tq, nkv, rep, hd) Q slab."""
+    m = jnp.full((b, nkv, rep, tq), -jnp.inf, jnp.float32)
+    num = jnp.zeros((b, nkv, rep, tq, hd), jnp.float32)
+    den = jnp.zeros((b, nkv, rep, tq), jnp.float32)
+    return m, num, den
+
+
+def ols_block_update(acc, qh, k_blk, v_blk, qpos, kpos):
+    """Fold one KV block into the accumulator.
+
+    qh (b, tq, nkv, rep, hd); k_blk/v_blk (b, kb, nkv, hd); qpos (tq,)
+    and kpos (kb,) are absolute positions for the causal mask.  All
+    softmax math in fp32; the two contractions take
+    ``preferred_element_type=f32`` so the MXU accumulates in fp32.
+    """
+    m, num, den = acc
+    hd = qh.shape[-1]
+    s = jnp.einsum(
+        "bqgrh,bkgh->bgrqk", qh, k_blk, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)
+    s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None, None], s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # fully-masked-so-far rows keep m = -inf; exp(-inf - -inf) is guarded
+    scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    num = num * scale[..., None] + jnp.einsum(
+        "bgrqk,bkgh->bgrqh", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
+    den = den * scale + jnp.sum(p, axis=-1)
+    return m_new, num, den
+
+
+def ols_finalize(acc, out_dtype):
+    """(b, nkv, rep, tq, hd) accumulator -> (b, tq, nh, hd) output."""
+    _, num, den = acc
+    out = num / jnp.maximum(den[..., None], 1e-30)
+    b, nkv, rep, tq, hd = out.shape
+    return jnp.moveaxis(out, 3, 1).reshape(b, tq, nkv * rep, hd).astype(out_dtype)
+
+
+def blockwise_sdpa_causal(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    offset: int | jax.Array = 0,
+    q_block: int = DEFAULT_BLOCK,
+    k_block: int = DEFAULT_BLOCK,
+) -> jax.Array:
+    """Causal softmax(QK^T/sqrt(d))V with GQA broadcast, O(t*block) memory.
+
+    q (b, tq, nh, hd); k/v (b, tk, nkv, hd); ``offset`` = absolute
+    position of q[0] minus that of k[0].  Matches the materialized
+    fp32-softmax SDPA to fp32 tolerance (tests/test_attention.py).
+    """
+    b, tq, nh, hd = q.shape
+    tk, nkv = k.shape[1], k.shape[2]
+    rep = nh // nkv
+    qb = _divisor_chunk(tq, q_block)
+    kb = _divisor_chunk(tk, k_block)
+    nq, nk = tq // qb, tk // kb
+
+    qs = jnp.moveaxis(q.reshape(b, nq, qb, nkv, rep, hd), 1, 0)
+    ks = jnp.moveaxis(k.reshape(b, nk, kb, nkv, hd), 1, 0)
+    vs = jnp.moveaxis(v.reshape(b, nk, kb, nkv, hd), 1, 0)
+
+    def one_q_block(args):
+        qi, q_blk = args
+        qpos = offset + qi * qb + jnp.arange(qb)
+
+        def kv_step(acc, inp):
+            kj, k_b, v_b = inp
+            kpos = kj * kb + jnp.arange(kb)
+            return ols_block_update(acc, q_blk, k_b, v_b, qpos, kpos), None
+
+        acc, _ = jax.lax.scan(
+            kv_step, ols_init(b, nkv, rep, qb, hd), (jnp.arange(nk), ks, vs)
+        )
+        return ols_finalize(acc, q.dtype)
+
+    if nq == 1:
+        out = one_q_block((jnp.int32(0), qs[0]))[None]
+    else:
+        out = jax.lax.map(one_q_block, (jnp.arange(nq), qs))
+    return jnp.moveaxis(out, 0, 1).reshape(b, tq, nh, hd)

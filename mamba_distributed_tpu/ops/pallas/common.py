@@ -1,0 +1,27 @@
+"""Shared Pallas-kernel plumbing."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def resolve_interpret(interpret: bool | None) -> bool:
+    """Resolve the ``interpret=None`` auto-default for a pallas_call.
+
+    Auto picks the real Mosaic lowering on TPU (including tunneled
+    platforms whose backend name isn't "tpu") and the Pallas interpreter
+    elsewhere, so CPU tests run the same kernel code.  The
+    ``MDT_PALLAS_INTERPRET`` env var ("0"/"1") overrides auto-detection —
+    lowering tests set it to "0" to force the real Mosaic path through
+    *composed* graphs (models, shard_map) that never see an ``interpret``
+    argument.
+    """
+    if interpret is not None:
+        return interpret
+    env = os.environ.get("MDT_PALLAS_INTERPRET")
+    if env is not None:
+        return env != "0"
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    return not (jax.default_backend() == "tpu" or "tpu" in kind)

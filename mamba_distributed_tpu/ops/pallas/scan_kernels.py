@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mamba_distributed_tpu.ops.pallas.common import resolve_interpret
 from mamba_distributed_tpu.ops.scan import _prep
 
 
@@ -377,9 +378,7 @@ def selective_scan_pallas(
     carry zero state and are sliced off), autodiff handles the pad/slice,
     and interpret mode takes the identical path so CPU tests exercise it.
     """
-    if interpret is None:
-        kind = getattr(jax.devices()[0], "device_kind", "").lower()
-        interpret = not (jax.default_backend() == "tpu" or "tpu" in kind)
+    interpret = resolve_interpret(interpret)
 
     b, t, d = u.shape
     uf, df, Af, Bf, Cf, Df = _prep(u, delta, A, B, C, D, delta_bias, delta_softplus)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mamba_distributed_tpu.ops.pallas.common import resolve_interpret
 from mamba_distributed_tpu.ops.scan import _divisor_chunk
 from mamba_distributed_tpu.ops.ssd import state_passing
 
@@ -104,12 +105,24 @@ def _chunk_output_kernel(
     )  # (l, hb*p)
 
 
-def _heads_per_block(h: int, p: int, g: int) -> int:
+def _heads_per_block(h: int, p: int, g: int, max_hb: int | None = None) -> int:
     hb = max(1, 128 // p)
+    if max_hb is not None:
+        hb = max(1, min(hb, max_hb))
     heads_per_group = h // g
     while heads_per_group % hb != 0 or h % hb != 0:
         hb -= 1
     return max(hb, 1)
+
+
+def _bwd_hb_cap(l: int) -> int:
+    """VMEM guard for the backward cell kernel (ADVICE r3): it holds ~5
+    (hb, l, l) fp32 tensors live (diff, Lm, M, dM, dMM), so cap hb to
+    keep that working set under ~4MB — the same budget the m1 backward's
+    rebuilt-state scratch honors.  Small headdim + large chunk (p=8 ->
+    hb=16 at l=256 would be ~20MB) is exactly the case this catches."""
+    budget = 4 * 1024 * 1024
+    return max(1, budget // (5 * l * l * 4))
 
 
 def _cell_specs(h: int, hb: int, l: int, p: int, n: int, g: int):
@@ -154,13 +167,13 @@ def _from_cells(v, b, t, h, p):
     return v.reshape(b, t, h, p)
 
 
-def _chunked_inputs(x, dt, A, B, C, chunk_size):
+def _chunked_inputs(x, dt, A, B, C, chunk_size, max_hb=None):
     """Shared fwd/bwd preprocessing: chunk/cell layouts + in-chunk log-decay."""
     b, t, h, p = x.shape
     g, n = B.shape[2], B.shape[3]
     l = _divisor_chunk(t, chunk_size)
     nc = t // l
-    hb = _heads_per_block(h, p, g)
+    hb = _heads_per_block(h, p, g, max_hb)
     nhb = h // hb
     if p % 8 != 0:  # the (p, n)-trailing state blocks need 8-sublane tiles
         raise ValueError(
@@ -357,10 +370,21 @@ def _ssd_bwd_cell_kernel(
     dC_ref[0, 0, 0] = dC_acc
 
 
-def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret):
-    """Full backward: recompute chunk states, reverse-scan, cell kernel."""
+def _ssd_pallas_bwd_impl(
+    x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret,
+    initial_state=None, dfinal=None,
+):
+    """Full backward: recompute chunk states, reverse-scan, cell kernel.
+
+    ``initial_state`` (b, h, p, n) makes the recomputed entering states
+    match a forward that was seeded (decode prefill / SP shards), and its
+    gradient is returned as the sixth output.  ``dfinal`` is the cotangent
+    of the final state when the forward returned it; it seeds the reverse
+    state scan the same way ``initial_state`` seeds the forward one.
+    """
+    l0 = _divisor_chunk(x.shape[1], chunk_size)
     xr, dtr, ar, chunk_decay, Br, Cr, dims = _chunked_inputs(
-        x, dt, A, B, C, chunk_size
+        x, dt, A, B, C, chunk_size, max_hb=_bwd_hb_cap(l0)
     )
     b, nc, l, h, hb, p, g, n = dims
     t = nc * l
@@ -380,7 +404,7 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
         compiler_params=_PARALLEL3,
         interpret=interpret,
     )(xr, dtr, ar, Br)
-    prev_states, _ = state_passing(states, chunk_decay)
+    prev_states, _ = state_passing(states, chunk_decay, initial_state)
 
     # direct state gradient from each chunk's off-diagonal output
     dP = pl.pallas_call(
@@ -393,8 +417,16 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
         interpret=interpret,
     )(dyr, ar, Cr)
 
-    # reverse associative scan: gP_c = dP_c + gamma_c * gP_{c+1}
+    # reverse associative scan: gP_c = dP_c + gamma_c * gP_{c+1}.  A final-
+    # state cotangent seeds it as a virtual chunk nc with dP = dfinal (its
+    # own decay entry is never consumed), so gP_c picks up the
+    # prod(gamma)-propagated dfinal term for free.
     decay = chunk_decay[..., None, None]             # (b, nc, h, 1, 1)
+    if dfinal is not None:
+        dP = jnp.concatenate(
+            [dP, dfinal.astype(dP.dtype)[:, None]], axis=1
+        )
+        decay = jnp.concatenate([decay, jnp.ones_like(decay[:, :1])], axis=1)
 
     def combine(left, right):
         a_l, s_l = left
@@ -404,8 +436,13 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
     _, gP_rev = jax.lax.associative_scan(
         combine, (jnp.flip(decay, 1), jnp.flip(dP, 1)), axis=1
     )
-    gP = jnp.flip(gP_rev, 1)
-    dS = jnp.concatenate([gP[:, 1:], jnp.zeros_like(gP[:, :1])], axis=1)
+    gP = jnp.flip(gP_rev, 1)                         # (b, nc(+1), h, p, n)
+    if dfinal is not None:
+        dS = gP[:, 1:]                               # virtual chunk = dfinal
+    else:
+        dS = jnp.concatenate([gP[:, 1:], jnp.zeros_like(gP[:, :1])], axis=1)
+    # gradient wrt the state entering chunk 0 == wrt initial_state
+    dinit = gP[:, 0] if initial_state is not None else None
     dgamma = jnp.sum(dS * prev_states, axis=(3, 4))  # (b, nc, h)
 
     dx_c, ddt5, da5, dB_cell, dC_cell = pl.pallas_call(
@@ -456,6 +493,7 @@ def _ssd_pallas_bwd_impl(x, dt, A, B, C, dy, chunk_size, compute_dtype, interpre
         dA.astype(A.dtype),
         dB.astype(B.dtype),
         dC.astype(C.dtype),
+        dinit,
     )
 
 
@@ -470,26 +508,38 @@ def _add_D(y, x, D):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9)
 )
-def _ssd_pallas_core(x, dt, A, B, C, chunk_size, compute_dtype, interpret):
-    y, _ = _ssd_pallas_fwd_impl(
-        x, dt, A, B, C, chunk_size, None, compute_dtype, interpret
+def _ssd_pallas_core(
+    x, dt, A, B, C, initial_state, chunk_size, compute_dtype, interpret,
+    return_final_state,
+):
+    y, final = _ssd_pallas_fwd_impl(
+        x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
     )
-    return y
+    return (y, final) if return_final_state else y
 
 
-def _core_fwd(x, dt, A, B, C, chunk_size, compute_dtype, interpret):
-    y = _ssd_pallas_core(x, dt, A, B, C, chunk_size, compute_dtype, interpret)
-    return y, (x, dt, A, B, C)
+def _core_fwd(
+    x, dt, A, B, C, initial_state, chunk_size, compute_dtype, interpret,
+    return_final_state,
+):
+    out = _ssd_pallas_core(
+        x, dt, A, B, C, initial_state, chunk_size, compute_dtype, interpret,
+        return_final_state,
+    )
+    return out, (x, dt, A, B, C, initial_state)
 
 
-def _core_bwd(chunk_size, compute_dtype, interpret, res, dy):
+def _core_bwd(chunk_size, compute_dtype, interpret, return_final_state, res, ct):
     """Pallas backward (see the backward section above)."""
-    x, dt, A, B, C = res
-    return _ssd_pallas_bwd_impl(
-        x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret
+    x, dt, A, B, C, initial_state = res
+    dy, dfinal = ct if return_final_state else (ct, None)
+    dx, ddt, dA, dB, dC, dinit = _ssd_pallas_bwd_impl(
+        x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret,
+        initial_state=initial_state, dfinal=dfinal,
     )
+    return dx, ddt, dA, dB, dC, dinit
 
 
 _ssd_pallas_core.defvjp(_core_fwd, _core_bwd)
@@ -510,25 +560,21 @@ def ssd_chunked_pallas(
 ):
     """Drop-in for ops/ssd.ssd_chunked backed by Pallas kernels.
 
-    With ``return_final_state`` or ``initial_state`` (decode prefill / SP)
-    the non-custom-vjp path is used; the training path (neither) gets the
-    custom VJP with an XLA backward.  ``interpret=None`` auto-selects the
-    Pallas interpreter off-TPU (CPU tests run the same kernel code).
+    Every path — plain training, seeded (``initial_state``: decode
+    prefill / SP shards), and ``return_final_state`` — runs under the
+    custom VJP whose backward is itself Pallas (kernels above): the
+    seeded forward recomputes entering states from the same seed, a
+    final-state cotangent seeds the reverse state scan, and the
+    initial-state gradient comes back as ``gP[0]``.  ``interpret=None``
+    auto-selects the Pallas interpreter off-TPU (CPU tests run the same
+    kernel code).
     """
-    if interpret is None:
-        # real Mosaic lowering on TPU (incl. tunneled platforms whose
-        # backend name isn't "tpu"); interpreter elsewhere (CPU tests)
-        kind = getattr(jax.devices()[0], "device_kind", "").lower()
-        interpret = not (jax.default_backend() == "tpu" or "tpu" in kind)
-    if initial_state is None and not return_final_state:
-        y = _ssd_pallas_core(
-            x, dt, A, B, C, chunk_size, compute_dtype, interpret
-        )
-        return _add_D(y, x, D)
-    y, final_state = _ssd_pallas_fwd_impl(
-        x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
+    interpret = resolve_interpret(interpret)
+    out = _ssd_pallas_core(
+        x, dt, A, B, C, initial_state, chunk_size, compute_dtype, interpret,
+        return_final_state,
     )
-    y = _add_D(y, x, D)
     if return_final_state:
-        return y, final_state
-    return y
+        y, final_state = out
+        return _add_D(y, x, D), final_state
+    return _add_D(out, x, D)

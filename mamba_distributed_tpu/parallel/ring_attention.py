@@ -7,34 +7,31 @@ whole sequence.  Causality is enforced per (q-block, kv-block) pair from
 the global block indices; fully-future blocks are computed-and-masked
 (compute is uniform, which XLA/TPU prefers over divergent control flow).
 
+Within each hop the received KV shard is consumed in flash-style
+sub-blocks (ops/blockwise_attention.py — the same update the dense path
+uses), so the per-hop working set is O(t_local * block), never the
+(t_local, t_local) fp32 score slab.
+
 The math follows the published blockwise/ring-attention construction
 (Liu et al. 2023); the implementation is an in-tree shard_map + lax.scan.
 """
 
 from __future__ import annotations
 
-import math
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-
-def _block_attn(q, k, v, qpos, kpos):
-    """Masked fp32 scores for one (q-block, kv-block) pair.
-
-    q (b, tq, nkv, rep, hd); k/v (b, tk, nkv, hd).
-    Returns scores (b, nkv, rep, tq, tk) with -inf above the causal line.
-    """
-    hd = q.shape[-1]
-    scores = jnp.einsum(
-        "bqgrh,bkgh->bgrqk", q, k, preferred_element_type=jnp.float32
-    ) / math.sqrt(hd)
-    mask = qpos[:, None] >= kpos[None, :]  # (tq, tk)
-    return jnp.where(mask[None, None, None], scores, -jnp.inf)
+from mamba_distributed_tpu.ops.blockwise_attention import (
+    DEFAULT_BLOCK,
+    ols_block_update,
+    ols_finalize,
+    ols_init,
+)
+from mamba_distributed_tpu.ops.scan import _divisor_chunk
 
 
-def ring_attention(seq_ctx, q, k, v):
+def ring_attention(seq_ctx, q, k, v, k_block: int = DEFAULT_BLOCK):
     """q (b, t, nh, hd), k/v (b, t, nkv, hd), t sharded over seq_ctx.axis.
 
     Returns (b, t, nh, hd) in q.dtype.  Exact (up to fp32 softmax) match
@@ -52,27 +49,25 @@ def ring_attention(seq_ctx, q, k, v):
         my = jax.lax.axis_index(ctx.axis)
         qh = q_l.reshape(bl, tl, nkv, rep, hd)
         qpos = my * tl + jnp.arange(tl)
+        kb = _divisor_chunk(tl, k_block)
+        nkb = tl // kb
 
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def accumulate(acc, kv, i):
-            m, num, den = acc
             k_i, v_i = kv
-            # kv block currently held came from rank (my - i) mod n
+            # kv shard currently held came from rank (my - i) mod n
             src = (my - i) % n
-            kpos = src * tl + jnp.arange(tl)
-            s = _block_attn(qh, k_i, v_i, qpos, kpos)  # (b,g,r,tq,tk)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            # guard: fully-masked rows keep m at -inf; exp(-inf - -inf) -> use where
-            scale = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
-            p = jnp.exp(s - m_new[..., None])
-            p = jnp.where(jnp.isfinite(s), p, 0.0)
-            num = num * scale[..., None] + jnp.einsum(
-                "bgrqk,bkgh->bgrqh", p.astype(v_i.dtype), v_i,
-                preferred_element_type=jnp.float32,
-            )
-            den = den * scale + jnp.sum(p, axis=-1)
-            return m_new, num, den
+            ks = jnp.moveaxis(k_i.reshape(bl, nkb, kb, nkv, hd), 1, 0)
+            vs = jnp.moveaxis(v_i.reshape(bl, nkb, kb, nkv, hd), 1, 0)
+
+            def kv_step(a, inp):
+                kj, k_b, v_b = inp
+                kpos = src * tl + kj * kb + jnp.arange(kb)
+                return ols_block_update(a, qh, k_b, v_b, qpos, kpos), None
+
+            acc, _ = jax.lax.scan(kv_step, acc, (jnp.arange(nkb), ks, vs))
+            return acc
 
         def step(carry, i):
             kv, acc = carry
@@ -80,18 +75,13 @@ def ring_attention(seq_ctx, q, k, v):
             kv = jax.lax.ppermute(kv, ctx.axis, perm)
             return (kv, acc), None
 
-        m0 = jnp.full((bl, nkv, rep, tl), -jnp.inf, jnp.float32)
-        num0 = jnp.zeros((bl, nkv, rep, tl, hd), jnp.float32)
-        den0 = jnp.zeros((bl, nkv, rep, tl), jnp.float32)
-        # n-1 hops; the last block is consumed without a wasted final permute
+        # n-1 hops; the last shard is consumed without a wasted final permute
         (kv, acc), _ = jax.lax.scan(
-            step, ((k_l, v_l), (m0, num0, den0)), jnp.arange(n - 1)
+            step, ((k_l, v_l), ols_init(bl, nkv, rep, tl, hd)),
+            jnp.arange(n - 1),
         )
-        m, num, den = accumulate(acc, kv, n - 1)
-        out = num / jnp.maximum(den[..., None], 1e-30)
-        # (b, g, r, tq, hd) -> (b, tq, g*r, hd)
-        out = jnp.moveaxis(out, 3, 1).reshape(bl, tl, nh, hd)
-        return out.astype(q_l.dtype)
+        acc = accumulate(acc, kv, n - 1)
+        return ols_finalize(acc, q_l.dtype)
 
     fn = jax.shard_map(
         local, mesh=ctx.mesh, in_specs=(bat4, bat4, bat4), out_specs=bat4,

@@ -107,6 +107,44 @@ def sp_conv1d(
     return fn(*args), None
 
 
+def _seeded_correction(dt, A, C, s_in, chunk_size, compute_dtype):
+    """Off-diagonal contribution of a shard's incoming state.
+
+    The seeded SSD output is *linear* in the incoming state: chunk c adds
+    ``diag(e^{a}) C @ (prefix_c * s_in)^T`` where ``prefix_c`` is the
+    product of the chunk decays before c.  Computing the seed as a
+    correction on top of the *unseeded* forward keeps the intra-chunk
+    work (Pallas kernels) to a single pass, with the cross-shard state
+    dependency confined to this cheap O(t*n*p) einsum.
+    """
+    from mamba_distributed_tpu.ops.scan import _divisor_chunk
+
+    b, t, g, n = C.shape
+    h = dt.shape[-1]
+    l = _divisor_chunk(t, chunk_size)
+    nc = t // l
+    hpg = h // g
+    p = s_in.shape[2]
+
+    dA = (dt.astype(jnp.float32) * A.astype(jnp.float32)).reshape(b, nc, l, h)
+    a_cum = jnp.cumsum(dA, axis=2)                   # in-chunk log-decay
+    chunk_sum = a_cum[:, :, -1, :]                   # (b, nc, h)
+    # prod of chunk decays BEFORE chunk c (exclusive prefix)
+    prefix = jnp.exp(jnp.cumsum(chunk_sum, axis=1) - chunk_sum)
+    e_a = jnp.exp(a_cum)                             # (b, nc, l, h)
+
+    s_eff = s_in.astype(jnp.float32)[:, None] * prefix[..., None, None]
+    s_eff = s_eff.reshape(b, nc, g, hpg, p, n)       # heads grouped: i -> (i//hpg, i%hpg)
+    C_r = C.reshape(b, nc, l, g, n)
+    corr = jnp.einsum(
+        "bclgn,bcgqpn->bclgqp",
+        C_r.astype(compute_dtype), s_eff.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    corr = corr * e_a.reshape(b, nc, l, g, hpg)[..., None]
+    return corr.reshape(b, t, h, p)
+
+
 def sp_ssd(
     ctx: SeqContext,
     x: jax.Array,
@@ -117,12 +155,19 @@ def sp_ssd(
     chunk_size: int,
     D: jax.Array | None = None,
     compute_dtype=jnp.bfloat16,
+    ssm_impl: str = "xla",
 ):
     """Sequence-sharded chunked SSD.
 
     Shapes as ops/ssd.ssd_chunked: x (b, t, h, p), dt (b, t, h),
     B/C (b, t, g, n), with t sharded over ``ctx.axis``.
     Returns (y, None) — the final state stays on the last shard.
+
+    ``ssm_impl="pallas"`` runs each shard's intra-chunk compute through
+    the fused VMEM kernels (ops/pallas/ssd_kernels.py, including their
+    Pallas backward via the seeded custom_vjp); only the O(d_state)
+    cross-shard state exchange stays shard_map/ppermute.  BASELINE
+    config 4 (2.8B, seq 8192) is exactly where this matters.
     """
     from mamba_distributed_tpu.ops.scan import _divisor_chunk
 
@@ -149,11 +194,33 @@ def sp_ssd(
             y_diag, off_ctx, prev_states, x_l, D_, compute_dtype
         )
 
+    def local_pallas(x_l, dt_l, A_, B_l, C_l, *rest):
+        from mamba_distributed_tpu.ops.pallas import ssd_chunked_pallas
+
+        D_ = rest[0] if has_D else None
+        # one unseeded Pallas pass gives both the local output and the
+        # shard summary; the incoming-state contribution is added as the
+        # linear correction (see _seeded_correction)
+        y0, final_local = ssd_chunked_pallas(
+            x_l, dt_l, A_, B_l, C_l, chunk_size=chunk_size, D=D_,
+            return_final_state=True, compute_dtype=compute_dtype,
+        )
+        decay_total = jnp.exp(
+            jnp.einsum(
+                "bth,h->bh",
+                dt_l.astype(jnp.float32), A_.astype(jnp.float32),
+            )
+        )
+        s_in = _incoming_state(ctx, decay_total, final_local)
+        corr = _seeded_correction(dt_l, A_, C_l, s_in, chunk_size, compute_dtype)
+        return (y0.astype(jnp.float32) + corr).astype(y0.dtype)
+
     in_specs = (bat4, bat3, P(None), bat4, bat4)
     if has_D:
         in_specs += (P(None, None) if D.ndim == 2 else P(None),)
     fn = jax.shard_map(
-        local, mesh=ctx.mesh, in_specs=in_specs, out_specs=bat4, check_vma=False
+        local_pallas if ssm_impl == "pallas" else local,
+        mesh=ctx.mesh, in_specs=in_specs, out_specs=bat4, check_vma=False,
     )
     args = (x, dt, A, B, C) + ((D,) if has_D else ())
     return fn(*args), None

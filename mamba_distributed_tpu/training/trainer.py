@@ -124,6 +124,9 @@ class Trainer:
         self._sample_prompt_ids = sample_prompt_ids
         self._decode_fn = decode_fn
         self._flops_per_token = flops_per_token(cfg.model, cfg.seq_len)
+        self._flops_per_token_model = flops_per_token(
+            cfg.model, cfg.seq_len, convention="model"
+        )
         self._peak = peak_flops_per_chip() * self.mesh.devices.size
 
     # ------------------------------------------------------------------
@@ -196,10 +199,11 @@ class Trainer:
             jax.block_until_ready(loss)
             dt = time.time() - t0
             tok_per_sec = tokens_per_step / dt
-            mfu = self._flops_per_token * tok_per_sec / self._peak
+            mfu = self._flops_per_token_model * tok_per_sec / self._peak
+            mfu_hw = self._flops_per_token * tok_per_sec / self._peak
             self.logger.train_step(
                 step, float(loss), float(self.schedule(step)), float(grad_norm),
-                dt, tok_per_sec, mfu,
+                dt, tok_per_sec, mfu, mfu_hw,
             )
             self.step += 1
 

@@ -61,7 +61,11 @@ class MetricsLogger:
                     f.write(json.dumps(_jsonable(record)) + "\n")
 
     def train_step(self, step: int, loss: float, lr: float, grad_norm: float,
-                   dt_s: float, tokens_per_sec: float, mfu: float) -> None:
+                   dt_s: float, tokens_per_sec: float, mfu: float,
+                   mfu_hw: float | None = None) -> None:
+        """``mfu`` is the model-FLOPs convention (the judged one);
+        ``mfu_hw`` additionally counts the chunked algorithm's extra
+        arithmetic (utils/flops.py module docstring)."""
         if not self.master:
             return
         print(
@@ -69,16 +73,16 @@ class MetricsLogger:
             f"norm: {grad_norm:.4f} | dt: {dt_s * 1000:.2f}ms | "
             f"tok/sec: {tokens_per_sec:.2f} | mfu: {mfu * 100:.1f}%"
         )
-        self._append(
-            f"{step} train {loss:.6f}",
-            {
-                "step": step, "kind": "train", "loss": round(loss, 6),
-                "lr": lr, "grad_norm": round(grad_norm, 4),
-                "step_ms": round(dt_s * 1000, 2),
-                "tokens_per_sec": round(tokens_per_sec, 1),
-                "mfu": round(mfu, 4),
-            },
-        )
+        record = {
+            "step": step, "kind": "train", "loss": round(loss, 6),
+            "lr": lr, "grad_norm": round(grad_norm, 4),
+            "step_ms": round(dt_s * 1000, 2),
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4),
+        }
+        if mfu_hw is not None:
+            record["mfu_hw"] = round(mfu_hw, 4)
+        self._append(f"{step} train {loss:.6f}", record)
 
     def val(self, step: int, loss: float) -> None:
         if not self.master:

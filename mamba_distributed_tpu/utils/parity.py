@@ -1,0 +1,164 @@
+"""Early-loss-curve parity checking against the reference's logged run.
+
+The reference's only correctness artifact is its training log
+(/root/reference/log/log_mamba.txt: ``"{step} train {loss:.6f}"`` /
+``"{step} val {loss:.4f}"`` lines, written by train.py:124,150,240).
+Our MetricsLogger emits the same 3-field format, so the two runs can be
+diffed directly.  Two comparison modes, because comparability depends on
+the data:
+
+- ``strict``: same data (tokenized FineWeb-Edu) — per-step losses must
+  match within a tolerance covering bf16 noise and per-device data
+  order.  This is the real parity claim (SURVEY.md §7 stage 3 exit
+  criterion: first ~30 steps track 10.99 -> ~9.0).
+- ``fingerprint``: synthetic stand-in data — only data-independent
+  fingerprints are compared: the t=0 loss must sit at the uniform-logits
+  value ln(vocab) (both runs start there regardless of data), the curve
+  must fall monotonically after smoothing, and the early drop must be a
+  healthy fraction of the reference's.  This validates the *harness*
+  (init, LR schedule, loss plumbing) while the chip / real data are
+  unavailable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+
+_LINE = re.compile(r"^(\d+)\s+(train|val)\s+([-+0-9.eEnainf]+)\s*$")
+
+
+def parse_log(text: str) -> dict[str, list[tuple[int, float]]]:
+    """Parse reference-format log text into {"train": [(step, loss)...],
+    "val": [...]} keeping file order.  Unparseable lines are skipped (the
+    console lines the reference also printed never land in log.txt)."""
+    out: dict[str, list[tuple[int, float]]] = {"train": [], "val": []}
+    for line in text.splitlines():
+        m = _LINE.match(line.strip())
+        if m:
+            out[m.group(2)].append((int(m.group(1)), float(m.group(3))))
+    return out
+
+
+def parse_log_file(path: str) -> dict[str, list[tuple[int, float]]]:
+    with open(path) as f:
+        return parse_log(f.read())
+
+
+@dataclasses.dataclass
+class ParityResult:
+    ok: bool
+    mode: str
+    steps_compared: int
+    checks: list[tuple[str, bool, str]]  # (name, passed, detail)
+
+    def report(self) -> str:
+        lines = [
+            f"parity mode={self.mode} steps={self.steps_compared} "
+            f"=> {'OK' if self.ok else 'FAIL'}"
+        ]
+        for name, passed, detail in self.checks:
+            lines.append(f"  [{'ok' if passed else 'FAIL'}] {name}: {detail}")
+        return "\n".join(lines)
+
+
+def _first_n_train(log: dict, n: int) -> list[float]:
+    seen: dict[int, float] = {}
+    for step, loss in log["train"]:
+        if step < n and step not in seen:
+            seen[step] = loss
+    return [seen[s] for s in sorted(seen)]
+
+
+def compare_strict(
+    ours: dict, ref: dict, steps: int = 30, tol: float = 0.35
+) -> ParityResult:
+    """Per-step loss diff over the first ``steps`` train steps.
+
+    ``tol`` covers bf16 compute noise, data-order differences across
+    device counts, and the reference's A100 vs TPU numerics — 0.35 is
+    tight enough to catch a wrong init/schedule/loss (those diverge by
+    >1 within 10 steps) and loose enough for hardware noise.
+    """
+    a = _first_n_train(ours, steps)
+    b = _first_n_train(ref, steps)
+    n = min(len(a), len(b))
+    checks = []
+    have = n >= min(steps, 10)
+    checks.append(("coverage", have, f"{n} comparable steps (need >= {min(steps, 10)})"))
+    if n:
+        diffs = [abs(x - y) for x, y in zip(a[:n], b[:n])]
+        worst = max(diffs)
+        at = diffs.index(worst)
+        ok = worst <= tol
+        checks.append(
+            ("per-step |loss diff|", ok,
+             f"max {worst:.4f} at step {at} (tol {tol})")
+        )
+    ok_all = all(p for _, p, _ in checks)
+    return ParityResult(ok_all, "strict", n, checks)
+
+
+def compare_fingerprint(
+    ours: dict,
+    ref: dict,
+    steps: int = 30,
+    vocab_size: int = 50304,
+    init_tol: float = 0.25,
+    min_drop_frac: float = 0.35,
+    smooth: int = 5,
+) -> ParityResult:
+    """Data-independent fingerprints of a healthy reference-recipe run."""
+    a = _first_n_train(ours, steps)
+    b = _first_n_train(ref, steps)
+    checks = []
+    n = min(len(a), len(b))
+    have = n >= min(steps, 10)
+    checks.append(("coverage", have, f"{n} comparable steps"))
+    if not have:
+        return ParityResult(False, "fingerprint", n, checks)
+
+    ln_v = math.log(vocab_size)
+    init_err = abs(a[0] - ln_v)
+    ref_init_err = abs(b[0] - ln_v)
+    checks.append(
+        ("t=0 loss ~ ln(vocab)", init_err <= init_tol,
+         f"ours {a[0]:.4f} vs ln({vocab_size})={ln_v:.4f} "
+         f"(|err| {init_err:.4f} <= {init_tol}; reference's was "
+         f"{ref_init_err:.4f})")
+    )
+
+    # smoothed-monotonic: every `smooth`-step window mean must fall
+    means = [
+        sum(a[i:i + smooth]) / len(a[i:i + smooth])
+        for i in range(0, n, smooth)
+    ]
+    mono = all(x > y for x, y in zip(means, means[1:]))
+    checks.append(
+        ("smoothed curve falls", mono,
+         f"{smooth}-step means {['%.3f' % m for m in means]}")
+    )
+
+    ref_drop = b[0] - min(b)
+    our_drop = a[0] - min(a)
+    frac = our_drop / ref_drop if ref_drop > 0 else float("nan")
+    checks.append(
+        (f"early drop >= {min_drop_frac:.0%} of reference's",
+         frac >= min_drop_frac,
+         f"ours {our_drop:.3f} vs ref {ref_drop:.3f} ({frac:.0%}); data "
+         "differs (synthetic zipf vs FineWeb) so only the order of "
+         "magnitude is comparable")
+    )
+    ok_all = all(p for _, p, _ in checks)
+    return ParityResult(ok_all, "fingerprint", n, checks)
+
+
+def compare(
+    ours: dict, ref: dict, mode: str = "fingerprint", steps: int = 30, **kw
+) -> ParityResult:
+    if mode == "strict":
+        return compare_strict(ours, ref, steps, **kw)
+    if mode == "fingerprint":
+        return compare_fingerprint(ours, ref, steps, **kw)
+    raise ValueError(f"unknown parity mode {mode!r}")

@@ -1,0 +1,47 @@
+"""Compare a training log against the reference's logged loss curve.
+
+  python scripts/compare_parity.py log_parity/log.txt               # fingerprint
+  python scripts/compare_parity.py our.txt --mode strict --steps 30 # real data
+
+Exit code 0 iff the comparison passes; the report goes to stdout.  The
+reference log defaults to the pinned copy at
+/root/reference/log/log_mamba.txt (steps 0-28: 10.9911 -> 8.98).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mamba_distributed_tpu.utils.parity import compare, parse_log_file  # noqa: E402
+
+REF_LOG = "/root/reference/log/log_mamba.txt"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ours", help="path to our reference-format log")
+    ap.add_argument("--ref", default=REF_LOG)
+    ap.add_argument("--mode", choices=("strict", "fingerprint"),
+                    default="fingerprint",
+                    help="strict: same training data; fingerprint: "
+                    "synthetic stand-in data (default)")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--tol", type=float, default=None,
+                    help="strict-mode per-step tolerance (default 0.35)")
+    args = ap.parse_args()
+
+    kw = {}
+    if args.mode == "strict" and args.tol is not None:
+        kw["tol"] = args.tol
+    res = compare(parse_log_file(args.ours), parse_log_file(args.ref),
+                  mode=args.mode, steps=args.steps, **kw)
+    print(res.report())
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

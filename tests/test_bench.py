@@ -19,6 +19,59 @@ def test_time_config_reports_errors_instead_of_raising():
     assert r["ssm_impl"] == "bogus"  # spec echoed for attribution
 
 
+def test_main_emits_structured_json_when_backend_unavailable(monkeypatch, capsys):
+    """A pool outage must produce one parseable JSON line, not a raw
+    traceback (the r2/r3 failure mode)."""
+    import json
+
+    def boom():
+        raise RuntimeError("Unable to initialize backend 'axon': UNAVAILABLE")
+
+    monkeypatch.setattr(bench, "init_backend", boom)
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert rec["value"] is None and rec["device"] is None
+    assert rec["error"].startswith("backend_unavailable: RuntimeError")
+    assert "UNAVAILABLE" in rec["error"]
+
+
+def test_flops_conventions():
+    """mfu_model's FLOPs basis must be strictly below the hardware
+    convention for mamba2 (chunked overhead dropped) and identical for
+    mamba1 (already the recurrence)."""
+    from mamba_distributed_tpu.config import get_preset
+
+    m2 = get_preset("mamba2-280m").model
+    from mamba_distributed_tpu.utils.flops import flops_per_token
+
+    hw = flops_per_token(m2, 1024, convention="hardware")
+    model = flops_per_token(m2, 1024, convention="model")
+    assert model < hw
+    m1 = get_preset("mamba1-280m").model
+    assert flops_per_token(m1, 1024, convention="hardware") == flops_per_token(
+        m1, 1024, convention="model"
+    )
+    with pytest.raises(ValueError, match="convention"):
+        flops_per_token(m2, 1024, convention="6nd")
+
+
+def test_main_emits_json_on_bad_iters(monkeypatch, capsys):
+    """Non-integer BENCH_ITERS must also keep the one-JSON-line contract."""
+    import json
+
+    monkeypatch.setattr(bench, "init_backend", lambda: type(
+        "D", (), {"device_kind": "cpu"})())
+    monkeypatch.setenv("BENCH_ITERS", "abc")
+    with pytest.raises(SystemExit):
+        bench.main()
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["error"].startswith("bad_env_spec")
+
+
 def test_env_spec_rejects_bad_remat(monkeypatch):
     monkeypatch.setenv("BENCH_REMAT", "yes")
     with pytest.raises(SystemExit, match="BENCH_REMAT"):

@@ -1,0 +1,139 @@
+"""Vendored GPT-2 byte-level BPE (data/gpt2_bpe.py).
+
+The real encoder.json/vocab.bpe are not present in this zero-egress
+environment, so the algorithm is pinned with a synthetic vocab built the
+same way the real one was: start from the 256 byte symbols, apply ranked
+merges.  Every semantic the real data relies on — the byte->unicode
+table, the pre-split regex, merge ordering, round-trip decode — is
+exercised."""
+
+import json
+import os
+
+import pytest
+
+from mamba_distributed_tpu.data.gpt2_bpe import (
+    GPT2BPE,
+    bytes_to_unicode,
+    load_encoder,
+)
+
+
+def _toy_bpe(tmp_path, merges):
+    """Build a valid (encoder.json, vocab.bpe) pair: 256 byte symbols +
+    one token per merge, ids in rank order (how the real vocab is laid
+    out for its first 256+N entries)."""
+    b2u = bytes_to_unicode()
+    symbols = [b2u[i] for i in range(256)]
+    vocab = {s: i for i, s in enumerate(symbols)}
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    d = tmp_path / "bpe"
+    d.mkdir()
+    (d / "encoder.json").write_text(json.dumps(vocab), encoding="utf-8")
+    (d / "vocab.bpe").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
+        encoding="utf-8",
+    )
+    return str(d)
+
+
+def test_bytes_to_unicode_bijective():
+    m = bytes_to_unicode()
+    assert len(m) == 256 and len(set(m.values())) == 256
+    # printable ascii maps to itself
+    assert m[ord("A")] == "A" and m[ord("!")] == "!"
+    # space is remapped (the property merges rely on: no raw whitespace)
+    assert m[ord(" ")] == "Ġ"
+
+
+def test_encode_without_merges_is_bytes(tmp_path):
+    d = _toy_bpe(tmp_path, [])
+    bpe = GPT2BPE.from_dir(d)
+    ids = bpe.encode("hi")
+    assert ids == [ord("h"), ord("i")]
+    assert bpe.decode(ids) == "hi"
+
+
+def test_merges_apply_in_rank_order(tmp_path):
+    # rank 0 merges 'h'+'e' first; 'he'+'y' then wins over nothing else
+    d = _toy_bpe(tmp_path, [("h", "e"), ("he", "y")])
+    bpe = GPT2BPE.from_dir(d)
+    assert bpe.encode("hey") == [bpe.encoder["hey"]]
+    assert bpe.encode("he") == [bpe.encoder["he"]]
+    assert bpe.decode(bpe.encode("hey")) == "hey"
+
+
+def test_presplit_keeps_leading_space_with_word(tmp_path):
+    """The ' word' pre-split rule HellaSwag's ' '-prefix convention
+    depends on (/root/reference/eval.py:96-98): a leading space binds to
+    the following word, so ' hey' can merge across the boundary."""
+    sp = "Ġ"  # byte-encoded space
+    d = _toy_bpe(tmp_path, [(sp, "h"), (sp + "h", "e")])
+    bpe = GPT2BPE.from_dir(d)
+    ids = bpe.encode("go hey")
+    # ' hey' pre-splits to [' hey'] -> merges to ' he' + 'y'
+    assert bpe.encoder[sp + "he"] in ids
+    assert bpe.decode(ids) == "go hey"
+
+
+def test_contractions_split(tmp_path):
+    d = _toy_bpe(tmp_path, [])
+    bpe = GPT2BPE.from_dir(d)
+    # "'ll" is its own pre-token; no cross-boundary merges possible
+    assert bpe.decode(bpe.encode("we'll")) == "we'll"
+
+
+def test_unicode_roundtrip(tmp_path):
+    d = _toy_bpe(tmp_path, [])
+    bpe = GPT2BPE.from_dir(d)
+    s = "héllo 世界!"
+    assert bpe.decode(bpe.encode(s)) == s
+
+
+def test_hf_filenames_accepted(tmp_path):
+    d = _toy_bpe(tmp_path, [("h", "e")])
+    os.rename(os.path.join(d, "encoder.json"), os.path.join(d, "vocab.json"))
+    os.rename(os.path.join(d, "vocab.bpe"), os.path.join(d, "merges.txt"))
+    bpe = GPT2BPE.from_dir(d)
+    assert bpe.encode("he") == [bpe.encoder["he"]]
+
+
+def test_decode_out_of_vocab_is_replacement_not_crash(tmp_path):
+    """A padded LM head (vocab 50304 vs 50257 BPE entries) can emit ids
+    with no BPE entry; decode must render U+FFFD, not raise."""
+    d = _toy_bpe(tmp_path, [])
+    bpe = GPT2BPE.from_dir(d)
+    out = bpe.decode([ord("h"), 99999, ord("i")])
+    assert out == "h�i"
+
+
+def test_load_encoder_prefers_local_dir(tmp_path, monkeypatch):
+    d = _toy_bpe(tmp_path, [])
+    monkeypatch.setenv("GPT2_BPE_DIR", d)
+    encode, decode = load_encoder()
+    assert decode(encode("abc")) == "abc"
+
+
+def test_load_encoder_missing_dir_message(tmp_path, monkeypatch):
+    monkeypatch.setenv("GPT2_BPE_DIR", str(tmp_path / "nope"))
+    with pytest.raises(FileNotFoundError, match="vocab.bpe"):
+        load_encoder()
+
+
+def test_load_encoder_incomplete_dir_still_tries_tiktoken(tmp_path, monkeypatch):
+    """An empty/unrelated ./gpt2_bpe dir must not mask the tiktoken
+    fallback; with neither available the error names both causes."""
+    d = tmp_path / "empty"
+    d.mkdir()
+    monkeypatch.setenv("GPT2_BPE_DIR", str(d))
+    with pytest.raises(FileNotFoundError, match="incomplete"):
+        load_encoder()  # tiktoken is absent in this env -> combined error
+
+
+def test_incomplete_dir_raises(tmp_path):
+    d = tmp_path / "half"
+    d.mkdir()
+    (d / "encoder.json").write_text("{}")
+    with pytest.raises(FileNotFoundError, match="merges.txt"):
+        GPT2BPE.from_dir(str(d))

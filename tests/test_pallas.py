@@ -291,6 +291,77 @@ def test_pallas_grads_grouped_small_headdim(rng):
                                    atol=2e-3, rtol=2e-3)
 
 
+def test_pallas_grads_seeded_and_final_state(rng):
+    """The seeded path (initial_state in, final state out — the SP shard /
+    decode-prefill shape) must be differentiable through the Pallas
+    custom_vjp, including the initial-state gradient, and match XLA
+    autodiff of ssd_chunked."""
+    x, dt, A, B, C, D = inputs(rng, t=64)
+    s0 = jax.random.normal(jax.random.PRNGKey(7),
+                           (x.shape[0], x.shape[2], x.shape[3], C.shape[-1]))
+
+    def loss(fn, **kw):
+        def inner(x, dt, A, B, C, s0):
+            y, fin = fn(x, dt, A, B, C, chunk_size=32,
+                        compute_dtype=jnp.float32, initial_state=s0,
+                        return_final_state=True, **kw)
+            # weight final-state so its cotangent is nonzero and distinct
+            return jnp.sum(y ** 2) + 0.5 * jnp.sum(fin ** 2)
+        return inner
+
+    args = (x, dt, A, B, C, s0)
+    g_ref = jax.grad(loss(ssd_chunked), argnums=tuple(range(6)))(*args)
+    g_pal = jax.grad(loss(ssd_chunked_pallas, interpret=True),
+                     argnums=tuple(range(6)))(*args)
+    for a, b in zip(g_ref, g_pal):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-3, rtol=2e-3)
+
+
+def test_pallas_grads_initial_state_no_final(rng):
+    """Seeded forward without returning the final state (prefill-into-loss
+    shape): dinit must still flow."""
+    x, dt, A, B, C, D = inputs(rng, t=64)
+    s0 = jax.random.normal(jax.random.PRNGKey(3),
+                           (x.shape[0], x.shape[2], x.shape[3], C.shape[-1]))
+
+    def loss(fn, **kw):
+        def inner(s0):
+            y = fn(x, dt, A, B, C, chunk_size=32, compute_dtype=jnp.float32,
+                   initial_state=s0, **kw)
+            return jnp.sum(y ** 2)
+        return inner
+
+    g_ref = jax.grad(loss(ssd_chunked))(s0)
+    g_pal = jax.grad(loss(ssd_chunked_pallas, interpret=True))(s0)
+    np.testing.assert_allclose(np.asarray(g_pal), np.asarray(g_ref),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_pallas_bwd_vmem_cap_small_headdim_large_chunk(rng):
+    """p=8 with l=256 is the ADVICE-r3 VMEM blowup case: the backward must
+    cap its head-block (hb) so the (hb, l, l) working set stays bounded,
+    and still match XLA grads."""
+    from mamba_distributed_tpu.ops.pallas import ssd_kernels as K
+
+    assert K._bwd_hb_cap(256) * 5 * 256 * 256 * 4 <= 4 * 1024 * 1024
+    x, dt, A, B, C, _ = inputs(rng, b=1, t=512, h=16, p=8, n=64, g=1)
+
+    def loss(fn, **kw):
+        def inner(x, dt, A, B, C):
+            return jnp.sum(fn(x, dt, A, B, C, chunk_size=256,
+                              compute_dtype=jnp.float32, **kw) ** 2)
+        return inner
+
+    g_ref = jax.grad(loss(ssd_chunked), argnums=(0, 1, 2, 3, 4))(x, dt, A, B, C)
+    g_pal = jax.grad(loss(ssd_chunked_pallas, interpret=True),
+                     argnums=(0, 1, 2, 3, 4))(x, dt, A, B, C)
+    for a, b in zip(g_ref, g_pal):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        scale = max(1.0, float(np.abs(a).max()))
+        np.testing.assert_allclose(b / scale, a / scale, atol=5e-3)
+
+
 def test_pallas_grads_with_D_and_bf16(rng):
     """Training-shaped call: D skip + bf16 compute; grads stay close to the
     XLA path under the same compute dtype."""
@@ -358,6 +429,49 @@ def test_m1_tpu_lowering_fwd_and_grad(rng):
         jax.grad(lambda *a: jnp.sum(f(*a) ** 2), (0, 1, 2, 3, 4)),
         u, delta, A, B, C,
     )
+
+
+def test_seq_sharded_train_step_tpu_lowering(monkeypatch, tmp_path):
+    """The FULL seq-sharded train step with pallas mixers (the sp_ssd
+    pallas route) lowers for the TPU platform — forced through the real
+    Mosaic path via MDT_PALLAS_INTERPRET=0, so shard_map + ppermute +
+    Pallas custom_vjp compose in one exported program (VERDICT r3 #3)."""
+    monkeypatch.setenv("MDT_PALLAS_INTERPRET", "0")
+    from mamba_distributed_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from mamba_distributed_tpu.training import Trainer
+
+    model = ModelConfig(
+        d_model=64, n_layer=2, vocab_size=256, ssm_layer="mamba2",
+        headdim=16, chunk_size=16, d_state=32, ssm_impl="pallas",
+    )
+    B, T, accum = 2, 64, 2
+    cfg = TrainConfig(
+        model=model,
+        mesh=MeshConfig(seq=4),
+        data=DataConfig(
+            data_dir=str(tmp_path / "data"),
+            synthetic_tokens_per_shard=B * T * accum * 8,
+            synthetic_num_shards=1,
+        ),
+        micro_batch_size=B,
+        seq_len=T,
+        total_batch_size=B * T * accum,
+        log_dir=str(tmp_path / "log"),
+        warmup_steps=2,
+        max_steps=4,
+        val_every=1000,
+    )
+    trainer = Trainer(cfg, verbose=False)
+    x, y = trainer._global_batch(cfg.grad_accum_steps, trainer.train_loader)
+    exported = jax.export.export(trainer.train_step, platforms=["tpu"])(
+        trainer.params, trainer.opt_state, x, y
+    )
+    assert "tpu" in [p.lower() for p in exported.platforms]
 
 
 @pytest.mark.parametrize("layer,kw", [

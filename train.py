@@ -28,6 +28,9 @@ def parse_args():
     p.add_argument("--data-dir", default=None)
     p.add_argument("--log-dir", default=None)
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   help="steps between checkpoints (preset default 1000, "
+                        "the reference's cadence)")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--micro-batch-size", type=int, default=None)
@@ -46,7 +49,8 @@ def parse_args():
     p.add_argument("--sample-prompt", default=None, metavar="TEXT",
                    help="sample 4x32-token continuations of TEXT every "
                         "sample_every steps, like the reference's in-loop "
-                        "sampling (needs tiktoken's GPT-2 BPE)")
+                        "sampling (tokenized by the vendored GPT-2 BPE from "
+                        "$GPT2_BPE_DIR / ./gpt2_bpe, tiktoken fallback)")
     p.add_argument("--sample-prompt-ids", default=None, metavar="IDS",
                    help="same, but the prompt as comma-separated token ids "
                         "(no tokenizer needed)")
@@ -68,16 +72,16 @@ def resolve_sampling(args):
         return [int(t) for t in args.sample_prompt_ids.split(",")], None
     if args.sample_prompt is None:
         return None, None
-    try:
-        import tiktoken
+    from mamba_distributed_tpu.data.gpt2_bpe import load_encoder
 
-        enc = tiktoken.get_encoding("gpt2")
-    except Exception as e:  # no tiktoken / no cached BPE in this env
+    try:
+        # vendored zero-egress BPE (local gpt2_bpe/ files), tiktoken fallback
+        encode, decode = load_encoder()
+    except FileNotFoundError as e:
         raise SystemExit(
-            f"--sample-prompt needs tiktoken's gpt2 encoding ({e}); "
-            "pass --sample-prompt-ids instead"
+            f"--sample-prompt: {e}\nOr pass --sample-prompt-ids instead."
         )
-    return enc.encode(args.sample_prompt), enc.decode
+    return encode(args.sample_prompt), decode
 
 
 def build_config(args):
@@ -90,6 +94,7 @@ def build_config(args):
         ("total_batch_size", args.total_batch_size),
         ("seq_len", args.seq_len),
         ("seed", args.seed),
+        ("checkpoint_every", args.checkpoint_every),
     ]:
         if arg is not None:
             overrides[field] = arg
@@ -134,13 +139,22 @@ def main():
     if args.auto_restart and not args.checkpoint_dir:
         raise SystemExit("--auto-restart needs --checkpoint-dir to recover from")
 
-    def make_trainer(resume: bool):
+    def make_trainer(resume: bool, after_crash: bool = False):
         trainer = Trainer(cfg, sample_prompt_ids=prompt_ids, decode_fn=decode_fn)
         if resume and args.checkpoint_dir:
             try:
                 trainer.restore_checkpoint(args.checkpoint_dir)
                 print(f"resumed from step {trainer.step}")
             except FileNotFoundError:
+                if after_crash:
+                    # a crash before the first checkpoint: a "restart" would
+                    # replay from step 0 — no recovery value, just repeated
+                    # data and burned restart budget (ADVICE r3)
+                    raise SystemExit(
+                        "auto-restart: crashed before any checkpoint was "
+                        "written; refusing to silently restart from step 0 "
+                        "(lower --checkpoint-every or rerun manually)"
+                    )
                 print("no checkpoint found; starting fresh")
         return trainer
 
@@ -157,7 +171,8 @@ def main():
                 # device memory never holds two full parameter sets
                 if trainer is None:
                     trainer = make_trainer(
-                        resume=args.resume if attempt == 0 else True
+                        resume=args.resume if attempt == 0 else True,
+                        after_crash=attempt > 0,
                     )
                 trainer.run(max_steps=args.max_steps,
                             checkpoint_dir=args.checkpoint_dir)
