@@ -22,6 +22,12 @@ Encoding matches tiktoken's "gpt2" exactly: same pre-split regex, same
 byte encoder, same merge ranks — pinned by tests/test_gpt2_bpe.py with a
 synthetic merge table (the real data files are not redistributable into
 this environment, but the algorithm is data-independent).
+
+The merge loop runs natively when a toolchain is present: the id-level
+C++ kernel (data/native/bpe_merge.cc, the counterpart of tiktoken's
+Rust core) is lazily built by data/native_bpe.py and differentially
+tested against the pure-Python loop; ``MDT_NATIVE_BPE=0`` forces the
+Python path.
 """
 
 from __future__ import annotations
@@ -82,7 +88,46 @@ class GPT2BPE:
         self.ranks = {pair: i for i, pair in enumerate(merges)}
         self.byte_enc = bytes_to_unicode()
         self.byte_dec = {v: k for k, v in self.byte_enc.items()}
-        self._cache: dict[str, tuple[str, ...]] = {}
+        # the only cache is id-level, keyed by pre-token; _bpe itself is
+        # uncached (it runs at most once per distinct pre-token)
+        self._id_cache: dict[str, tuple[int, ...]] = {}
+        self._native = None
+        self._native_tried = False
+
+    def _native_table(self):
+        """Lazy id-level merge table on the C++ merge loop (data/native_bpe.py);
+        None when the toolchain is absent or the vocab is degenerate."""
+        if self._native_tried:
+            return self._native
+        self._native_tried = True
+        try:
+            from mamba_distributed_tpu.data.native_bpe import (
+                NativeBpeTable,
+                available,
+            )
+
+            if not available():
+                return None
+            triples = []
+            for (sa, sb), _rank in sorted(
+                self.ranks.items(), key=lambda kv: kv[1]
+            ):
+                a, b = self.encoder.get(sa), self.encoder.get(sb)
+                c = self.encoder.get(sa + sb)
+                if a is None or b is None or c is None:
+                    return None  # vocab/merge mismatch: stay on Python path
+                triples.append((a, b, c))
+            # id-level BPE needs every single-byte symbol to have an id
+            if any(s not in self.encoder for s in self.byte_enc.values()):
+                return None
+            # raw byte -> id, skipping the unicode-symbol detour entirely
+            self._byte_ids = [
+                self.encoder[self.byte_enc[b]] for b in range(256)
+            ]
+            self._native = NativeBpeTable(triples)
+        except Exception:
+            self._native = None
+        return self._native
 
     @classmethod
     def from_dir(cls, bpe_dir: str) -> "GPT2BPE":
@@ -98,18 +143,19 @@ class GPT2BPE:
             encoder = json.load(f)
         with open(bpe_path, encoding="utf-8") as f:
             lines = f.read().split("\n")
+        # the standard first-line "#version: ..." header is metadata, not a
+        # merge (a real merge CAN start with '#', so only line 0 is special)
+        if lines and lines[0].startswith("#version"):
+            lines = lines[1:]
         merges = []
         for line in lines:
             parts = line.split()
             if len(parts) == 2:
                 merges.append((parts[0], parts[1]))
-            # version headers / blank lines are skipped
+            # blank / malformed lines are skipped
         return cls(encoder, merges)
 
     def _bpe(self, token: str) -> tuple[str, ...]:
-        cached = self._cache.get(token)
-        if cached is not None:
-            return cached
         word = tuple(token)
         while len(word) > 1:
             pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
@@ -131,14 +177,38 @@ class GPT2BPE:
                     merged.append(word[i])
                     i += 1
             word = tuple(merged)
-        self._cache[token] = word
         return word
 
     def encode(self, text: str) -> list[int]:
+        native = self._native_table()
+        toks = _PAT.findall(text)
+        cache = self._id_cache
+        if native is not None:
+            # batch every cache miss of this call into ONE native call
+            misses = {t for t in toks if t not in cache}
+            if misses:
+                misses = list(misses)
+                flat: list[int] = []
+                offsets = [0]
+                byte_ids = self._byte_ids
+                for t in misses:
+                    flat.extend(byte_ids[b] for b in t.encode("utf-8"))
+                    offsets.append(len(flat))
+                lens, merged = native.apply_spans(flat, offsets)
+                pos = 0
+                for t, ln in zip(misses, lens):
+                    cache[t] = tuple(merged[pos : pos + ln])
+                    pos += ln
         ids: list[int] = []
-        for tok in _PAT.findall(text):
-            mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
-            ids.extend(self.encoder[piece] for piece in self._bpe(mapped))
+        for tok in toks:
+            cached = cache.get(tok)
+            if cached is None:  # pure-Python path (no native table)
+                mapped = "".join(self.byte_enc[b] for b in tok.encode("utf-8"))
+                cached = tuple(
+                    self.encoder[piece] for piece in self._bpe(mapped)
+                )
+                cache[tok] = cached
+            ids.extend(cached)
         return ids
 
     def decode(self, ids) -> str:

@@ -1,0 +1,126 @@
+"""Tokenize raw text into the .npy token shards the loader reads.
+
+Closes the "import your own data" loop (reference README.md:11) without
+network access: the reference ecosystem produced ``edu_fineweb10B/``
+shards with a tiktoken-based prep script; this is the zero-egress
+equivalent on the vendored GPT-2 BPE (data/gpt2_bpe.py).
+
+  python scripts/prepare_data.py --out edu_fineweb10B doc1.txt doc2.txt
+  python scripts/prepare_data.py --out data --jsonl corpus.jsonl   # {"text": ...}
+  cat corpus.txt | python scripts/prepare_data.py --out data -
+
+Output: ``{prefix}_{split}_{idx:06d}.npy`` uint16 shards (same naming
+scheme the synthetic generator and loader use; rank-striding and
+resume semantics live in data/loader.py).  Each document is prefixed
+with the <|endoftext|> delimiter, the convention the reference's corpus
+used, so documents are separable at training time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mamba_distributed_tpu.data.gpt2_bpe import ENDOFTEXT_ID, load_encoder  # noqa: E402
+
+
+def iter_texts(paths: list[str], jsonl: bool):
+    """Yields document texts; malformed jsonl lines are skipped with a
+    located warning instead of aborting a multi-hour prep run."""
+    for path in paths:
+        stream = sys.stdin if path == "-" else open(path, encoding="utf-8")
+        try:
+            if jsonl:
+                for lineno, line in enumerate(stream, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)["text"]
+                    except (json.JSONDecodeError, KeyError, TypeError) as e:
+                        print(
+                            f"warning: {path}:{lineno}: skipping bad record "
+                            f"({type(e).__name__}: {e})",
+                            file=sys.stderr,
+                        )
+            else:
+                yield stream.read()
+        finally:
+            if path != "-":
+                stream.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("inputs", nargs="+",
+                    help="text files ('-' = stdin), or jsonl with --jsonl")
+    ap.add_argument("--out", required=True, help="output shard directory")
+    ap.add_argument("--jsonl", action="store_true",
+                    help="inputs are jsonl with a 'text' field per line")
+    ap.add_argument("--shard-tokens", type=int, default=2**24,
+                    help="tokens per shard (default 16.7M, ~33MB uint16)")
+    ap.add_argument("--prefix", default="corpus")
+    ap.add_argument("--val-frac", type=float, default=0.0,
+                    help="fraction of shards routed to the val split "
+                    "(floor quota spread through the stream; a corpus "
+                    "smaller than 1/frac shards gets none)")
+    ap.add_argument("--bpe-dir", default=None,
+                    help="GPT-2 BPE data dir (default $GPT2_BPE_DIR or ./gpt2_bpe)")
+    args = ap.parse_args()
+    if "train" in args.prefix or "val" in args.prefix:
+        # the loader discovers splits by substring over the whole filename
+        # (data/loader.py), so these words in the prefix would cross-
+        # contaminate the splits silently
+        ap.error(f"--prefix {args.prefix!r} must not contain 'train'/'val'")
+
+    encode, _ = load_encoder(args.bpe_dir)
+    os.makedirs(args.out, exist_ok=True)
+
+    buf: list[int] = []
+    shards = val_shards = 0
+    total = 0
+
+    def next_split() -> str:
+        """Streaming floor quota: shard i goes to val exactly when the
+        running val count has fallen behind floor(frac * (i+1)).  The
+        first shard is always train (the loader requires a train split),
+        and val shards spread through the stream instead of pooling at
+        the corpus head."""
+        nonlocal val_shards
+        if args.val_frac > 0 and val_shards + 1 <= args.val_frac * (shards + 1):
+            val_shards += 1
+            return "val"
+        return "train"
+
+    def flush():
+        nonlocal buf, shards, total
+        chunk, buf = buf[: args.shard_tokens], buf[args.shard_tokens :]
+        arr = np.asarray(chunk, dtype=np.uint16)
+        path = os.path.join(
+            args.out, f"{args.prefix}_{next_split()}_{shards:06d}.npy"
+        )
+        np.save(path, arr)
+        shards += 1
+        total += len(arr)
+        print(f"wrote {path} ({len(arr):,} tokens)", file=sys.stderr)
+
+    for text in iter_texts(args.inputs, args.jsonl):
+        buf.append(ENDOFTEXT_ID)
+        buf.extend(encode(text))
+        while len(buf) >= args.shard_tokens:
+            flush()
+    if buf:
+        flush()
+    print(f"done: {shards} shards ({val_shards} val), {total:,} tokens "
+          f"in {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,6 +99,53 @@ def test_hf_filenames_accepted(tmp_path):
     assert bpe.encode("he") == [bpe.encoder["he"]]
 
 
+def test_native_merge_matches_python(tmp_path, monkeypatch):
+    """Differential: the C++ id-level merge loop == the pure-Python
+    string-level loop on a randomized merge table and inputs."""
+    import random
+
+    from mamba_distributed_tpu.data import native_bpe
+
+    if not native_bpe.available():
+        pytest.skip("no C++ toolchain")
+
+    rng = random.Random(7)
+    b2u = bytes_to_unicode()
+    base = [b2u[i] for i in range(256)]
+    merges, seen = [], set()
+    # random chain of merges over lowercase letters + space symbol
+    alphabet = [b2u[ord(c)] for c in "abcdefgh "]
+    pieces = list(alphabet)
+    for _ in range(40):
+        a, b = rng.choice(pieces), rng.choice(pieces)
+        if (a, b) in seen:
+            continue
+        seen.add((a, b))
+        merges.append((a, b))
+        pieces.append(a + b)
+    d = _toy_bpe(tmp_path, merges)
+
+    bpe_native = GPT2BPE.from_dir(d)
+    assert bpe_native._native_table() is not None
+    bpe_python = GPT2BPE.from_dir(d)
+    bpe_python._native_tried = True  # forces the Python loop
+
+    for _ in range(50):
+        s = "".join(rng.choice("abcdefgh ") for _ in range(rng.randint(1, 60)))
+        assert bpe_native.encode(s) == bpe_python.encode(s), s
+        assert bpe_native.decode(bpe_native.encode(s)) == s
+
+
+def test_native_bpe_env_disable(tmp_path, monkeypatch):
+    monkeypatch.setenv("MDT_NATIVE_BPE", "0")
+    monkeypatch.setattr("mamba_distributed_tpu.data.native_bpe._tried", False)
+    monkeypatch.setattr("mamba_distributed_tpu.data.native_bpe._lib", None)
+    d = _toy_bpe(tmp_path, [("h", "e")])
+    bpe = GPT2BPE.from_dir(d)
+    assert bpe._native_table() is None
+    assert bpe.encode("he") == [bpe.encoder["he"]]
+
+
 def test_decode_out_of_vocab_is_replacement_not_crash(tmp_path):
     """A padded LM head (vocab 50304 vs 50257 BPE entries) can emit ids
     with no BPE entry; decode must render U+FFFD, not raise."""

@@ -1,0 +1,127 @@
+"""scripts/prepare_data.py: raw text -> shards the loader actually reads."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.data.gpt2_bpe import ENDOFTEXT_ID, bytes_to_unicode
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "prepare_data.py")
+
+
+@pytest.fixture
+def bpe_dir(tmp_path):
+    b2u = bytes_to_unicode()
+    vocab = {b2u[i]: i for i in range(256)}
+    d = tmp_path / "bpe"
+    d.mkdir()
+    (d / "encoder.json").write_text(json.dumps(vocab))
+    (d / "vocab.bpe").write_text("#version: 0.2\n")
+    return str(d)
+
+
+def _run(args, bpe_dir):
+    return subprocess.run(
+        [sys.executable, SCRIPT, "--bpe-dir", bpe_dir, *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_text_files_to_shards(tmp_path, bpe_dir):
+    f1 = tmp_path / "a.txt"
+    f1.write_text("hello world")
+    f2 = tmp_path / "b.txt"
+    f2.write_text("bye")
+    out = tmp_path / "shards"
+    p = _run(["--out", str(out), "--shard-tokens", "8", str(f1), str(f2)],
+             bpe_dir)
+    assert p.returncode == 0, p.stderr
+    files = sorted(os.listdir(out))
+    assert files and all(f.endswith(".npy") for f in files)
+    toks = np.concatenate([np.load(out / f) for f in files])
+    assert toks.dtype == np.uint16
+    # 2 documents => 2 <|endoftext|> delimiters, one leading each doc
+    assert (toks.astype(np.int64) == ENDOFTEXT_ID).sum() == 2
+    assert toks[0] == ENDOFTEXT_ID
+    # total = 2 delimiters + byte tokens of both texts (identity vocab)
+    assert len(toks) == 2 + len("hello world") + len("bye")
+
+
+def test_jsonl_and_val_split(tmp_path, bpe_dir):
+    src = tmp_path / "c.jsonl"
+    with open(src, "w") as f:
+        for i in range(6):
+            f.write(json.dumps({"text": "x" * 40}) + "\n")
+    out = tmp_path / "shards"
+    p = _run(["--out", str(out), "--jsonl", "--shard-tokens", "41",
+              "--val-frac", "0.334", str(src)], bpe_dir)
+    assert p.returncode == 0, p.stderr
+    files = sorted(os.listdir(out))
+    vals = [f for f in files if "_val_" in f]
+    trains = [f for f in files if "_train_" in f]
+    assert len(files) == 6 and len(vals) == 2 and len(trains) == 4
+    # the first shard must be train (the loader needs a train split even
+    # for one-shard corpora), and val shards spread through the stream
+    assert "_train_" in files[0] or files[0].endswith("_train_000000.npy")
+    assert not any(f.endswith("_000000.npy") and "_val_" in f for f in files)
+
+
+def test_single_shard_corpus_is_train(tmp_path, bpe_dir):
+    """README's --val-frac 0.01 example on a small corpus must still
+    produce a usable train split (regression: quota used to send the
+    first — possibly only — shard to val)."""
+    src = tmp_path / "small.txt"
+    src.write_text("tiny corpus")
+    out = tmp_path / "shards"
+    p = _run(["--out", str(out), "--val-frac", "0.01", str(src)], bpe_dir)
+    assert p.returncode == 0, p.stderr
+    files = os.listdir(out)
+    assert len(files) == 1 and "_train_" in files[0]
+
+
+def test_prefix_containing_split_word_rejected(tmp_path, bpe_dir):
+    """'train'/'val' inside --prefix would cross-contaminate the loader's
+    substring-based split discovery."""
+    src = tmp_path / "a.txt"
+    src.write_text("x")
+    p = _run(["--out", str(tmp_path / "s"), "--prefix", "fineweb_train",
+              str(src)], bpe_dir)
+    assert p.returncode != 0
+    assert "must not contain" in p.stderr
+
+
+def test_bad_jsonl_line_skipped_with_warning(tmp_path, bpe_dir):
+    src = tmp_path / "c.jsonl"
+    src.write_text(json.dumps({"text": "good"}) + "\n"
+                   + "{broken json\n"
+                   + json.dumps({"content": "no text key"}) + "\n"
+                   + json.dumps({"text": "also good"}) + "\n")
+    out = tmp_path / "shards"
+    p = _run(["--out", str(out), "--jsonl", str(src)], bpe_dir)
+    assert p.returncode == 0, p.stderr
+    assert p.stderr.count("skipping bad record") == 2
+    toks = np.load(out / os.listdir(out)[0])
+    assert (toks.astype(np.int64) == ENDOFTEXT_ID).sum() == 2  # 2 good docs
+
+
+def test_loader_consumes_prepared_shards(tmp_path, bpe_dir):
+    """End to end: prepared shards feed DataLoader batches."""
+    src = tmp_path / "d.txt"
+    src.write_text("abcdefgh" * 64)
+    out = tmp_path / "shards"
+    p = _run(["--out", str(out), "--shard-tokens", "256", "--val-frac",
+              "0.5", str(src)], bpe_dir)
+    assert p.returncode == 0, p.stderr
+
+    from mamba_distributed_tpu.data.loader import ShardedTokenLoader
+
+    dl = ShardedTokenLoader(B=2, T=16, data_dir=str(out), split="train",
+                            master_process=False)
+    x, y = dl.next_batch()
+    assert x.shape == (2, 16) and y.shape == (2, 16)
+    assert (x[:, 1:] == y[:, :-1]).all()  # next-token shift
