@@ -273,8 +273,22 @@ class TrainConfig:
                 f"tensor={m.tensor}); use pipe alone or pipe=1"
             )
         if m.pipe > 1 and self.model.attn_layer_idx:
-            raise ValueError("pipeline parallelism needs a uniform layer stack")
-        if m.pipe > 1 and self.model.n_layer % m.pipe != 0:
+            # a PERIODIC hybrid pipelines by supersteps (one attn layer per
+            # period — models/lm._hybrid_period); aperiodic patterns can't
+            # shard evenly over stages
+            from mamba_distributed_tpu.models.lm import _hybrid_period
+
+            if _hybrid_period(self.model) is None:
+                raise ValueError(
+                    "pipeline parallelism needs a uniform layer stack or a "
+                    "periodic hybrid (one attn layer every n_layer/n_attn)"
+                )
+            if len(self.model.attn_layer_idx) % m.pipe != 0:
+                raise ValueError(
+                    f"hybrid pipeline: n_attn={len(self.model.attn_layer_idx)} "
+                    f"supersteps must divide over mesh.pipe={m.pipe} stages"
+                )
+        elif m.pipe > 1 and self.model.n_layer % m.pipe != 0:
             raise ValueError(
                 f"n_layer={self.model.n_layer} must divide over "
                 f"mesh.pipe={m.pipe} stages"

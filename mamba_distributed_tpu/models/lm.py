@@ -327,26 +327,53 @@ def lm_loss_pipelined(
     LM head run batched over it, and the block stack streams the
     microbatches through ``parallel/pipeline.pipelined_layers`` — whose
     schedule is differentiable (ppermute/scan/where all transpose), so
-    one ``jax.grad`` trains through the pipeline.  Uniform stacks only
-    (the hybrid's interleaved attention layers don't shard evenly).
+    one ``jax.grad`` trains through the pipeline.  Uniform stacks
+    pipeline per layer; periodic hybrids (config-5 pattern) pipeline per
+    *superstep* — each pipeline "layer" is one
+    ``[offset mamba] -> attn -> [rest mamba]`` group, so the per-stage
+    work stays homogeneous.
     """
     from mamba_distributed_tpu.parallel.pipeline import pipelined_layers
 
-    assert not cfg.attn_layer_idx, "pipeline parallelism needs a uniform stack"
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = params["embedding"][input_ids].astype(compute_dtype)  # (mb,b,t,d)
     residual = jnp.zeros_like(
         hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
     )
 
-    def body(carry, bp):
-        h, r = carry
-        return _block_fwd(bp, cfg, h, r, False)
+    if cfg.attn_layer_idx:
+        per = _hybrid_period(cfg)
+        assert per is not None, (
+            "pipeline parallelism needs a uniform stack or a periodic hybrid"
+        )
+        p, r = per
+        stacked = (_group_mamba_stack(params, cfg, p), params["attn_blocks"])
+
+        def mbody(carry, bp):
+            h, rs = carry
+            return _block_fwd(bp, cfg, h, rs, False), None
+
+        def body(carry, group):
+            mblk, ablk = group
+            carry, _ = jax.lax.scan(
+                mbody, carry, jax.tree.map(lambda x: x[:r], mblk)
+            )
+            carry = _block_fwd(ablk, cfg, *carry, True)
+            carry, _ = jax.lax.scan(
+                mbody, carry, jax.tree.map(lambda x: x[r:], mblk)
+            )
+            return carry
+    else:
+        stacked = params["blocks"]
+
+        def body(carry, bp):
+            h, r_ = carry
+            return _block_fwd(bp, cfg, h, r_, False)
 
     if cfg.remat:
         body = _remat(body, cfg)
     hidden, residual = pipelined_layers(
-        body, params["blocks"], (hidden, residual), mesh, axis=axis
+        body, stacked, (hidden, residual), mesh, axis=axis
     )
     lf = _final_logits(params, cfg, hidden, residual)
     lse = jax.nn.logsumexp(lf, axis=-1)

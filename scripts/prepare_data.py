@@ -30,9 +30,37 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from mamba_distributed_tpu.data.gpt2_bpe import ENDOFTEXT_ID, load_encoder  # noqa: E402
 
 
+_CHUNK_CHARS = 1 << 20  # ~1MB of text per encode call in plain-text mode
+
+
+def _split_safe(buf: str):
+    """Split ``buf`` so the second part starts at a whitespace run.
+
+    GPT-2's pre-split regex binds a leading space to the following word
+    and tokenizes whitespace runs as units, so the only cut that cannot
+    change tokenization is *before* a whitespace run: emit everything up
+    to the start of the last run, carry the run + tail forward.
+    """
+    i = len(buf) - 1
+    while i >= 0 and buf[i].isspace():
+        i -= 1
+    while i >= 0 and not buf[i].isspace():
+        i -= 1
+    # buf[i] is the last whitespace before the final word (or -1)
+    j = i
+    while j >= 0 and buf[j].isspace():
+        j -= 1
+    if j < 0:  # no safe boundary (one giant word / all whitespace)
+        return None
+    return buf[: j + 1], buf[j + 1 :]
+
+
 def iter_texts(paths: list[str], jsonl: bool):
-    """Yields document texts; malformed jsonl lines are skipped with a
-    located warning instead of aborting a multi-hour prep run."""
+    """Yields (new_doc, text_piece).  jsonl: one document per line
+    (malformed lines are skipped with a located warning).  Plain text:
+    one document per file, streamed in ~1MB pieces cut at whitespace-run
+    boundaries so chunking never changes tokenization — peak memory stays
+    O(chunk), not O(file)."""
     for path in paths:
         stream = sys.stdin if path == "-" else open(path, encoding="utf-8")
         try:
@@ -42,7 +70,7 @@ def iter_texts(paths: list[str], jsonl: bool):
                     if not line:
                         continue
                     try:
-                        yield json.loads(line)["text"]
+                        yield True, json.loads(line)["text"]
                     except (json.JSONDecodeError, KeyError, TypeError) as e:
                         print(
                             f"warning: {path}:{lineno}: skipping bad record "
@@ -50,7 +78,20 @@ def iter_texts(paths: list[str], jsonl: bool):
                             file=sys.stderr,
                         )
             else:
-                yield stream.read()
+                buf, first = "", True
+                while True:
+                    piece = stream.read(_CHUNK_CHARS)
+                    if not piece:
+                        break
+                    buf += piece
+                    if len(buf) >= _CHUNK_CHARS:
+                        cut = _split_safe(buf)
+                        if cut is not None:
+                            out, buf = cut
+                            yield first, out
+                            first = False
+                if buf or first:
+                    yield first, buf
         finally:
             if path != "-":
                 stream.close()
@@ -78,6 +119,8 @@ def main() -> int:
         # (data/loader.py), so these words in the prefix would cross-
         # contaminate the splits silently
         ap.error(f"--prefix {args.prefix!r} must not contain 'train'/'val'")
+    if not 0 <= args.val_frac < 1:
+        ap.error(f"--val-frac must be in [0, 1), got {args.val_frac}")
 
     encode, _ = load_encoder(args.bpe_dir)
     os.makedirs(args.out, exist_ok=True)
@@ -110,8 +153,9 @@ def main() -> int:
         total += len(arr)
         print(f"wrote {path} ({len(arr):,} tokens)", file=sys.stderr)
 
-    for text in iter_texts(args.inputs, args.jsonl):
-        buf.append(ENDOFTEXT_ID)
+    for new_doc, text in iter_texts(args.inputs, args.jsonl):
+        if new_doc:
+            buf.append(ENDOFTEXT_ID)
         buf.extend(encode(text))
         while len(buf) >= args.shard_tokens:
             flush()

@@ -28,3 +28,26 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)
+
+
+def make_toy_bpe(dirpath, merges=()):
+    """Write a valid toy GPT-2 BPE data dir: the 256-byte identity vocab
+    plus one vocab entry per merge (ids in rank order — how the real
+    vocab lays out its first entries).  Shared by the tokenizer,
+    data-prep, and CLI test suites."""
+    import json
+
+    from mamba_distributed_tpu.data.gpt2_bpe import bytes_to_unicode
+
+    b2u = bytes_to_unicode()
+    vocab = {b2u[i]: i for i in range(256)}
+    for a, b in merges:
+        vocab.setdefault(a + b, len(vocab))
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "encoder.json"), "w", encoding="utf-8") as f:
+        json.dump(vocab, f)
+    with open(os.path.join(dirpath, "vocab.bpe"), "w", encoding="utf-8") as f:
+        f.write("#version: 0.2\n")
+        for a, b in merges:
+            f.write(f"{a} {b}\n")
+    return str(dirpath)

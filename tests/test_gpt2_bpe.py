@@ -19,23 +19,11 @@ from mamba_distributed_tpu.data.gpt2_bpe import (
 )
 
 
+from tests.conftest import make_toy_bpe
+
+
 def _toy_bpe(tmp_path, merges):
-    """Build a valid (encoder.json, vocab.bpe) pair: 256 byte symbols +
-    one token per merge, ids in rank order (how the real vocab is laid
-    out for its first 256+N entries)."""
-    b2u = bytes_to_unicode()
-    symbols = [b2u[i] for i in range(256)]
-    vocab = {s: i for i, s in enumerate(symbols)}
-    for a, b in merges:
-        vocab[a + b] = len(vocab)
-    d = tmp_path / "bpe"
-    d.mkdir()
-    (d / "encoder.json").write_text(json.dumps(vocab), encoding="utf-8")
-    (d / "vocab.bpe").write_text(
-        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n",
-        encoding="utf-8",
-    )
-    return str(d)
+    return make_toy_bpe(tmp_path / "bpe", merges)
 
 
 def test_bytes_to_unicode_bijective():

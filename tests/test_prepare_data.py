@@ -14,15 +14,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "prepare_data.py")
 
 
+from tests.conftest import make_toy_bpe
+
+
 @pytest.fixture
 def bpe_dir(tmp_path):
-    b2u = bytes_to_unicode()
-    vocab = {b2u[i]: i for i in range(256)}
-    d = tmp_path / "bpe"
-    d.mkdir()
-    (d / "encoder.json").write_text(json.dumps(vocab))
-    (d / "vocab.bpe").write_text("#version: 0.2\n")
-    return str(d)
+    return make_toy_bpe(tmp_path / "bpe")
 
 
 def _run(args, bpe_dir):
@@ -93,6 +90,58 @@ def test_prefix_containing_split_word_rejected(tmp_path, bpe_dir):
               str(src)], bpe_dir)
     assert p.returncode != 0
     assert "must not contain" in p.stderr
+
+
+def test_val_frac_one_rejected(tmp_path, bpe_dir):
+    """--val-frac >= 1 would route every shard (incl. the first) to val."""
+    src = tmp_path / "a.txt"
+    src.write_text("x")
+    p = _run(["--out", str(tmp_path / "s"), "--val-frac", "1", str(src)],
+             bpe_dir)
+    assert p.returncode != 0 and "val-frac" in p.stderr
+
+
+def _load_script():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("prepare_data", SCRIPT)
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_split_safe_never_changes_tokenization():
+    """Chunk cuts land before whitespace runs, so pre-split tokens of the
+    pieces concatenate to the tokens of the whole."""
+    import random
+
+    from mamba_distributed_tpu.data.gpt2_bpe import _PAT
+
+    m = _load_script()
+    rng = random.Random(5)
+    for _ in range(30):
+        s = "".join(rng.choice("ab c  \t\nd'll ") for _ in range(200))
+        cut = m._split_safe(s)
+        if cut is None:
+            continue
+        a, b = cut
+        assert a + b == s
+        assert _PAT.findall(a) + _PAT.findall(b) == _PAT.findall(s), (a, b)
+
+
+def test_plain_text_streams_in_chunks(tmp_path):
+    """A text file bigger than the chunk size is yielded in pieces that
+    re-join exactly, with new_doc set only on the first piece."""
+    m = _load_script()
+    m._CHUNK_CHARS = 64
+    src = tmp_path / "big.txt"
+    content = ("word " * 100).strip()
+    src.write_text(content)
+    pieces = list(m.iter_texts([str(src)], jsonl=False))
+    assert len(pieces) > 2
+    assert pieces[0][0] is True
+    assert all(flag is False for flag, _ in pieces[1:])
+    assert "".join(t for _, t in pieces) == content
 
 
 def test_bad_jsonl_line_skipped_with_warning(tmp_path, bpe_dir):
