@@ -70,6 +70,10 @@ class ModelConfig:
     # convention, so imported hybrid configs keep their semantics)
     attn_rotary_dim: int = -1
     rope_theta: float = 10000.0
+    # attention strategy under sequence parallelism: "ring" (KV rotates,
+    # O(t/S) per-chip memory) or "ulysses" (all-to-all head sharding —
+    # needs heads % seq == 0; parallel/ulysses.py)
+    attn_sp_impl: str = "ring"
 
     # --- precision policy (reference: bf16 autocast + fp32 master weights,
     # train.py:72,142,211) ---
@@ -104,6 +108,11 @@ class ModelConfig:
             raise ValueError(
                 "ssm_impl='pallas' backs the SSD scan (mamba2) and the "
                 f"selective scan (mamba1); got ssm_layer={self.ssm_layer!r}"
+            )
+        if self.attn_sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attn_sp_impl must be 'ring' or 'ulysses', got "
+                f"{self.attn_sp_impl!r}"
             )
 
     @property

@@ -131,9 +131,18 @@ def attention_mixer(
         k = apply_rope(k, angles)
 
     if seq_ctx is not None:
-        from mamba_distributed_tpu.parallel.ring_attention import ring_attention
+        if cfg.attn_sp_impl == "ulysses":
+            from mamba_distributed_tpu.parallel.ulysses import (
+                ulysses_attention,
+            )
 
-        out = ring_attention(seq_ctx, q, k, v)
+            out = ulysses_attention(seq_ctx, q, k, v)
+        else:
+            from mamba_distributed_tpu.parallel.ring_attention import (
+                ring_attention,
+            )
+
+            out = ring_attention(seq_ctx, q, k, v)
     else:
         from mamba_distributed_tpu.ops.blockwise_attention import (
             blockwise_sdpa_causal,

@@ -43,6 +43,10 @@ def parse_args():
     p.add_argument("--mesh-tensor", type=int, default=None)
     p.add_argument("--ssm-impl", choices=["xla", "pallas"], default=None,
                    help="kernel backend for the SSM scan")
+    p.add_argument("--attn-sp-impl", choices=["ring", "ulysses"], default=None,
+                   help="attention strategy under sequence parallelism "
+                        "(ring: KV rotation; ulysses: all-to-all head "
+                        "sharding, needs heads %% mesh-seq == 0)")
     p.add_argument("--remat-policy", choices=["all", "dots"], default=None)
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first (TPU pods)")
@@ -109,6 +113,7 @@ def build_config(args):
     model_over = {
         k: v for k, v in [
             ("ssm_impl", args.ssm_impl), ("remat_policy", args.remat_policy),
+            ("attn_sp_impl", args.attn_sp_impl),
         ] if v is not None
     }
     if model_over:
