@@ -130,11 +130,11 @@ def mamba1_mixer(
         delta_softplus=True,
     )
     if seq_ctx is not None:
-        # SP uses the shard_map scan (ssm_impl='pallas' is bypassed here,
-        # matching the mamba2 structure where sp_ssd owns the sharded path)
         from mamba_distributed_tpu.parallel.seq_parallel import sp_selective_scan
 
-        y, ssm_state = sp_selective_scan(seq_ctx, x, dt, A, B, C, **scan_kw)
+        y, ssm_state = sp_selective_scan(
+            seq_ctx, x, dt, A, B, C, ssm_impl=cfg.ssm_impl, **scan_kw
+        )
     else:
         if cfg.ssm_impl == "pallas":
             from mamba_distributed_tpu.ops.pallas import selective_scan_pallas

@@ -182,21 +182,24 @@ def _m1_entry_states_kernel(
 
 
 def _m1_bwd_kernel(
-    u_ref, dt_ref, At_ref, B_ref, C_ref, hin_ref, dy_ref,
-    du_ref, ddt_ref, dA_ref, dB_ref, dC_ref,
+    u_ref, dt_ref, At_ref, B_ref, C_ref, hin_ref, dy_ref, dfinal_ref,
+    du_ref, ddt_ref, dA_ref, dB_ref, dC_ref, dh0_ref,
     gh_scratch, hbuf, dA_scratch, *, nt: int,
 ):
     """Reverse sweep over one (batch, d-block, reversed t-tile) cell.
 
     hbuf[i] holds h_{i-1} (the state *entering* step i), rebuilt from the
     tile's entry state; gh and the dA accumulator persist across the
-    sequential (reversed) tile dimension in scratch.
+    sequential (reversed) tile dimension in scratch.  ``dfinal`` (the
+    final-state cotangent — zeros for an unseeded call) seeds gh at the
+    reverse start; after the full sweep gh IS the initial-state gradient,
+    emitted as ``dh0``.
     """
     ti = pl.program_id(2)
 
     @pl.when(ti == 0)
     def _():
-        gh_scratch[...] = jnp.zeros_like(gh_scratch)
+        gh_scratch[...] = dfinal_ref[0]
         dA_scratch[...] = jnp.zeros_like(dA_scratch)
 
     At = At_ref[...]          # (n, dblk)
@@ -245,10 +248,18 @@ def _m1_bwd_kernel(
     @pl.when(ti == nt - 1)
     def _():
         dA_ref[0] = dA_scratch[...]
+        # gh after the earliest step == dL/d(initial state)
+        dh0_ref[0] = gh_scratch[...]
 
 
-def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
-    """Entry-state recompute + reverse kernel + tiny XLA reductions."""
+def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret,
+                        h0=None, dfinal=None):
+    """Entry-state recompute + reverse kernel + tiny XLA reductions.
+
+    ``h0``/``dfinal`` are (b, d, n) seeded-call extras: the entry-state
+    recompute starts from h0, dfinal seeds the reverse sweep, and the
+    initial-state gradient comes back as the sixth output (b, d, n).
+    """
     b, t, d = uf.shape
     n = Af.shape[-1]
     t_blk, dblk = _pick_blocks(t, d)
@@ -261,7 +272,16 @@ def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
     nd = d // dblk
     grid = (b, nd, nt)
     At = Af.T
-    h0 = jnp.zeros((b, n, d), jnp.float32)
+    h0 = (
+        jnp.zeros((b, n, d), jnp.float32)
+        if h0 is None
+        else jnp.swapaxes(h0, 1, 2).astype(jnp.float32)
+    )
+    dfinal = (
+        jnp.zeros((b, n, d), jnp.float32)
+        if dfinal is None
+        else jnp.swapaxes(dfinal, 1, 2).astype(jnp.float32)
+    )
 
     io_spec = pl.BlockSpec((1, t_blk, dblk), lambda bi, di, ti: (bi, ti, di))
     bc_spec = pl.BlockSpec((1, t_blk, n), lambda bi, di, ti: (bi, ti, 0))
@@ -293,13 +313,15 @@ def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
     rbc_spec = pl.BlockSpec(
         (1, t_blk, n), lambda bi, di, ti: (bi, nt - 1 - ti, 0)
     )
-    du, ddt, dA_part, dB_part, dC_part = pl.pallas_call(
+    st_spec = pl.BlockSpec((1, n, dblk), lambda bi, di, ti: (bi, 0, di))
+    du, ddt, dA_part, dB_part, dC_part, dh0 = pl.pallas_call(
         functools.partial(_m1_bwd_kernel, nt=nt),
         grid=grid,
         in_specs=[
             rio_spec, rio_spec, A_spec, rbc_spec, rbc_spec,
             pl.BlockSpec((1, 1, n, dblk), lambda bi, di, ti: (bi, nt - 1 - ti, 0, di)),
             rio_spec,
+            st_spec,
         ],
         out_specs=[
             rio_spec,
@@ -307,6 +329,7 @@ def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
             pl.BlockSpec((1, n, dblk), lambda bi, di, ti: (bi, 0, di)),
             pl.BlockSpec((1, 1, t_blk, n), lambda bi, di, ti: (bi, di, nt - 1 - ti, 0)),
             pl.BlockSpec((1, 1, t_blk, n), lambda bi, di, ti: (bi, di, nt - 1 - ti, 0)),
+            st_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, t, d), jnp.float32),
@@ -314,6 +337,7 @@ def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
             jax.ShapeDtypeStruct((b, n, d), jnp.float32),
             jax.ShapeDtypeStruct((b, nd, t, n), jnp.float32),
             jax.ShapeDtypeStruct((b, nd, t, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, n, d), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((n, dblk), jnp.float32),
@@ -322,30 +346,34 @@ def _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy, interpret):
         ],
         compiler_params=seq_semantics,
         interpret=interpret,
-    )(uf, df, At, Bf, Cf, entry_states, dy)
+    )(uf, df, At, Bf, Cf, entry_states, dy, dfinal)
 
     dAf = jnp.sum(dA_part, axis=0).T           # (d, n)
     dBf = jnp.sum(dB_part, axis=1)             # (b, t, n)
     dCf = jnp.sum(dC_part, axis=1)
-    return du, ddt, dAf, dBf, dCf
+    return du, ddt, dAf, dBf, dCf, jnp.swapaxes(dh0, 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
-def _m1_core(uf, df, Af, Bf, Cf, interpret):
-    b, _, d = uf.shape
-    h0 = jnp.zeros((b, d, Af.shape[-1]), jnp.float32)
-    y, _ = _m1_pallas_fwd(uf, df, Af, Bf, Cf, h0, interpret)
-    return y
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _m1_core(uf, df, Af, Bf, Cf, h0, interpret, return_final_state):
+    y, hT = _m1_pallas_fwd(uf, df, Af, Bf, Cf, h0, interpret)
+    return (y, hT) if return_final_state else y
 
 
-def _m1_core_fwd(uf, df, Af, Bf, Cf, interpret):
-    return _m1_core(uf, df, Af, Bf, Cf, interpret), (uf, df, Af, Bf, Cf)
+def _m1_core_fwd(uf, df, Af, Bf, Cf, h0, interpret, return_final_state):
+    out = _m1_core(uf, df, Af, Bf, Cf, h0, interpret, return_final_state)
+    return out, (uf, df, Af, Bf, Cf, h0)
 
 
-def _m1_core_bwd(interpret, res, dy):
+def _m1_core_bwd(interpret, return_final_state, res, ct):
     """Pallas backward (see the backward section above)."""
-    uf, df, Af, Bf, Cf = res
-    return _m1_pallas_bwd_impl(uf, df, Af, Bf, Cf, dy.astype(jnp.float32), interpret)
+    uf, df, Af, Bf, Cf, h0 = res
+    dy, dfinal = ct if return_final_state else (ct, None)
+    du, ddt, dAf, dBf, dCf, dh0 = _m1_pallas_bwd_impl(
+        uf, df, Af, Bf, Cf, dy.astype(jnp.float32), interpret,
+        h0=h0, dfinal=dfinal,
+    )
+    return du, ddt, dAf, dBf, dCf, dh0
 
 
 _m1_core.defvjp(_m1_core_fwd, _m1_core_bwd)
@@ -367,10 +395,13 @@ def selective_scan_pallas(
 ):
     """Drop-in for ops/scan.selective_scan backed by the Pallas kernel.
 
-    With ``initial_state``/``return_final_state`` (decode prefill / SP)
-    the non-custom-vjp path runs; the plain training path gets the custom
-    VJP with a Pallas backward.  ``interpret=None`` auto-selects the Pallas
-    interpreter off-TPU (CPU tests run the same kernel code).
+    Every path — plain training, seeded (``initial_state``: decode
+    prefill / SP shards), and ``return_final_state`` — runs under the
+    custom VJP whose backward is itself Pallas: the entry-state
+    recompute starts from the same seed, a final-state cotangent seeds
+    the reverse sweep, and the initial-state gradient is returned.
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (CPU
+    tests run the same kernel code).
 
     The channel axis is padded to a multiple of the 128-lane vreg width
     and t to a multiple of 8 sublanes, so Mosaic only ever sees aligned
@@ -393,20 +424,20 @@ def selective_scan_pallas(
         Bf = jnp.pad(Bf, ((0, 0), pt, (0, 0)))
         Cf = jnp.pad(Cf, ((0, 0), pt, (0, 0)))
 
-    if initial_state is None and not return_final_state:
-        y = _m1_core(uf, df, Af, Bf, Cf, interpret)
-        h_last = None
-    else:
-        h0 = (
-            jnp.zeros((b, d + pad_d, Af.shape[-1]), jnp.float32)
-            if initial_state is None
-            else initial_state.astype(jnp.float32)
-        )
-        if pad_d and initial_state is not None:
-            h0 = jnp.pad(h0, ((0, 0), (0, pad_d), (0, 0)))
-        y, h_last = _m1_pallas_fwd(uf, df, Af, Bf, Cf, h0, interpret)
-        if pad_d and h_last is not None:
+    h0 = (
+        jnp.zeros((b, d + pad_d, Af.shape[-1]), jnp.float32)
+        if initial_state is None
+        else initial_state.astype(jnp.float32)
+    )
+    if pad_d and initial_state is not None:
+        h0 = jnp.pad(h0, ((0, 0), (0, pad_d), (0, 0)))
+    out = _m1_core(uf, df, Af, Bf, Cf, h0, interpret, return_final_state)
+    if return_final_state:
+        y, h_last = out
+        if pad_d:
             h_last = h_last[:, :d]
+    else:
+        y, h_last = out, None
 
     if pad_d or pad_t:
         y = y[:, :t, :d]

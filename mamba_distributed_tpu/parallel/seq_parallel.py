@@ -279,6 +279,7 @@ def sp_selective_scan(
     z: jax.Array | None = None,
     delta_bias: jax.Array | None = None,
     delta_softplus: bool = False,
+    ssm_impl: str = "xla",
 ):
     """Sequence-sharded Mamba-1 selective scan.
 
@@ -297,9 +298,20 @@ def sp_selective_scan(
     a few percent of layer FLOPs (the projections dominate), so 2x scan
     cost buys O(T/devices) memory with a negligible step-time impact.
 
+    ``ssm_impl="pallas"`` runs both local passes through the fused VMEM
+    kernel (ops/pallas/scan_kernels.py — its seeded custom_vjp makes the
+    h_in-dependent second pass differentiable); the cross-shard exchange
+    stays shard_map/ppermute either way.
+
     Returns (y, None) — the final state stays on the last shard.
     """
     from mamba_distributed_tpu.ops.scan import _prep, selective_scan
+
+    if ssm_impl == "pallas":
+        from mamba_distributed_tpu.ops.pallas import selective_scan_pallas
+        scan_fn = selective_scan_pallas
+    else:
+        scan_fn = selective_scan
 
     bat3 = P(ctx.batch_axes, ctx.axis, None)
     has_D, has_z, has_bias = D is not None, z is not None, delta_bias is not None
@@ -311,7 +323,7 @@ def sp_selective_scan(
         bias_ = next(it) if has_bias else None
 
         # pass 1: local summary (zero incoming state)
-        _, s_local = selective_scan(
+        _, s_local = scan_fn(
             u_l, dt_l, A_, B_l, C_l,
             delta_bias=bias_, delta_softplus=delta_softplus,
             return_final_state=True,
@@ -324,7 +336,7 @@ def sp_selective_scan(
         h_in = _incoming_state(ctx, decay_total, s_local)
 
         # pass 2: the real scan, seeded
-        return selective_scan(
+        return scan_fn(
             u_l, dt_l, A_, B_l, C_l, D=D_, z=z_l,
             delta_bias=bias_, delta_softplus=delta_softplus,
             initial_state=h_in,
