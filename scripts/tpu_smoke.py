@@ -128,6 +128,61 @@ def main() -> None:
             scale = float(jnp.max(jnp.abs(a))) or 1.0
             report(f"m1_pallas_bwd_d{name}", bb / scale, a / scale, atol=2e-2)
 
+        # --- seeded backwards (SP shards / decode prefill differentiate
+        # through these): initial_state in, final-state cotangent seeding.
+        # Shapes derive from the arrays (b/t/n were rebound by the m1
+        # section above) ---
+        s0 = jax.random.normal(
+            jax.random.PRNGKey(7),
+            (x.shape[0], x.shape[2], x.shape[3], C.shape[-1]),
+        )
+
+        def ssd_seeded_loss(fn):
+            def inner(x, dt, A, B, C, s0):
+                y, fin = fn(x, dt, A, B, C, chunk_size=256, D=D,
+                            compute_dtype=jnp.float32, initial_state=s0,
+                            return_final_state=True)
+                return jnp.sum(y ** 2) + 0.5 * jnp.sum(fin ** 2)
+            return inner
+
+        g_ref = jax.jit(jax.grad(ssd_seeded_loss(ssd_chunked), (0, 5)))(
+            x, dt, A, B, C, s0
+        )
+        g_pal = jax.jit(jax.grad(ssd_seeded_loss(ssd_chunked_pallas), (0, 5)))(
+            x, dt, A, B, C, s0
+        )
+        jax.block_until_ready(g_pal)
+        _progress("ssd pallas SEEDED backward compiled+ran on hardware")
+        for name, a, bb in zip(("x", "initial_state"), g_ref, g_pal):
+            scale = float(jnp.max(jnp.abs(a))) or 1.0
+            report(f"ssd_pallas_seeded_bwd_d{name}", bb / scale, a / scale,
+                   atol=2e-2)
+
+        h0 = jax.random.normal(
+            jax.random.PRNGKey(8),
+            (u.shape[0], u.shape[2], A1.shape[-1]),
+        )
+
+        def m1_seeded_loss(fn):
+            def inner(u, delta, A, B, C, h0):
+                y, fin = fn(u, delta, A, B, C, delta_softplus=True,
+                            initial_state=h0, return_final_state=True)
+                return jnp.sum(y ** 2) + 0.5 * jnp.sum(fin ** 2)
+            return inner
+
+        g_ref = jax.jit(jax.grad(m1_seeded_loss(selective_scan), (0, 5)))(
+            u, delta, A1, B1, C1, h0
+        )
+        g_pal = jax.jit(jax.grad(m1_seeded_loss(selective_scan_pallas), (0, 5)))(
+            u, delta, A1, B1, C1, h0
+        )
+        jax.block_until_ready(g_pal)
+        _progress("m1 pallas SEEDED backward compiled+ran on hardware")
+        for name, a, bb in zip(("u", "initial_state"), g_ref, g_pal):
+            scale = float(jnp.max(jnp.abs(a))) or 1.0
+            report(f"m1_pallas_seeded_bwd_d{name}", bb / scale, a / scale,
+                   atol=2e-2)
+
     raise SystemExit(0 if ok else 1)
 
 
