@@ -136,13 +136,20 @@ def attention_mixer(
                 ulysses_attention,
             )
 
-            out = ulysses_attention(seq_ctx, q, k, v)
+            out = ulysses_attention(seq_ctx, q, k, v, impl=cfg.attn_impl)
         else:
             from mamba_distributed_tpu.parallel.ring_attention import (
                 ring_attention,
             )
 
             out = ring_attention(seq_ctx, q, k, v)
+    elif cfg.attn_impl == "pallas":
+        from mamba_distributed_tpu.ops.pallas.attention_kernels import (
+            flash_sdpa_causal,
+        )
+
+        # flash kernel: online softmax in VMEM, fully-future blocks skipped
+        out = flash_sdpa_causal(q, k, v)
     else:
         from mamba_distributed_tpu.ops.blockwise_attention import (
             blockwise_sdpa_causal,

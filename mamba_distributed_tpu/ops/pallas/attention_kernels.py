@@ -1,0 +1,390 @@
+"""Pallas flash-attention kernel (causal, GQA) for the hybrid layers.
+
+TPU-native counterpart of the flash-attn CUDA kernels the reference's
+attention surface sits on one dep down (``mamba_ssm.modules.mha.MHA`` →
+``flash_attn`` — mamba-ssm 2.2.2; the reference never enables attention,
+SURVEY.md §2.3, but BASELINE config 5 requires it).  Re-derived for the
+MXU/VMEM model, not translated:
+
+  * grid = (batch, q-head, q-block, kv-block); the kv-block dimension is
+    the sequential one — the online-softmax accumulator (running max,
+    denominator, output) lives in VMEM scratch and streams KV through a
+    bounded working set, exactly the flash construction;
+  * fully-future (q-block, kv-block) pairs are *skipped* via ``pl.when``
+    on the grid indices — unlike the XLA blockwise path
+    (ops/blockwise_attention.py) whose branch-free schedule computes and
+    masks them, the kernel recovers the ~2x causal FLOPs;
+  * GQA routes the shared KV head via BlockSpec index maps
+    (``hi // rep``) — Q heads never see repeated KV in HBM;
+  * softmax statistics are carried per q-row in fp32; the row
+    log-sum-exp is emitted in a lane-degenerate ``(..., tq, 8)`` layout
+    (block spans the full trailing dim, so Mosaic tiling stays legal
+    without transposing row statistics into lanes).
+
+The backward is Pallas too (the flash-attn backward's trade): p is
+recomputed per (q, kv) block pair from q/k and the saved row-lse — no
+(t, t) tensor is ever materialized — with one kernel accumulating dq
+over the sequential kv dimension and a second accumulating dk/dv over
+the sequential q dimension; per-q-head dk/dv partials are group-summed
+in XLA (same pattern as the SSD backward's dB/dC).  Gradient parity vs
+the XLA blockwise path is pinned by tests/test_attention_pallas.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mamba_distributed_tpu.ops.pallas.common import resolve_interpret
+
+_NEG_INF = float("-inf")
+
+
+def _pick_block(t: int, target: int) -> int:
+    """Block size for a (padded) sequence length: target, or all of t."""
+    if t >= target:
+        return target
+    return -(-t // 8) * 8  # round up to the 8-sublane granule
+
+
+def _causal_mask(qb, kb, q0, k0, tk_valid):
+    """(qb, kb) bool: query row q0+i may attend key col k0+j (< tk_valid)."""
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (qb, kb), 0) + q0
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (qb, kb), 1) + k0
+    return (qpos >= kpos) & (kpos < tk_valid)
+
+
+def _fa_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, den_scr, acc_scr,
+    *, nk: int, sm_scale: float, offset: int, tk_valid: int,
+):
+    """One (batch, q-head, q-block, kv-block) cell of the forward."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    qb = q_ref.shape[2]
+    kb = k_ref.shape[2]
+
+    @pl.when(kj == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        den_scr[...] = jnp.zeros_like(den_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip fully-future blocks: first key of this block vs last query row
+    @pl.when(kj * kb <= qi * qb + qb - 1 + offset)
+    def _():
+        q = q_ref[0, 0]                                  # (qb, hd)
+        s = jax.lax.dot_general(                         # (qb, kb) fp32
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        mask = _causal_mask(qb, kb, qi * qb + offset, kj * kb, tk_valid)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                            # (qb, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # rows with every key masked so far keep m = -inf; guard both exps
+        scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_new), 0.0)   # (qb, kb)
+
+        acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        den_scr[...] = den_scr[...] * scale + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kj == nk - 1)
+    def _():
+        den = den_scr[:, :1]                             # (qb, 1)
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(den, 1e-30)).astype(
+            o_ref.dtype
+        )
+        # row lse; rows that saw no unmasked key (possible only for
+        # offset < 0 uses) get +inf so the backward's exp(s - lse) is 0
+        # there.  Padded query rows attend normally and get a finite lse —
+        # their backward is harmless because their dO rows are zero.
+        lse = jnp.where(
+            den > 0.0, m_scr[:, :1] + jnp.log(jnp.maximum(den, 1e-30)),
+            jnp.inf,
+        )
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], 8))
+
+
+def _fa_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref, dq_scr,
+    *, nk: int, sm_scale: float, offset: int, tk_valid: int,
+):
+    """dq for one q-block, accumulated over the sequential kv dimension."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    qb = q_ref.shape[2]
+    kb = k_ref.shape[2]
+
+    @pl.when(kj == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(kj * kb <= qi * qb + qb - 1 + offset)
+    def _():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        mask = _causal_mask(qb, kb, qi * qb + offset, kj * kb, tk_valid)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])            # (qb, kb)
+        dp = jax.lax.dot_general(                        # dO @ V^T
+            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dlt_ref[0, 0][:, :1])
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+
+    @pl.when(kj == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...]
+
+
+def _fa_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, nq: int, sm_scale: float, offset: int, tk_valid: int,
+):
+    """Per-q-head dk/dv partials for one kv-block, over the sequential
+    q dimension (group-summed over GQA reps in XLA afterwards)."""
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    qb = q_ref.shape[2]
+    kb = k_ref.shape[2]
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(kj * kb <= qi * qb + qb - 1 + offset)
+    def _():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        mask = _causal_mask(qb, kb, qi * qb + offset, kj * kb, tk_valid)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])            # (qb, kb)
+        do = do_ref[0, 0]
+        # dV += P^T @ dO   (contract the q/sublane dim of both)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dlt_ref[0, 0][:, :1])
+        # dK += dS^T @ Q
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...]
+        dv_ref[0, 0] = dv_scr[...]
+
+
+def _fa_fwd_impl(qt, kt, vt, offset, tk_valid, qb, kb, interpret):
+    """(b, nh, tq, hd), (b, nkv, tk, hd) -> o (b, nh, tq, hd), lse."""
+    b, nh, tq, hd = qt.shape
+    nkv, tk = kt.shape[1], kt.shape[2]
+    rep = nh // nkv
+    nq, nk = tq // qb, tk // kb
+    sm_scale = 1.0 / math.sqrt(hd)
+    grid = (b, nh, nq, nk)
+
+    q_spec = pl.BlockSpec((1, 1, qb, hd), lambda bi, hi, qi, kj: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, kb, hd), lambda bi, hi, qi, kj: (bi, hi // rep, kj, 0)
+    )
+    lse_spec = pl.BlockSpec((1, 1, qb, 8), lambda bi, hi, qi, kj: (bi, hi, qi, 0))
+
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fa_fwd_kernel, nk=nk, sm_scale=sm_scale, offset=offset,
+            tk_valid=tk_valid,
+        ),
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nh, tq, hd), qt.dtype),
+            jax.ShapeDtypeStruct((b, nh, tq, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qb, 128), jnp.float32),
+            pltpu.VMEM((qb, 128), jnp.float32),
+            pltpu.VMEM((qb, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return o, lse
+
+
+def _fa_bwd_impl(qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret):
+    b, nh, tq, hd = qt.shape
+    nkv, tk = kt.shape[1], kt.shape[2]
+    rep = nh // nkv
+    nq, nk = tq // qb, tk // kb
+    sm_scale = 1.0 / math.sqrt(hd)
+
+    # D_i = rowsum(dO ⊙ O), emitted in the same lane-degenerate layout as
+    # lse (elementwise + lane reduction: XLA fuses it)
+    dlt = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )
+    dlt = jnp.broadcast_to(dlt, (b, nh, tq, 8))
+
+    q_spec = pl.BlockSpec((1, 1, qb, hd), lambda bi, hi, qi, kj: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, kb, hd), lambda bi, hi, qi, kj: (bi, hi // rep, kj, 0)
+    )
+    lse_spec = pl.BlockSpec((1, 1, qb, 8), lambda bi, hi, qi, kj: (bi, hi, qi, 0))
+    seq_kv = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, nk=nk, sm_scale=sm_scale, offset=offset,
+            tk_valid=tk_valid,
+        ),
+        grid=(b, nh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, tq, hd), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((qb, hd), jnp.float32)],
+        compiler_params=seq_kv,
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, dlt)
+
+    # dk/dv: grid loops kv blocks in the third slot, q blocks sequential
+    rq_spec = pl.BlockSpec((1, 1, qb, hd), lambda bi, hi, kj, qi: (bi, hi, qi, 0))
+    rkv_spec = pl.BlockSpec(
+        (1, 1, kb, hd), lambda bi, hi, kj, qi: (bi, hi // rep, kj, 0)
+    )
+    rkv_out = pl.BlockSpec((1, 1, kb, hd), lambda bi, hi, kj, qi: (bi, hi, kj, 0))
+    rlse_spec = pl.BlockSpec((1, 1, qb, 8), lambda bi, hi, kj, qi: (bi, hi, qi, 0))
+    dk_part, dv_part = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, nq=nq, sm_scale=sm_scale, offset=offset,
+            tk_valid=tk_valid,
+        ),
+        grid=(b, nh, nk, nq),
+        in_specs=[rq_spec, rkv_spec, rkv_spec, rq_spec, rlse_spec, rlse_spec],
+        out_specs=[rkv_out, rkv_out],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nh, tk, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, nh, tk, hd), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((kb, hd), jnp.float32),
+            pltpu.VMEM((kb, hd), jnp.float32),
+        ],
+        compiler_params=seq_kv,
+        interpret=interpret,
+    )(qt, kt, vt, do, lse, dlt)
+
+    # GQA group-sum of the per-q-head partials (rep == 1 is a no-op reshape)
+    dk = jnp.sum(dk_part.reshape(b, nkv, rep, tk, hd), axis=2)
+    dv = jnp.sum(dv_part.reshape(b, nkv, rep, tk, hd), axis=2)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fa_core(qt, kt, vt, offset, tk_valid, qb, kb, interpret):
+    o, _ = _fa_fwd_impl(qt, kt, vt, offset, tk_valid, qb, kb, interpret)
+    return o
+
+
+def _fa_core_fwd(qt, kt, vt, offset, tk_valid, qb, kb, interpret):
+    o, lse = _fa_fwd_impl(qt, kt, vt, offset, tk_valid, qb, kb, interpret)
+    return o, (qt, kt, vt, o, lse)
+
+
+def _fa_core_bwd(offset, tk_valid, qb, kb, interpret, res, do):
+    qt, kt, vt, o, lse = res
+    dq, dk, dv = _fa_bwd_impl(
+        qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret
+    )
+    return (
+        dq.astype(qt.dtype), dk.astype(kt.dtype), dv.astype(vt.dtype)
+    )
+
+
+_fa_core.defvjp(_fa_core_fwd, _fa_core_bwd)
+
+
+def flash_sdpa_causal(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    offset: int = 0,
+    q_block: int = 256,
+    k_block: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal softmax(QK^T/sqrt(d))V with GQA broadcast — Pallas flash.
+
+    Same contract as ops/blockwise_attention.blockwise_sdpa_causal:
+    q (b, tq, nh, hd); k/v (b, tk, nkv, hd); ``offset`` = absolute
+    position of q[0] minus that of k[0] (static).  Sequence lengths are
+    padded to block multiples (padded keys are masked via the key-length
+    term; padded query rows are computed then sliced off — their
+    cotangent rows are zero through the pad/slice pair, so ds vanishes
+    on them and the backward stays NaN-free), head dims pass through
+    whole (blocks span the full trailing dim).  ``interpret=None``
+    auto-selects the Pallas interpreter off-TPU.
+    """
+    interpret = resolve_interpret(interpret)
+    b, tq, nh, hd = q.shape
+    tk, nkv = k.shape[1], k.shape[2]
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} not a multiple of kv heads {nkv}")
+    offset = int(offset)
+
+    qb = _pick_block(tq, q_block)
+    kb = _pick_block(tk, k_block)
+    pad_q = -tq % qb
+    pad_k = -tk % kb
+
+    qt = jnp.moveaxis(q, 2, 1)
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    o = _fa_core(qt, kt, vt, offset, tk, qb, kb, interpret)
+    if pad_q:
+        o = o[:, :, :tq]
+    return jnp.moveaxis(o, 1, 2)

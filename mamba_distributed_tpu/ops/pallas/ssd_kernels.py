@@ -4,15 +4,19 @@ TPU-native counterpart of the Triton SSD kernels the reference depends on
 (``mamba_ssm/ops/triton/ssd_chunk_scan.py`` etc., mamba-ssm 2.2.2) — but
 re-derived for the MXU/VMEM model, not translated:
 
-  * one grid cell = (batch, chunk, head-block); the (l x l) decay matrix
+  * one grid cell = (batch, chunk, head); the (l x l) decay matrix
     ``L`` is rebuilt from the cumulative log-decay *inside VMEM* per cell,
     never touching HBM (the XLA path's biggest intermediate);
   * the two sequential pieces stay at the XLA level where they belong:
     the inter-chunk state recurrence is a tiny ``associative_scan``
-    (ops/ssd.state_passing), and grouped B/C are indexed per head-block
-    via the BlockSpec index map (never repeated into (b, t, h, n) form);
-  * heads are processed ``hb = 128 // headdim`` at a time so the lane
-    dimension of the y/x tiles stays full.
+    (ops/ssd.state_passing), and grouped B/C are indexed per head via
+    the BlockSpec index map (never repeated into (b, t, h, n) form);
+  * every kernel body is strictly 2-D (l- or p-major tiles): the real
+    Mosaic compiler rejects lane-splitting shape casts like
+    ``(l, hb*p) -> (l, hb, p)`` at its infer-vector-layout pass — a
+    failure mode ``jax.export``-based lowering tests do NOT catch (found
+    on hardware, round 4) — so the head axis lives purely in the grid
+    and nothing is ever reshaped in-kernel.
 
 Training uses ``jax.custom_vjp`` with a **Pallas backward** (the analogue
 of ``_mamba_chunk_scan_combined_bwd`` in the reference dep's
@@ -44,137 +48,127 @@ _PARALLEL3 = pltpu.CompilerParams(
 )
 
 
-def _chunk_states_kernel(x_ref, dt_ref, acum_ref, B_ref, out_ref, *, compute_dtype):
-    """Per-chunk state contribution: out[hb, p, n] = sum_l decay*dt*x (x) B."""
-    a = acum_ref[0, 0, 0]         # (l, hb) fp32, inclusive cumsum of dt*A
-    dt = dt_ref[0, 0, 0]          # (l, hb) fp32
+def _chunk_states_kernel(x_ref, w_ref, B_ref, out_ref, *, compute_dtype):
+    """Per-chunk state contribution: out[p, n] = sum_l w*x (x) B,
+    with w = dt * exp(a_last - a) precomputed in XLA."""
+    w = w_ref[0, 0, 0]            # (l, 1) fp32
     Bb = B_ref[0, 0, 0]           # (l, n)
-    l, hb = a.shape
-    x = x_ref[0, 0, 0].reshape(l, hb, -1)   # (l, hb, p)
+    x = x_ref[0, 0, 0]            # (l, p)
 
-    decay = jnp.exp(a[-1:, :] - a) * dt            # (l, hb)
-    Bd = Bb[:, None, :] * decay[:, :, None]        # (l, hb, n)
-    # batched over hb: (hb, p, l) @ (hb, l, n) -> (hb, p, n)
-    xt = jnp.transpose(x, (1, 2, 0)).astype(compute_dtype)
-    Bt = jnp.transpose(Bd, (1, 0, 2)).astype(compute_dtype)
-    out_ref[0, 0] = jax.lax.dot_general(
-        xt, Bt, (((2,), (1,)), ((0,), (0,))),
+    Bd = (Bb.astype(jnp.float32) * w).astype(compute_dtype)      # (l, n)
+    # x^T @ Bd: (p, l) @ (l, n) -> (p, n), contracting the sublane dim
+    out_ref[0, 0, 0] = jax.lax.dot_general(
+        x.astype(compute_dtype), Bd, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
 
 def _chunk_output_kernel(
-    x_ref, dt_ref, acum_ref, B_ref, C_ref, prev_ref, y_ref, *, compute_dtype
+    x_ref, dt_ref, ac_ref, at_ref, e_ref, B_ref, C_ref, prev_ref, y_ref,
+    *, compute_dtype
 ):
-    """y = (G odot L) @ (x*dt) + (C*exp(a)) @ prev_state^T for one cell."""
-    a = acum_ref[0, 0, 0]         # (l, hb) fp32
-    dt = dt_ref[0, 0, 0]          # (l, hb)
+    """y = (G odot L) @ (x*dt) + (C*exp(a)) @ prev_state^T for one cell.
+
+    ``ac``/``at`` are the in-chunk cumulative log-decay in column (l, 1)
+    and row (1, l) layouts (both fed from XLA — Mosaic supports neither
+    lane-splitting reshapes nor small transposes in-kernel), ``e`` is
+    exp(a) (l, 1).
+    """
+    ac = ac_ref[0, 0, 0]          # (l, 1) fp32
+    at = at_ref[0, 0, 0]          # (1, l) fp32
+    dt = dt_ref[0, 0, 0]          # (l, 1)
+    e = e_ref[0, 0, 0]            # (l, 1)
     Bb = B_ref[0, 0, 0].astype(compute_dtype)      # (l, n)
     Cb = C_ref[0, 0, 0].astype(compute_dtype)      # (l, n)
-    l, hb = a.shape
-    x = x_ref[0, 0, 0].reshape(l, hb, -1)          # (l, hb, p)
-    prev = prev_ref[0, 0]         # (hb, p, n) fp32
+    l = ac.shape[0]
+    x = x_ref[0, 0, 0]            # (l, p)
+    prev = prev_ref[0, 0, 0]      # (p, n) fp32
 
-    # G is group-shared across the hb heads of this block
-    G = jnp.dot(Cb, Bb.T, preferred_element_type=jnp.float32)  # (l, l)
+    # G is group-shared; recomputed per cell (cheap vs one HBM round-trip).
+    # NT-form dot_general: no in-kernel transpose (Mosaic-safe)
+    G = jax.lax.dot_general(
+        Cb, Bb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                          # (l, l)
 
-    # decay matrix rebuilt in VMEM: L[h, i, j] = exp(a_i - a_j) on i >= j
-    ai = a.T[:, :, None]          # (hb, l, 1)
-    aj = a.T[:, None, :]          # (hb, 1, l)
+    # decay matrix rebuilt in VMEM: L[i, j] = exp(a_i - a_j) on i >= j
     ii = jax.lax.broadcasted_iota(jnp.int32, (l, l), 0)
     jj = jax.lax.broadcasted_iota(jnp.int32, (l, l), 1)
     tril = ii >= jj
-    M = jnp.where(tril[None], G[None] * jnp.exp(ai - aj), 0.0)  # (hb, l, l)
+    M = jnp.where(tril, G * jnp.exp(ac - at), 0.0)             # (l, l)
 
-    xdt = (x.astype(jnp.float32) * dt[:, :, None]).astype(compute_dtype)
-    xdt_t = jnp.transpose(xdt, (1, 0, 2))          # (hb, l, p)
-    y = jax.lax.dot_general(
-        M.astype(compute_dtype), xdt_t, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )                                              # (hb, l, p)
+    xdt = (x.astype(jnp.float32) * dt).astype(compute_dtype)   # (l, p)
+    y = jnp.dot(M.astype(compute_dtype), xdt,
+                preferred_element_type=jnp.float32)            # (l, p)
 
-    # off-diagonal: carried-state contribution
-    cd = (Cb[None] * jnp.exp(a.T)[:, :, None]).astype(compute_dtype)  # (hb, l, n)
+    # off-diagonal: carried-state contribution  (C*e^a) @ prev^T
+    cd = (Cb.astype(jnp.float32) * e).astype(compute_dtype)    # (l, n)
     y = y + jax.lax.dot_general(
-        cd, jnp.transpose(prev, (0, 2, 1)).astype(compute_dtype),
-        (((2,), (1,)), ((0,), (0,))),
+        cd, prev.astype(compute_dtype), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    y_ref[0, 0, 0] = (
-        jnp.transpose(y, (1, 0, 2)).reshape(l, -1).astype(y_ref.dtype)
-    )  # (l, hb*p)
+    y_ref[0, 0, 0] = y.astype(y_ref.dtype)         # (l, p)
 
 
-def _heads_per_block(h: int, p: int, g: int, max_hb: int | None = None) -> int:
-    hb = max(1, 128 // p)
-    if max_hb is not None:
-        hb = max(1, min(hb, max_hb))
-    heads_per_group = h // g
-    while heads_per_group % hb != 0 or h % hb != 0:
-        hb -= 1
-    return max(hb, 1)
-
-
-def _bwd_hb_cap(l: int) -> int:
-    """VMEM guard for the backward cell kernel (ADVICE r3): it holds ~5
-    (hb, l, l) fp32 tensors live (diff, Lm, M, dM, dMM), so cap hb to
-    keep that working set under ~4MB — the same budget the m1 backward's
-    rebuilt-state scratch honors.  Small headdim + large chunk (p=8 ->
-    hb=16 at l=256 would be ~20MB) is exactly the case this catches."""
-    budget = 4 * 1024 * 1024
-    return max(1, budget // (5 * l * l * 4))
-
-
-def _cell_specs(h: int, hb: int, l: int, p: int, n: int, g: int):
+def _cell_specs(h: int, l: int, p: int, n: int, g: int):
     """Grid-cell BlockSpecs shared by the fwd and bwd kernels.
 
     Every block spans the FULL trailing two array dims, which makes it
     unconditionally legal under Mosaic's (8, 128)-or-full-dim tiling
-    rule — the head-block structure lives in a dedicated array axis
-    instead of a partial-dim block (layouts built by _chunked_inputs):
-      x/y/dy  (b, nc, nhb, l, hb*p)   one lane-filling head-block per cell
-      dt/a    (b, nc, nhb, l, hb)
-      B/C     (b, nc, g,   l, n)      cell's group via the index map
-      states  (b, nc, h, p, n)        (p, n) trailing dims; p % 8 asserted
+    rule, and every kernel-visible tile is 2-D — the head axis lives in
+    the grid, never inside a block (layouts built by _chunked_inputs):
+      x/y/dy  (b, nc, h, l, p)       one head per cell
+      dt/a/e  (b, nc, h, l, 1)       lane-degenerate per-head columns
+      at      (b, nc, h, 1, l)       row layout of the log-decay
+      B/C     (b, nc, g, l, n)       cell's group via the index map
+      states  (b, nc, h, p, n)       (p, n) trailing dims; p % 8 asserted
     """
     xhp_spec = pl.BlockSpec(
-        (1, 1, 1, l, hb * p), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+        (1, 1, 1, l, p), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
     )
     dt_spec = pl.BlockSpec(
-        (1, 1, 1, l, hb), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+        (1, 1, 1, l, 1), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+    )
+    at_spec = pl.BlockSpec(
+        (1, 1, 1, 1, l), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
     )
     bc_spec = pl.BlockSpec(
-        (1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, (hi * hb * g) // h, 0, 0)
+        (1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, (hi * g) // h, 0, 0)
     )
-    st_spec = pl.BlockSpec((1, 1, hb, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0))
-    return xhp_spec, dt_spec, bc_spec, st_spec
+    st_spec = pl.BlockSpec(
+        (1, 1, 1, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
+    )
+    return xhp_spec, dt_spec, at_spec, bc_spec, st_spec
 
 
-def _to_cells(v, b, nc, l, nhb, hb, tail):
-    """(b, t, h, *tail) -> (b, nc, nhb, l, hb*prod(tail))."""
-    v = v.reshape(b, nc, l, nhb, hb, *tail)
-    v = jnp.moveaxis(v, 3, 2)                        # (b, nc, nhb, l, hb, ...)
-    return v.reshape(b, nc, nhb, l, -1)
+def _to_cells(v, b, nc, l, h, tail):
+    """(b, t, h, *tail) -> (b, nc, h, l, prod(tail) or 1)."""
+    v = v.reshape(b, nc, l, h, *tail)
+    v = jnp.moveaxis(v, 3, 2)                        # (b, nc, h, l, ...)
+    return v.reshape(b, nc, h, l, -1)
 
 
 def _from_cells(v, b, t, h, p):
-    """(b, nc, nhb, l, hb*p) -> (b, t, h, p)."""
-    nc, nhb = v.shape[1], v.shape[2]
-    l = v.shape[3]
-    hb = h // nhb
-    v = v.reshape(b, nc, nhb, l, hb, p)
-    v = jnp.moveaxis(v, 2, 3)                        # (b, nc, l, nhb, hb, p)
+    """(b, nc, h, l, p) -> (b, t, h, p)."""
+    nc, l = v.shape[1], v.shape[3]
+    v = jnp.moveaxis(v, 2, 3)                        # (b, nc, l, h, p)
     return v.reshape(b, t, h, p)
 
 
-def _chunked_inputs(x, dt, A, B, C, chunk_size, max_hb=None):
-    """Shared fwd/bwd preprocessing: chunk/cell layouts + in-chunk log-decay."""
+def _chunked_inputs(x, dt, A, B, C, chunk_size):
+    """Shared fwd/bwd preprocessing: chunk/cell layouts + in-chunk log-decay.
+
+    All the elementwise decay factors the kernels need are precomputed
+    here (they fuse into the cumsum chain): ``ar``/``art`` are the
+    cumulative log-decay in column (l, 1) / row (1, l) cell layouts,
+    ``er`` = exp(a), ``wr`` = dt * exp(a_last - a), ``dr`` =
+    exp(a_last - a).  Everything is bounded by exp(0) = 1 (a is a cumsum
+    of dt*A <= 0), so none of the exps can overflow.
+    """
     b, t, h, p = x.shape
     g, n = B.shape[2], B.shape[3]
     l = _divisor_chunk(t, chunk_size)
     nc = t // l
-    hb = _heads_per_block(h, p, g, max_hb)
-    nhb = h // hb
     if p % 8 != 0:  # the (p, n)-trailing state blocks need 8-sublane tiles
         raise ValueError(
             f"ssm_impl='pallas' needs headdim % 8 == 0 for Mosaic tiling, "
@@ -185,13 +179,22 @@ def _chunked_inputs(x, dt, A, B, C, chunk_size, max_hb=None):
     dA = dtf * A.astype(jnp.float32)                 # (b, t, h)
     a_cum = jnp.cumsum(dA.reshape(b, nc, l, h), axis=2)          # (b, nc, l, h)
     chunk_decay = jnp.exp(a_cum[:, :, -1, :])        # (b, nc, h)
+    d_to_end = jnp.exp(a_cum[:, :, -1:, :] - a_cum)  # (b, nc, l, h)
 
-    xr = _to_cells(x, b, nc, l, nhb, hb, (p,))
-    dtr = _to_cells(dtf, b, nc, l, nhb, hb, ())
-    ar = _to_cells(a_cum.reshape(b, t, h), b, nc, l, nhb, hb, ())
+    flat = lambda v: v.reshape(b, t, h)
+    xr = _to_cells(x, b, nc, l, h, (p,))
+    dtr = _to_cells(dtf, b, nc, l, h, ())
+    ar = _to_cells(flat(a_cum), b, nc, l, h, ())
+    er = _to_cells(flat(jnp.exp(a_cum)), b, nc, l, h, ())
+    dr = _to_cells(flat(d_to_end), b, nc, l, h, ())
+    art = jnp.swapaxes(ar, 3, 4)                     # (b, nc, h, 1, l)
     Br = jnp.moveaxis(B.reshape(b, nc, l, g, n), 3, 2)           # (b, nc, g, l, n)
     Cr = jnp.moveaxis(C.reshape(b, nc, l, g, n), 3, 2)
-    return xr, dtr, ar, chunk_decay, Br, Cr, (b, nc, l, h, hb, p, g, n)
+    cells = {
+        "x": xr, "dt": dtr, "a": ar, "at": art, "e": er, "d": dr,
+        "w": dtr * dr, "B": Br, "C": Cr,
+    }
+    return cells, chunk_decay, (b, nc, l, h, p, g, n)
 
 
 def _ssd_pallas_fwd_impl(
@@ -202,37 +205,36 @@ def _ssd_pallas_fwd_impl(
     Shapes: x (b,t,h,p); dt (b,t,h) [bias-added+softplused]; A (h,);
     B/C (b,t,g,n).  Returns (y_no_D (b,t,h,p) fp32-accurate, final_state).
     """
-    xr, dtr, ar, chunk_decay, Br, Cr, dims = _chunked_inputs(
-        x, dt, A, B, C, chunk_size
-    )
-    b, nc, l, h, hb, p, g, n = dims
+    cells, chunk_decay, dims = _chunked_inputs(x, dt, A, B, C, chunk_size)
+    b, nc, l, h, p, g, n = dims
     t = nc * l
-    nhb = h // hb
 
-    grid = (b, nc, nhb)
-    xhp_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
+    grid = (b, nc, h)
+    xhp_spec, dt_spec, at_spec, bc_spec, st_spec = _cell_specs(h, l, p, n, g)
 
     states = pl.pallas_call(
         functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
         grid=grid,
-        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec],
+        in_specs=[xhp_spec, dt_spec, bc_spec],
         out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, ar, Br)
+    )(cells["x"], cells["w"], cells["B"])
 
     prev_states, final_state = state_passing(states, chunk_decay, initial_state)
 
     y = pl.pallas_call(
         functools.partial(_chunk_output_kernel, compute_dtype=compute_dtype),
-        out_shape=jax.ShapeDtypeStruct((b, nc, nhb, l, hb * p), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, nc, h, l, p), x.dtype),
         grid=grid,
-        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec],
+        in_specs=[xhp_spec, dt_spec, dt_spec, at_spec, dt_spec, bc_spec,
+                  bc_spec, st_spec],
         out_specs=xhp_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, ar, Br, Cr, prev_states)
+    )(cells["x"], cells["dt"], cells["a"], cells["at"], cells["e"],
+      cells["B"], cells["C"], prev_states)
 
     return _from_cells(y, b, t, h, p), final_state
 
@@ -254,117 +256,122 @@ def _ssd_pallas_fwd_impl(
 # ---------------------------------------------------------------------------
 
 
-def _dstate_direct_kernel(dy_ref, acum_ref, C_ref, out_ref, *, compute_dtype):
+def _dstate_direct_kernel(dy_ref, e_ref, C_ref, out_ref, *, compute_dtype):
     """Direct gradient of the chunk-entering state: dP = dY^T @ (e^a .* C)."""
-    a = acum_ref[0, 0, 0]                            # (l, hb) fp32
+    e = e_ref[0, 0, 0]                               # (l, 1) fp32, <= 1
     Cb = C_ref[0, 0, 0]                              # (l, n)
-    l, hb = a.shape
-    dy = dy_ref[0, 0, 0].reshape(l, hb, -1)          # (l, hb, p)
+    dy = dy_ref[0, 0, 0]                             # (l, p)
 
-    e = jnp.exp(a)                                   # (l, hb), <= 1
-    eC = e.T[:, :, None] * Cb[None].astype(jnp.float32)          # (hb, l, n)
-    dyt = jnp.transpose(dy, (1, 2, 0)).astype(compute_dtype)     # (hb, p, l)
-    out_ref[0, 0] = jax.lax.dot_general(
-        dyt, eC.astype(compute_dtype), (((2,), (1,)), ((0,), (0,))),
+    eC = (e * Cb.astype(jnp.float32)).astype(compute_dtype)      # (l, n)
+    # dY^T @ eC: contract the sublane dim of both -> (p, n)
+    out_ref[0, 0, 0] = jax.lax.dot_general(
+        dy.astype(compute_dtype), eC, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )                                                # (hb, p, n)
+    )
 
 
 def _ssd_bwd_cell_kernel(
-    x_ref, dt_ref, acum_ref, B_ref, C_ref, prev_ref, dy_ref, dS_ref,
-    dx_ref, ddt_ref, da_ref, dB_ref, dC_ref, *, compute_dtype,
+    x_ref, dt_ref, ac_ref, at_ref, e_ref, d_ref, B_ref, C_ref, prev_ref,
+    dy_ref, dS_ref, dx_ref, ddt_ref, da_ref, dB_ref, dC_ref,
+    *, compute_dtype,
 ):
-    """All per-cell input gradients for one (batch, chunk, head-block).
+    """All per-cell input gradients for one (batch, chunk, head).
 
-    Outputs: dx (l,hb,p); ddt_direct (l,hb) [the dt*x product-rule term];
-    da (l,hb) [grad wrt the in-chunk cumulative log-decay, pushed through
-    the cumsum chain by the XLA epilogue]; dB/dC (l,n) per head-block
-    [summed over a group's head-blocks outside].
+    Strictly 2-D bodies (see module docstring): sublane-axis sums go
+    through ones-vector matmuls instead of transposes, and all decay
+    factors (e = exp(a), d = exp(a_last - a), row/col a) arrive
+    precomputed from XLA.
+
+    Outputs: dx (l,p); ddt_direct (l,1) [the dt*x product-rule term];
+    da (l,1) [grad wrt the in-chunk cumulative log-decay, pushed through
+    the cumsum chain by the XLA epilogue]; dB/dC (l,n) per head
+    [summed over a group's heads outside].
     """
     cd = compute_dtype
-    a = acum_ref[0, 0, 0]                            # (l, hb) fp32
-    dt = dt_ref[0, 0, 0]                             # (l, hb) fp32
-    l, hb = a.shape
-    x = x_ref[0, 0, 0].reshape(l, hb, -1).astype(jnp.float32)    # (l, hb, p)
+    ac = ac_ref[0, 0, 0]                             # (l, 1) fp32
+    at = at_ref[0, 0, 0]                             # (1, l) fp32
+    dt = dt_ref[0, 0, 0]                             # (l, 1) fp32
+    e = e_ref[0, 0, 0]                               # (l, 1) = exp(a)
+    d = d_ref[0, 0, 0]                               # (l, 1) decay-to-end
+    l = ac.shape[0]
+    x = x_ref[0, 0, 0].astype(jnp.float32)           # (l, p)
     Bb = B_ref[0, 0, 0]                              # (l, n)
     Cb = C_ref[0, 0, 0]                              # (l, n)
-    P = prev_ref[0, 0]                               # (hb, p, n) fp32
-    dy = dy_ref[0, 0, 0].reshape(l, hb, -1).astype(jnp.float32)  # (l, hb, p)
-    dS = dS_ref[0, 0]                                # (hb, p, n) fp32
+    P = prev_ref[0, 0, 0]                            # (p, n) fp32
+    dy = dy_ref[0, 0, 0].astype(jnp.float32)         # (l, p)
+    dS = dS_ref[0, 0, 0]                             # (p, n) fp32
+    ones = jnp.ones((l, 1), jnp.float32)
 
-    e = jnp.exp(a)                                   # (l, hb)
-    d = jnp.exp(a[-1:, :] - a)                       # (l, hb) decay-to-end
-    u = x * dt[:, :, None]                           # (l, hb, p)
-    ut = jnp.transpose(u, (1, 0, 2))                 # (hb, l, p)
-    dyt = jnp.transpose(dy, (1, 0, 2))               # (hb, l, p)
+    u = x * dt                                       # (l, p)
 
     # --- intra-chunk: y_diag = (G .* L) @ u -------------------------------
-    G = jnp.dot(Cb.astype(cd), Bb.astype(cd).T,
-                preferred_element_type=jnp.float32)  # (l, l) group-shared
+    G = jax.lax.dot_general(                         # (l, l), NT form
+        Cb.astype(cd), Bb.astype(cd), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     ii = jax.lax.broadcasted_iota(jnp.int32, (l, l), 0)
     jj = jax.lax.broadcasted_iota(jnp.int32, (l, l), 1)
     tril = ii >= jj
-    diff = a.T[:, :, None] - a.T[:, None, :]         # (hb, l, l)
-    Lm = jnp.exp(jnp.where(tril[None], diff, -jnp.inf))          # (hb, l, l)
-    M = G[None] * Lm                                 # (hb, l, l) fp32
+    Lm = jnp.where(tril, jnp.exp(ac - at), 0.0)      # (l, l)
+    M = G * Lm                                       # (l, l) fp32
 
-    dM = jax.lax.dot_general(                        # dM = dY @ u^T
-        dyt.astype(cd), ut.astype(cd), (((2,), (2,)), ((0,), (0,))),
+    dM = jax.lax.dot_general(                        # dM = dY @ u^T  (l, l)
+        dy.astype(cd), u.astype(cd), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )                                                # (hb, l, l)
-    du = jax.lax.dot_general(                        # du = M^T @ dY
-        jnp.transpose(M, (0, 2, 1)).astype(cd), dyt.astype(cd),
-        (((2,), (1,)), ((0,), (0,))),
+    )
+    du = jax.lax.dot_general(                        # du = M^T @ dY  (l, p)
+        M.astype(cd), dy.astype(cd), (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )                                                # (hb, l, p)
+    )
 
     dMM = dM * M                                     # = dL .* L .* G
-    da = (jnp.sum(dMM, axis=2) - jnp.sum(dMM, axis=1)).T         # (l, hb)
-    dG = jnp.sum(dM * Lm, axis=0)                    # (l, l), masked by Lm
-    dB_acc = jnp.dot(dG.T.astype(cd), Cb.astype(cd),
-                     preferred_element_type=jnp.float32)         # (l, n)
+    rowsum = jnp.sum(dMM, axis=1, keepdims=True)     # (l, 1) lane reduction
+    colsum = jax.lax.dot_general(                    # dMM^T @ 1 -> (l, 1)
+        dMM, ones, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    da = rowsum - colsum                             # (l, 1)
+    dG = dM * Lm                                     # (l, l), masked by Lm
+    dB_acc = jax.lax.dot_general(                    # dG^T @ C  (l, n)
+        dG.astype(cd), Cb.astype(cd), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     dC_acc = jnp.dot(dG.astype(cd), Bb.astype(cd),
                      preferred_element_type=jnp.float32)         # (l, n)
 
     # --- off-diagonal: y_off = diag(e) C @ P^T ----------------------------
-    T = jax.lax.dot_general(                         # T = dY @ P
-        dyt.astype(cd), P.astype(cd), (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )                                                # (hb, l, n)
-    dC_acc = dC_acc + jnp.sum(e.T[:, :, None] * T, axis=0)
-    de = jnp.sum(T * Cb[None].astype(jnp.float32), axis=2)       # (hb, l)
-    da = da + de.T * e
+    T = jnp.dot(dy.astype(cd), P.astype(cd),
+                preferred_element_type=jnp.float32)  # (l, n) = dY @ P
+    dC_acc = dC_acc + e * T
+    de = jnp.sum(T * Cb.astype(jnp.float32), axis=1, keepdims=True)  # (l, 1)
+    da = da + de * e
 
     # --- state summary: S = sum_j d_j u_j (x) B_j -------------------------
-    dwt = jnp.transpose(                             # dw = dS @ B^T per head
-        jax.lax.dot_general(
-            dS.astype(cd), Bb.astype(cd), (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ),                                           # (hb, p, l)
-        (0, 2, 1),
-    )                                                # (hb, l, p)
-    dT = d.T                                         # (hb, l)
-    wt = ut * dT[:, :, None]                         # (hb, l, p)
-    dB_acc = dB_acc + jnp.sum(
-        jax.lax.dot_general(
-            jnp.transpose(wt, (0, 2, 1)).astype(cd), dS.astype(cd),
-            (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ),
-        axis=0,
+    dw = jax.lax.dot_general(                        # B @ dS^T  (l, p)
+        Bb.astype(cd), dS.astype(cd), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    w = u * d                                        # (l, p)
+    dB_acc = dB_acc + jax.lax.dot_general(           # w^T-free NT: w @ dS
+        w.astype(cd), dS.astype(cd), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )                                                # (l, n)
-    du = du + dT[:, :, None] * dwt
-    dd = jnp.sum(ut * dwt, axis=2)                   # (hb, l)
-    ddd = dd * dT                                    # chain through exp
-    da = da - ddd.T
-    # += at the last row, as a mask-add (scatter has no Mosaic lowering)
-    last = (jax.lax.broadcasted_iota(jnp.int32, da.shape, 0) == l - 1)
-    da = da + jnp.where(last, jnp.sum(ddd, axis=1)[None, :], 0.0)
+    du = du + d * dw
+    dd = jnp.sum(u * dw, axis=1, keepdims=True)      # (l, 1)
+    ddd = dd * d                                     # chain through exp
+    da = da - ddd
+    # += at the last row, as a mask-add (scatter has no Mosaic lowering);
+    # the total over l comes from a ones-matmul (no sublane transpose)
+    total = jax.lax.dot_general(                     # (1, 1)
+        ones, ddd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    last = (jax.lax.broadcasted_iota(jnp.int32, (l, 1), 0) == l - 1)
+    da = da + jnp.where(last, total, 0.0)
 
     # --- u = dt * x product rule ------------------------------------------
-    du_l = jnp.transpose(du, (1, 0, 2))              # (l, hb, p)
-    dx_ref[0, 0, 0] = (dt[:, :, None] * du_l).reshape(l, -1).astype(dx_ref.dtype)
-    ddt_ref[0, 0, 0] = jnp.sum(x * du_l, axis=2)
+    dx_ref[0, 0, 0] = (dt * du).astype(dx_ref.dtype)
+    ddt_ref[0, 0, 0] = jnp.sum(x * du, axis=1, keepdims=True)
     da_ref[0, 0, 0] = da
     dB_ref[0, 0, 0] = dB_acc
     dC_ref[0, 0, 0] = dC_acc
@@ -382,16 +389,12 @@ def _ssd_pallas_bwd_impl(
     of the final state when the forward returned it; it seeds the reverse
     state scan the same way ``initial_state`` seeds the forward one.
     """
-    l0 = _divisor_chunk(x.shape[1], chunk_size)
-    xr, dtr, ar, chunk_decay, Br, Cr, dims = _chunked_inputs(
-        x, dt, A, B, C, chunk_size, max_hb=_bwd_hb_cap(l0)
-    )
-    b, nc, l, h, hb, p, g, n = dims
+    cells, chunk_decay, dims = _chunked_inputs(x, dt, A, B, C, chunk_size)
+    b, nc, l, h, p, g, n = dims
     t = nc * l
-    nhb = h // hb
-    grid = (b, nc, nhb)
-    xhp_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, hb, l, p, n, g)
-    dyr = _to_cells(dy, b, nc, l, nhb, hb, (p,))
+    grid = (b, nc, h)
+    xhp_spec, dt_spec, at_spec, bc_spec, st_spec = _cell_specs(h, l, p, n, g)
+    dyr = _to_cells(dy, b, nc, l, h, (p,))
 
     # recompute the chunk summaries + entering states (remat, like the
     # reference dep's Triton backward which re-derives chunk states)
@@ -399,11 +402,11 @@ def _ssd_pallas_bwd_impl(
         functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
         out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
         grid=grid,
-        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec],
+        in_specs=[xhp_spec, dt_spec, bc_spec],
         out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, ar, Br)
+    )(cells["x"], cells["w"], cells["B"])
     prev_states, _ = state_passing(states, chunk_decay, initial_state)
 
     # direct state gradient from each chunk's off-diagonal output
@@ -415,7 +418,7 @@ def _ssd_pallas_bwd_impl(
         out_specs=st_spec,
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(dyr, ar, Cr)
+    )(dyr, cells["e"], cells["C"])
 
     # reverse associative scan: gP_c = dP_c + gamma_c * gP_{c+1}.  A final-
     # state cotangent seeds it as a virtual chunk nc with dP = dfinal (its
@@ -448,15 +451,15 @@ def _ssd_pallas_bwd_impl(
     dx_c, ddt5, da5, dB_cell, dC_cell = pl.pallas_call(
         functools.partial(_ssd_bwd_cell_kernel, compute_dtype=compute_dtype),
         out_shape=(
-            jax.ShapeDtypeStruct((b, nc, nhb, l, hb * p), x.dtype),
-            jax.ShapeDtypeStruct((b, nc, nhb, l, hb), jnp.float32),
-            jax.ShapeDtypeStruct((b, nc, nhb, l, hb), jnp.float32),
-            jax.ShapeDtypeStruct((b, nc, nhb, l, n), jnp.float32),
-            jax.ShapeDtypeStruct((b, nc, nhb, l, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, h, l, p), x.dtype),
+            jax.ShapeDtypeStruct((b, nc, h, l, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, h, l, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, h, l, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, h, l, n), jnp.float32),
         ),
         grid=grid,
-        in_specs=[xhp_spec, dt_spec, dt_spec, bc_spec, bc_spec, st_spec,
-                  xhp_spec, st_spec],
+        in_specs=[xhp_spec, dt_spec, dt_spec, at_spec, dt_spec, dt_spec,
+                  bc_spec, bc_spec, st_spec, xhp_spec, st_spec],
         out_specs=(
             xhp_spec,
             dt_spec,
@@ -466,10 +469,11 @@ def _ssd_pallas_bwd_impl(
         ),
         compiler_params=_PARALLEL3,
         interpret=interpret,
-    )(xr, dtr, ar, Br, Cr, prev_states, dyr, dS)
+    )(cells["x"], cells["dt"], cells["a"], cells["at"], cells["e"],
+      cells["d"], cells["B"], cells["C"], prev_states, dyr, dS)
 
     # --- XLA epilogue: push `da` through the cumsum chain -----------------
-    def cells_to_blh(v):  # (b, nc, nhb, l, hb) -> (b, nc, l, h)
+    def cells_to_blh(v):  # (b, nc, h, l, 1) -> (b, nc, l, h)
         return jnp.moveaxis(v, 2, 3).reshape(b, nc, l, h)
 
     da = cells_to_blh(da5)
@@ -478,12 +482,12 @@ def _ssd_pallas_bwd_impl(
     ddA = jnp.flip(jnp.cumsum(jnp.flip(da, 2), axis=2), 2)       # (b, nc, l, h)
     Af = A.astype(jnp.float32)
     ddt = (ddt_dir + ddA * Af[None, None, None]).reshape(b, t, h)
-    dA = jnp.sum(ddA * cells_to_blh(dtr), axis=(0, 1, 2))
+    dA = jnp.sum(ddA * cells_to_blh(cells["dt"]), axis=(0, 1, 2))
 
-    # group-sum the per-head-block B/C gradients (blocks are head-ordered,
-    # so a group's nhb/g blocks are consecutive)
-    dB_g = dB_cell.reshape(b, nc, g, nhb // g, l, n).sum(axis=3)
-    dC_g = dC_cell.reshape(b, nc, g, nhb // g, l, n).sum(axis=3)
+    # group-sum the per-head B/C gradients (cells are head-ordered,
+    # so a group's h/g heads are consecutive)
+    dB_g = dB_cell.reshape(b, nc, g, h // g, l, n).sum(axis=3)
+    dC_g = dC_cell.reshape(b, nc, g, h // g, l, n).sum(axis=3)
     dB = jnp.transpose(dB_g, (0, 1, 3, 2, 4)).reshape(b, t, g, n)
     dC = jnp.transpose(dC_g, (0, 1, 3, 2, 4)).reshape(b, t, g, n)
 

@@ -27,15 +27,24 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def ulysses_attention(seq_ctx, q, k, v):
+def ulysses_attention(seq_ctx, q, k, v, impl: str = "xla"):
     """q (b, t, nh, hd), k/v (b, t, nkv, hd), t sharded over seq_ctx.axis.
 
     Returns (b, t, nh, hd) in q.dtype — exact match with single-device
-    causal attention (pinned by tests/test_seq_parallel.py).
+    causal attention (pinned by tests/test_seq_parallel.py).  ``impl``
+    picks the per-device SDPA backend: "xla" (blockwise scan) or
+    "pallas" (flash kernel) — after the first all-to-all every device
+    holds full-length sequences for its head slice, so the dense kernels
+    drop in unchanged.
     """
-    from mamba_distributed_tpu.ops.blockwise_attention import (
-        blockwise_sdpa_causal,
-    )
+    if impl == "pallas":
+        from mamba_distributed_tpu.ops.pallas.attention_kernels import (
+            flash_sdpa_causal as sdpa,
+        )
+    else:
+        from mamba_distributed_tpu.ops.blockwise_attention import (
+            blockwise_sdpa_causal as sdpa,
+        )
 
     ctx = seq_ctx
     n = ctx.size
@@ -59,7 +68,7 @@ def ulysses_attention(seq_ctx, q, k, v):
             jnp.stack([k_l, v_l]), ctx.axis, split_axis=3, concat_axis=2,
             tiled=True,
         )
-        out = blockwise_sdpa_causal(qh, kv[0], kv[1])
+        out = sdpa(qh, kv[0], kv[1])
         # head-sharded -> seq-sharded
         return jax.lax.all_to_all(
             out, ctx.axis, split_axis=1, concat_axis=2, tiled=True

@@ -43,6 +43,9 @@ def parse_args():
     p.add_argument("--mesh-tensor", type=int, default=None)
     p.add_argument("--ssm-impl", choices=["xla", "pallas"], default=None,
                    help="kernel backend for the SSM scan")
+    p.add_argument("--attn-impl", choices=["xla", "pallas"], default=None,
+                   help="SDPA backend for hybrid attention layers (pallas: "
+                        "flash kernel)")
     p.add_argument("--attn-sp-impl", choices=["ring", "ulysses"], default=None,
                    help="attention strategy under sequence parallelism "
                         "(ring: KV rotation; ulysses: all-to-all head "
@@ -114,6 +117,7 @@ def build_config(args):
         k: v for k, v in [
             ("ssm_impl", args.ssm_impl), ("remat_policy", args.remat_policy),
             ("attn_sp_impl", args.attn_sp_impl),
+            ("attn_impl", args.attn_impl),
         ] if v is not None
     }
     if model_over:
