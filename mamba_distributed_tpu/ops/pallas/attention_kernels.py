@@ -86,7 +86,9 @@ def _fa_fwd_kernel(
         mask = _causal_mask(qb, kb, qi * qb + offset, kj * kb, tk_valid)
         s = jnp.where(mask, s, _NEG_INF)
 
-        m_prev = m_scr[:, :1]                            # (qb, 1)
+        # lanes of the stat scratches hold replicated copies; a lane-max
+        # read avoids ref lane-slicing (no Mosaic sub-128 memref slices)
+        m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)   # (qb, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         # rows with every key masked so far keep m = -inf; guard both exps
         scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
@@ -101,7 +103,7 @@ def _fa_fwd_kernel(
 
     @pl.when(kj == nk - 1)
     def _():
-        den = den_scr[:, :1]                             # (qb, 1)
+        den = jnp.max(den_scr[...], axis=1, keepdims=True)    # (qb, 1)
         o_ref[0, 0] = (acc_scr[...] / jnp.maximum(den, 1e-30)).astype(
             o_ref.dtype
         )
@@ -109,8 +111,9 @@ def _fa_fwd_kernel(
         # offset < 0 uses) get +inf so the backward's exp(s - lse) is 0
         # there.  Padded query rows attend normally and get a finite lse —
         # their backward is harmless because their dO rows are zero.
+        m_fin = jnp.max(m_scr[...], axis=1, keepdims=True)
         lse = jnp.where(
-            den > 0.0, m_scr[:, :1] + jnp.log(jnp.maximum(den, 1e-30)),
+            den > 0.0, m_fin + jnp.log(jnp.maximum(den, 1e-30)),
             jnp.inf,
         )
         lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], 8))
@@ -140,12 +143,16 @@ def _fa_bwd_dq_kernel(
         ) * sm_scale
         mask = _causal_mask(qb, kb, qi * qb + offset, kj * kb, tk_valid)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, :1])            # (qb, kb)
+        # stat blocks carry lane-replicated values; lane-max reads avoid
+        # sub-128 vector lane slices (Mosaic-safe)
+        lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)   # (qb, 1)
+        p = jnp.exp(s - lse)                             # (qb, kb)
         dp = jax.lax.dot_general(                        # dO @ V^T
             do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - dlt_ref[0, 0][:, :1])
+        dlt = jnp.max(dlt_ref[0, 0], axis=1, keepdims=True)
+        ds = p * (dp - dlt)
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -182,7 +189,10 @@ def _fa_bwd_dkv_kernel(
         ) * sm_scale
         mask = _causal_mask(qb, kb, qi * qb + offset, kj * kb, tk_valid)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse_ref[0, 0][:, :1])            # (qb, kb)
+        # stat blocks carry lane-replicated values; lane-max reads avoid
+        # sub-128 vector lane slices (Mosaic-safe)
+        lse = jnp.max(lse_ref[0, 0], axis=1, keepdims=True)   # (qb, 1)
+        p = jnp.exp(s - lse)                             # (qb, kb)
         do = do_ref[0, 0]
         # dV += P^T @ dO   (contract the q/sublane dim of both)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
@@ -193,7 +203,8 @@ def _fa_bwd_dkv_kernel(
             do, v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - dlt_ref[0, 0][:, :1])
+        dlt = jnp.max(dlt_ref[0, 0], axis=1, keepdims=True)
+        ds = p * (dp - dlt)
         # dK += dS^T @ Q
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),

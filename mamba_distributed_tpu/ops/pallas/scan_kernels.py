@@ -41,9 +41,21 @@ from mamba_distributed_tpu.ops.pallas.common import resolve_interpret
 from mamba_distributed_tpu.ops.scan import _prep
 
 
-def _m1_step(h, At, dt_t, u_t, Bn):
-    """One recurrence step: h' = h * exp(A dt) + (dt u) B (all per-lane)."""
-    return h * jnp.exp(At * dt_t) + (dt_t * u_t) * Bn
+_OUTER = (((0,), (0,)), ((), ()))    # (1, n) x (1, d) -> (n, d)
+_MATVEC = (((1,), (0,)), ((), ()))   # (1, n) x (n, d) -> (1, d)
+_LANES = (((1,), (1,)), ((), ()))    # (1, d) x (n, d) -> (1, n)
+
+
+def _m1_step(h, At, dt_t, u_t, B_row):
+    """One recurrence step: h' = h * exp(A dt) + outer(B, dt u).
+
+    ``B_row`` is (1, n); the outer product runs as a singleton-contracted
+    dot_general — Mosaic supports no (1, n) -> (n, 1) shape cast, so
+    row-vector B/C never get transposed in-kernel (hardware lesson, r4).
+    """
+    return h * jnp.exp(At * dt_t) + jax.lax.dot_general(
+        B_row, dt_t * u_t, _OUTER, preferred_element_type=jnp.float32,
+    )
 
 
 def _m1_scan_kernel(
@@ -68,10 +80,12 @@ def _m1_scan_kernel(
     def body(i, h):
         dt_t = dt_ref[0, pl.ds(i, 1)]              # (1, dblk)
         u_t = u_ref[0, pl.ds(i, 1)]                # (1, dblk)
-        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)  # (n, 1)
-        Cn = C_ref[0, pl.ds(i, 1)].reshape(-1, 1)  # (n, 1)
-        h = _m1_step(h, At, dt_t, u_t, Bn)
-        y_ref[0, pl.ds(i, 1)] = jnp.sum(h * Cn, axis=0, keepdims=True)
+        B_row = B_ref[0, pl.ds(i, 1)]              # (1, n)
+        C_row = C_ref[0, pl.ds(i, 1)]              # (1, n)
+        h = _m1_step(h, At, dt_t, u_t, B_row)
+        y_ref[0, pl.ds(i, 1)] = jax.lax.dot_general(
+            C_row, h, _MATVEC, preferred_element_type=jnp.float32,
+        )
         return h
 
     h_scratch[...] = jax.lax.fori_loop(0, tb, body, h_scratch[...])
@@ -175,8 +189,8 @@ def _m1_entry_states_kernel(
     def body(i, h):
         dt_t = dt_ref[0, pl.ds(i, 1)]
         u_t = u_ref[0, pl.ds(i, 1)]
-        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)
-        return _m1_step(h, At, dt_t, u_t, Bn)
+        B_row = B_ref[0, pl.ds(i, 1)]              # (1, n)
+        return _m1_step(h, At, dt_t, u_t, B_row)
 
     h_scratch[...] = jax.lax.fori_loop(0, tb, body, h_scratch[...])
 
@@ -210,31 +224,45 @@ def _m1_bwd_kernel(
         hbuf[pl.ds(i, 1)] = h[None]
         dt_t = dt_ref[0, pl.ds(i, 1)]
         u_t = u_ref[0, pl.ds(i, 1)]
-        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)
-        return _m1_step(h, At, dt_t, u_t, Bn)
+        B_row = B_ref[0, pl.ds(i, 1)]
+        return _m1_step(h, At, dt_t, u_t, B_row)
 
     jax.lax.fori_loop(0, tb, fwd_body, hin_ref[0, 0])
 
-    # reverse sweep
+    ones_n = jnp.ones((1, At.shape[0]), jnp.float32)
+
+    # reverse sweep (row-vector forms throughout: outer products and
+    # sublane contractions via dot_general, never a (1, n) -> (n, 1) cast)
     def rev_body(k, carry):
         gh, dA = carry
         i = tb - 1 - k
         dt_t = dt_ref[0, pl.ds(i, 1)]              # (1, dblk)
         u_t = u_ref[0, pl.ds(i, 1)]
         dy_t = dy_ref[0, pl.ds(i, 1)]
-        Bn = B_ref[0, pl.ds(i, 1)].reshape(-1, 1)  # (n, 1)
-        Cn = C_ref[0, pl.ds(i, 1)].reshape(-1, 1)
+        B_row = B_ref[0, pl.ds(i, 1)]              # (1, n)
+        C_row = C_ref[0, pl.ds(i, 1)]
         hprev = hbuf[i]                            # (n, dblk)
 
         e_t = jnp.exp(At * dt_t)
-        gh = gh + Cn * dy_t
-        hcur = _m1_step(hprev, At, dt_t, u_t, Bn)
-        dC_ref[0, 0, pl.ds(i, 1)] = jnp.sum(hcur * dy_t, axis=1)[None]
-        dB_ref[0, 0, pl.ds(i, 1)] = jnp.sum(gh * (dt_t * u_t), axis=1)[None]
-        ddt_ref[0, pl.ds(i, 1)] = jnp.sum(
-            gh * (hprev * At * e_t + u_t * Bn), axis=0, keepdims=True
+        gh = gh + jax.lax.dot_general(             # += outer(C, dy)
+            C_row, dy_t, _OUTER, preferred_element_type=jnp.float32,
         )
-        du_ref[0, pl.ds(i, 1)] = dt_t * jnp.sum(gh * Bn, axis=0, keepdims=True)
+        hcur = _m1_step(hprev, At, dt_t, u_t, B_row)
+        dC_ref[0, 0, pl.ds(i, 1)] = jax.lax.dot_general(
+            dy_t, hcur, _LANES, preferred_element_type=jnp.float32,
+        )                                          # (1, n)
+        dB_ref[0, 0, pl.ds(i, 1)] = jax.lax.dot_general(
+            dt_t * u_t, gh, _LANES, preferred_element_type=jnp.float32,
+        )
+        term = hprev * At * e_t + jax.lax.dot_general(
+            B_row, u_t, _OUTER, preferred_element_type=jnp.float32,
+        )
+        ddt_ref[0, pl.ds(i, 1)] = jax.lax.dot_general(
+            ones_n, gh * term, _MATVEC, preferred_element_type=jnp.float32,
+        )                                          # (1, dblk) sublane sum
+        du_ref[0, pl.ds(i, 1)] = dt_t * jax.lax.dot_general(
+            B_row, gh, _MATVEC, preferred_element_type=jnp.float32,
+        )
         ghe = gh * e_t
         dA = dA + ghe * hprev * dt_t
         return ghe, dA
