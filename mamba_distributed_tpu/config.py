@@ -76,11 +76,10 @@ class ModelConfig:
     attn_sp_impl: str = "ring"
     # SDPA backend for full-sequence attention: "xla" (blockwise online-
     # softmax scan, ops/blockwise_attention.py) or "pallas" (flash kernel,
-    # ops/pallas/attention_kernels.py — skips fully-masked blocks).  Decode
-    # steps always use the tiny-t XLA path, and ring sequence parallelism
-    # always uses the XLA block update inside its hops (traced per-hop
-    # offsets); TrainConfig rejects the silent pallas+ring combination —
-    # use attn_sp_impl="ulysses" to get the flash kernel under SP.
+    # ops/pallas/attention_kernels.py — skips fully-masked blocks).  Under
+    # SP, ulysses runs flash after its head all-to-all and ring runs the
+    # flash pair kernels per hop (fully-future hops skipped outright).
+    # Decode steps always use the tiny-t XLA path.
     attn_impl: str = "xla"
 
     # --- precision policy (reference: bf16 autocast + fp32 master weights,
@@ -313,20 +312,6 @@ class TrainConfig:
             raise ValueError(
                 f"n_layer={self.model.n_layer} must divide over "
                 f"mesh.pipe={m.pipe} stages"
-            )
-        if (
-            m.seq > 1
-            and self.model.attn_layer_idx
-            and self.model.attn_impl == "pallas"
-            and self.model.attn_sp_impl == "ring"
-        ):
-            # ring hops run the XLA block update (traced per-hop offsets);
-            # accepting this combination would silently drop the flash
-            # kernel the user asked for
-            raise ValueError(
-                "attn_impl='pallas' does not apply inside ring attention "
-                "hops; with mesh.seq > 1 use attn_sp_impl='ulysses' (flash "
-                "runs after the head all-to-all) or attn_impl='xla'"
             )
 
     @property

@@ -142,7 +142,7 @@ def attention_mixer(
                 ring_attention,
             )
 
-            out = ring_attention(seq_ctx, q, k, v)
+            out = ring_attention(seq_ctx, q, k, v, impl=cfg.attn_impl)
     elif cfg.attn_impl == "pallas":
         from mamba_distributed_tpu.ops.pallas.attention_kernels import (
             flash_sdpa_causal,

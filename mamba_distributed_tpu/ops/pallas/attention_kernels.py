@@ -258,20 +258,15 @@ def _fa_fwd_impl(qt, kt, vt, offset, tk_valid, qb, kb, interpret):
     return o, lse
 
 
-def _fa_bwd_impl(qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret):
+def _fa_bwd_dq_call(qt, kt, vt, do, lse, dlt, offset, tk_valid, qb, kb,
+                    interpret):
+    """Pair-level dq (b, nh, tq, hd) fp32 given row lse/delta in the
+    lane-degenerate (..., 8) layout.  Reused per ring-attention hop."""
     b, nh, tq, hd = qt.shape
     nkv, tk = kt.shape[1], kt.shape[2]
     rep = nh // nkv
     nq, nk = tq // qb, tk // kb
     sm_scale = 1.0 / math.sqrt(hd)
-
-    # D_i = rowsum(dO ⊙ O), emitted in the same lane-degenerate layout as
-    # lse (elementwise + lane reduction: XLA fuses it)
-    dlt = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-        keepdims=True,
-    )
-    dlt = jnp.broadcast_to(dlt, (b, nh, tq, 8))
 
     q_spec = pl.BlockSpec((1, 1, qb, hd), lambda bi, hi, qi, kj: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
@@ -282,7 +277,7 @@ def _fa_bwd_impl(qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret):
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
     )
 
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _fa_bwd_dq_kernel, nk=nk, sm_scale=sm_scale, offset=offset,
             tk_valid=tk_valid,
@@ -296,7 +291,20 @@ def _fa_bwd_impl(qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret):
         interpret=interpret,
     )(qt, kt, vt, do, lse, dlt)
 
-    # dk/dv: grid loops kv blocks in the third slot, q blocks sequential
+
+def _fa_bwd_dkv_call(qt, kt, vt, do, lse, dlt, offset, tk_valid, qb, kb,
+                     interpret):
+    """Pair-level (dk, dv) (b, nkv, tk, hd) fp32, GQA group-summed."""
+    b, nh, tq, hd = qt.shape
+    nkv, tk = kt.shape[1], kt.shape[2]
+    rep = nh // nkv
+    nq, nk = tq // qb, tk // kb
+    sm_scale = 1.0 / math.sqrt(hd)
+    seq_kv = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+    )
+
+    # grid loops kv blocks in the third slot, q blocks sequential
     rq_spec = pl.BlockSpec((1, 1, qb, hd), lambda bi, hi, kj, qi: (bi, hi, qi, 0))
     rkv_spec = pl.BlockSpec(
         (1, 1, kb, hd), lambda bi, hi, kj, qi: (bi, hi // rep, kj, 0)
@@ -326,7 +334,94 @@ def _fa_bwd_impl(qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret):
     # GQA group-sum of the per-q-head partials (rep == 1 is a no-op reshape)
     dk = jnp.sum(dk_part.reshape(b, nkv, rep, tk, hd), axis=2)
     dv = jnp.sum(dv_part.reshape(b, nkv, rep, tk, hd), axis=2)
+    return dk, dv
+
+
+def lane8(x):
+    """(..., t) row statistic -> the kernels' lane-degenerate (..., t, 8)."""
+    return jnp.broadcast_to(x[..., None], (*x.shape, 8))
+
+
+def _fa_bwd_impl(qt, kt, vt, o, lse, do, offset, tk_valid, qb, kb, interpret):
+    # D_i = rowsum(dO ⊙ O), emitted in the same lane-degenerate layout as
+    # lse (elementwise + lane reduction: XLA fuses it)
+    dlt = lane8(jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+    ))
+    dq = _fa_bwd_dq_call(qt, kt, vt, do, lse, dlt, offset, tk_valid, qb, kb,
+                         interpret)
+    dk, dv = _fa_bwd_dkv_call(qt, kt, vt, do, lse, dlt, offset, tk_valid,
+                              qb, kb, interpret)
     return dq, dk, dv
+
+
+def flash_pair_fwd(qt, kt, vt, offset, qb=256, kb=256, interpret=None):
+    """Raw pair forward: (o (b, nh, tq, hd), lse (b, nh, tq) fp32).
+
+    Head-major layouts, NOT differentiable on its own — ring attention
+    (parallel/ring_attention.py) composes these pair calls under its own
+    custom_vjp, merging per-hop (o, lse) partials and reusing
+    ``flash_pair_dq``/``flash_pair_dkv`` with the GLOBAL lse in the
+    backward (the flash decomposition is exact per (q, kv) pair given
+    the merged lse and delta).  ``offset`` must be static: ring hops are
+    fully-past (offset = tq), diagonal (0), or skipped.
+    """
+    interpret = resolve_interpret(interpret)
+    tq, tk = qt.shape[2], kt.shape[2]
+    qb = _pick_block(tq, qb)
+    kb = _pick_block(tk, kb)
+    pad_q, pad_k = -tq % qb, -tk % kb
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    o, lse8 = _fa_fwd_impl(qt, kt, vt, int(offset), tk, qb, kb, interpret)
+    return o[:, :, :tq], lse8[:, :, :tq, 0]
+
+
+def flash_pair_dq(qt, kt, vt, do, lse, dlt, offset, qb=256, kb=256,
+                  interpret=None):
+    """Raw pair dq (fp32) from the GLOBAL row lse / delta (b, nh, tq)."""
+    interpret = resolve_interpret(interpret)
+    tq, tk = qt.shape[2], kt.shape[2]
+    qb = _pick_block(tq, qb)
+    kb = _pick_block(tk, kb)
+    pad_q, pad_k = -tq % qb, -tk % kb
+    pads = ((0, 0), (0, 0), (0, pad_q), (0, 0))
+    if pad_q:
+        qt, do = jnp.pad(qt, pads), jnp.pad(do, pads)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)),
+                      constant_values=jnp.inf)
+        dlt = jnp.pad(dlt, ((0, 0), (0, 0), (0, pad_q)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    dq = _fa_bwd_dq_call(qt, kt, vt, do, lane8(lse), lane8(dlt),
+                         int(offset), tk, qb, kb, interpret)
+    return dq[:, :, :tq]
+
+
+def flash_pair_dkv(qt, kt, vt, do, lse, dlt, offset, qb=256, kb=256,
+                   interpret=None):
+    """Raw pair (dk, dv) (fp32, GQA group-summed) from GLOBAL lse/delta."""
+    interpret = resolve_interpret(interpret)
+    tq, tk = qt.shape[2], kt.shape[2]
+    qb = _pick_block(tq, qb)
+    kb = _pick_block(tk, kb)
+    pad_q, pad_k = -tq % qb, -tk % kb
+    pads = ((0, 0), (0, 0), (0, pad_q), (0, 0))
+    if pad_q:
+        qt, do = jnp.pad(qt, pads), jnp.pad(do, pads)
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)),
+                      constant_values=jnp.inf)
+        dlt = jnp.pad(dlt, ((0, 0), (0, 0), (0, pad_q)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    dk, dv = _fa_bwd_dkv_call(qt, kt, vt, do, lane8(lse), lane8(dlt),
+                              int(offset), tk, qb, kb, interpret)
+    return dk[:, :, :tk], dv[:, :, :tk]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -519,6 +519,49 @@ def test_seq_sharded_train_step_tpu_lowering(monkeypatch, tmp_path):
     assert "tpu" in [p.lower() for p in exported.platforms]
 
 
+def test_hybrid_ring_flash_train_step_tpu_lowering(monkeypatch, tmp_path):
+    """Seq-sharded HYBRID train step with attn_impl='pallas': shard_map +
+    lax.switch over the flash pair kernels + the ring custom_vjp (dk/dv
+    riding the ring) all compose in one TPU-exported program."""
+    monkeypatch.setenv("MDT_PALLAS_INTERPRET", "0")
+    from mamba_distributed_tpu.config import (
+        DataConfig,
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from mamba_distributed_tpu.training import Trainer
+
+    model = ModelConfig(
+        d_model=64, n_layer=2, vocab_size=256, ssm_layer="mamba2",
+        headdim=16, chunk_size=16, d_state=32, attn_layer_idx=(1,),
+        attn_num_heads=4, attn_num_kv_heads=2, attn_impl="pallas",
+    )
+    B, T, accum = 2, 64, 2
+    cfg = TrainConfig(
+        model=model,
+        mesh=MeshConfig(seq=4),
+        data=DataConfig(
+            data_dir=str(tmp_path / "data"),
+            synthetic_tokens_per_shard=B * T * accum * 8,
+            synthetic_num_shards=1,
+        ),
+        micro_batch_size=B,
+        seq_len=T,
+        total_batch_size=B * T * accum,
+        log_dir=str(tmp_path / "log"),
+        warmup_steps=2,
+        max_steps=4,
+        val_every=1000,
+    )
+    trainer = Trainer(cfg, verbose=False)
+    x, y = trainer._global_batch(cfg.grad_accum_steps, trainer.train_loader)
+    exported = jax.export.export(trainer.train_step, platforms=["tpu"])(
+        trainer.params, trainer.opt_state, x, y
+    )
+    assert "tpu" in [p.lower() for p in exported.platforms]
+
+
 @pytest.mark.parametrize("layer,kw", [
     ("mamba2", dict(headdim=16, chunk_size=32, d_state=32)),
     ("mamba1", dict(d_state=8)),
