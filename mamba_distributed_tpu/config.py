@@ -283,14 +283,20 @@ class TrainConfig:
 
     def __post_init__(self):
         m = self.mesh
-        if m.pipe > 1 and (m.data * m.fsdp * m.seq * m.tensor) > 1:
-            # the GPipe schedule declares activations replicated over every
-            # non-pipe axis, so composing would silently all-gather the
-            # batch/params instead of parallelizing — reject loudly
+        if m.pipe > 1 and (m.seq * m.tensor) > 1:
+            # the GPipe schedule composes with the pure-DP batch axes
+            # (data/fsdp: each replica runs the schedule on its batch
+            # slice) but not with seq/tensor, whose shardings cut through
+            # the activations the schedule declares stage-local
             raise ValueError(
-                f"mesh.pipe={m.pipe} cannot yet combine with other mesh "
-                f"axes (data={m.data}, fsdp={m.fsdp}, seq={m.seq}, "
-                f"tensor={m.tensor}); use pipe alone or pipe=1"
+                f"mesh.pipe={m.pipe} composes with data/fsdp only; got "
+                f"seq={m.seq}, tensor={m.tensor}"
+            )
+        if m.pipe > 1 and self.shard_params:
+            raise ValueError(
+                "mesh.pipe > 1 keeps params replicated across data/fsdp "
+                "(stage-sharded over pipe); shard_params=True is not "
+                "supported with pipeline parallelism"
             )
         if m.pipe > 1 and self.model.attn_layer_idx:
             # a PERIODIC hybrid pipelines by supersteps (one attn layer per

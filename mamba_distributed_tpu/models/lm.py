@@ -318,6 +318,7 @@ def lm_loss_pipelined(
     targets: jax.Array,
     mesh,
     axis: str = "pipe",
+    batch_axes=None,
 ) -> jax.Array:
     """``lm_loss`` averaged over grad-accum microbatches, with the layer
     stack pipelined over the mesh's ``axis`` (GPipe).
@@ -373,7 +374,8 @@ def lm_loss_pipelined(
     if cfg.remat:
         body = _remat(body, cfg)
     hidden, residual = pipelined_layers(
-        body, stacked, (hidden, residual), mesh, axis=axis
+        body, stacked, (hidden, residual), mesh, axis=axis,
+        batch_axes=batch_axes,
     )
     lf = _final_logits(params, cfg, hidden, residual)
     lse = jax.nn.logsumexp(lf, axis=-1)

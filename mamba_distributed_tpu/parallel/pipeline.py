@@ -39,6 +39,7 @@ def pipelined_layers(
     xs,
     mesh: Mesh,
     axis: str = "stage",
+    batch_axes=None,
 ):
     """Run ``scan(body_fn)`` over layer-stacked params, pipelined over
     ``axis``.
@@ -50,8 +51,15 @@ def pipelined_layers(
       stacked_params: pytree whose leaves carry a leading ``n_layer``
         axis; n_layer % n_stages must be 0 (sharded over ``axis``).
       xs: activation pytree whose leaves carry a leading (n_micro, ...)
-        microbatch axis (replicated over the mesh).
+        microbatch axis.
       mesh: mesh containing ``axis``.
+      batch_axes: optional mesh axis name(s) the activations' dim 1 (the
+        batch dim under the microbatch axis) is sharded over — this is
+        how pipeline parallelism composes with data parallelism: each
+        data replica runs the same GPipe schedule on its batch slice,
+        and params stay replicated across ``batch_axes`` (their gradient
+        psum over the data axes happens in the surrounding GSPMD
+        program / shard_map transpose).  None = replicated activations.
 
     Returns the output pytree with the same (n_micro, ...) leading axis —
     identical to an unpipelined ``lax.scan`` of ``body_fn`` over all
@@ -116,11 +124,16 @@ def pipelined_layers(
         return outs
 
     # params shard their leading layer axis over the stage axis; activations
-    # are replicated on it
+    # are replicated on it (and batch-sharded over batch_axes if given)
     param_specs = jax.tree.map(
         lambda p: P(axis, *(None,) * (jnp.ndim(p) - 1)), stacked_params
     )
-    xs_specs = jax.tree.map(lambda x: P(*(None,) * jnp.ndim(x)), xs)
+    if batch_axes is None:
+        xs_specs = jax.tree.map(lambda x: P(*(None,) * jnp.ndim(x)), xs)
+    else:
+        xs_specs = jax.tree.map(
+            lambda x: P(None, batch_axes, *(None,) * (jnp.ndim(x) - 2)), xs
+        )
     fn = jax.shard_map(
         local,
         mesh=mesh,

@@ -48,9 +48,14 @@ def make_train_step(
         accum = x.shape[0]
         if pipe > 1:
             # GPipe: the accum microbatches stream through the pipeline
-            # in ONE differentiable schedule — no lax.scan accumulation
+            # in ONE differentiable schedule — no lax.scan accumulation.
+            # Composes with data parallelism: each (data, fsdp) replica
+            # runs the schedule on its batch slice
+            dp_axes = ("data", "fsdp") if cfg.data_parallel_size > 1 else None
             loss, grads = jax.value_and_grad(
-                lambda p, x, y: lm_loss_pipelined(p, model_cfg, x, y, mesh)
+                lambda p, x, y: lm_loss_pipelined(
+                    p, model_cfg, x, y, mesh, batch_axes=dp_axes
+                )
             )(params, x, y)
         elif accum == 1:
             loss, grads = jax.value_and_grad(loss_fn)(params, x[0], y[0])

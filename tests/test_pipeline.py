@@ -206,3 +206,21 @@ def test_trainer_pipeline_matches_single_device(tmp_path):
     # block params are genuinely stage-sharded
     spec = tr.params["blocks"]["mixer"]["in_proj"]["kernel"].sharding.spec
     assert spec and spec[0] == "pipe", spec
+
+
+@pytest.mark.slow
+def test_trainer_pipeline_x_data_matches_single_device(tmp_path):
+    """mesh (data=2, pipe=2): each data replica streams its batch slice
+    through the GPipe schedule; grads psum over data — losses match the
+    single-device run (pipeline x data-parallel composition)."""
+    from mamba_distributed_tpu.config import MeshConfig
+    from tests.test_parallel import losses_of
+
+    over = dict(n_layer=4)
+    ref, _ = losses_of(tmp_path / "a", steps=3, micro=4, accum=4,
+                       model_over=over)
+    pp, tr = losses_of(tmp_path / "b", steps=3, micro=2, accum=4,
+                       mesh=MeshConfig(data=2, pipe=2), model_over=over)
+    np.testing.assert_allclose(ref, pp, rtol=2e-4)
+    spec = tr.params["blocks"]["mixer"]["in_proj"]["kernel"].sharding.spec
+    assert spec and spec[0] == "pipe", spec
