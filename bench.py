@@ -93,7 +93,9 @@ def build_step(spec: dict):
     preset = spec.get("preset", DEFAULT_PRESET)
     cfg = get_preset(preset, micro_batch_size=B, seq_len=T, total_batch_size=B * T)
     model_over = {
-        k: spec[k] for k in ("ssm_impl", "remat", "remat_policy") if k in spec
+        k: spec[k]
+        for k in ("ssm_impl", "attn_impl", "remat", "remat_policy")
+        if k in spec
     }
     if model_over:
         cfg = dataclasses.replace(
@@ -136,7 +138,8 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     """
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
-    known = {"preset", "B", "T", "ssm_impl", "remat", "remat_policy"}
+    known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
+             "remat_policy"}
     unknown = set(spec) - known
     if unknown:
         raise KeyError(

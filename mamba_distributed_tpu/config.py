@@ -369,6 +369,14 @@ PRESETS: dict[str, TrainConfig] = {
         dict(d_model=768, n_layer=64, ssm_layer="mamba1"),
         dict(),
     ),
+    # single-chip hybrid (config-5 architecture at 280M scale): attention
+    # every 8th layer, GQA 12q/4kv — the shape the attn_impl sweep benches
+    "hybrid-280m": _mk(
+        dict(d_model=768, n_layer=64, ssm_layer="mamba2",
+             attn_layer_idx=tuple(range(3, 64, 8)), attn_num_heads=12,
+             attn_num_kv_heads=4),
+        dict(),
+    ),
     # 2. 280M data-parallel over 8 chips (DDP -> pjit drop-in)
     "mamba2-280m-dp8": _mk(
         dict(d_model=768, n_layer=64, ssm_layer="mamba2"),

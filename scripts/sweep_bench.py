@@ -30,6 +30,12 @@ DEFAULT_CONFIGS = [
     {"B": 16, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
     {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
     {"B": 32, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
+    # hybrid (config-5 architecture, single-chip scale): does the flash
+    # kernel beat the blockwise XLA scan on real hardware?
+    {"preset": "hybrid-280m", "B": 8, "attn_impl": "xla"},
+    {"preset": "hybrid-280m", "B": 8, "attn_impl": "pallas"},
+    {"preset": "hybrid-280m", "B": 8, "attn_impl": "pallas",
+     "ssm_impl": "pallas"},
 ]
 
 
@@ -47,7 +53,10 @@ def main() -> None:
         r = time_config(spec, iters=iters)
         results.append(r)
         print(json.dumps(r), flush=True)
-    ok = [r for r in results if "tok_per_sec" in r]
+    # "best" picks bench.py's shipped defaults, so only rows of the
+    # default (headline) preset compete — hybrid rows are informational
+    ok = [r for r in results
+          if "tok_per_sec" in r and "preset" not in r]
     if ok:
         best = max(ok, key=lambda r: r["tok_per_sec"])
         print(json.dumps({"best": best}), flush=True)
