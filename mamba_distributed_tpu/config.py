@@ -33,6 +33,16 @@ class ModelConfig:
     ssm_layer: str = "mamba2"
     # 0 => no MLP between mixers (pure mixer stack, the reference default).
     d_intermediate: int = 0
+    # --- MoE (beyond the reference; completes the parallelism menu with
+    # expert parallelism over mesh.expert) ---
+    # 0 => dense gated MLP; > 1 => the MLP becomes a token-choice top-k
+    # mixture of experts (GShard-style dense-dispatch einsums: static
+    # shapes, MXU-friendly; experts shard over the mesh's expert axis)
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # weight of the Switch/GShard load-balance aux loss added by lm_loss
+    moe_aux_weight: float = 0.01
     rms_norm: bool = True
     residual_in_fp32: bool = True
     tie_embeddings: bool = True
@@ -125,6 +135,19 @@ class ModelConfig:
             raise ValueError(
                 f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}"
             )
+        if self.moe_num_experts:
+            if self.moe_num_experts < 2:
+                raise ValueError("moe_num_experts must be 0 (dense) or >= 2")
+            if self.d_intermediate <= 0:
+                raise ValueError(
+                    "MoE replaces the gated MLP: moe_num_experts > 0 needs "
+                    "d_intermediate > 0"
+                )
+            if not 1 <= self.moe_top_k <= self.moe_num_experts:
+                raise ValueError(
+                    f"moe_top_k={self.moe_top_k} must be in "
+                    f"[1, {self.moe_num_experts}]"
+                )
 
     @property
     def vocab_size_padded(self) -> int:
@@ -199,7 +222,12 @@ class ModelConfig:
                 n += di * d  # out_proj
             if self.d_intermediate > 0:
                 n += d  # second norm
-                n += d * self.d_intermediate * 2 + self.d_intermediate * d  # gated MLP
+                mlp = d * self.d_intermediate * 2 + self.d_intermediate * d
+                if self.moe_num_experts:
+                    n += d * self.moe_num_experts  # router
+                    n += self.moe_num_experts * mlp  # expert-stacked MLPs
+                else:
+                    n += mlp  # gated MLP
         n += d  # final norm
         return n
 
@@ -214,6 +242,10 @@ class MeshConfig:
     tensor- tensor parallelism over d_inner/heads
     pipe  - GPipe pipeline stages over the layer stack (the grad-accum
             microbatches feed the pipeline; parallel/pipeline.py)
+    expert- expert parallelism: MoE expert-stacked MLP weights shard
+            their expert axis here; tokens are batch-sharded over it too
+            (an extra pure-DP axis for the non-MoE layers), so the MoE
+            dispatch/combine einsums become GSPMD all-to-alls
     """
 
     data: int = 1
@@ -221,18 +253,21 @@ class MeshConfig:
     seq: int = 1
     tensor: int = 1
     pipe: int = 1
+    expert: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.seq * self.tensor * self.pipe
+        return (self.data * self.fsdp * self.seq * self.tensor * self.pipe
+                * self.expert)
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("data", "fsdp", "seq", "tensor", "pipe")
+        return ("data", "fsdp", "seq", "tensor", "pipe", "expert")
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.seq, self.tensor, self.pipe)
+        return (self.data, self.fsdp, self.seq, self.tensor, self.pipe,
+                self.expert)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -283,15 +318,31 @@ class TrainConfig:
 
     def __post_init__(self):
         m = self.mesh
-        if m.pipe > 1 and (m.seq * m.tensor) > 1:
+        if m.pipe > 1 and (m.seq * m.tensor * m.expert) > 1:
             # the GPipe schedule composes with the pure-DP batch axes
             # (data/fsdp: each replica runs the schedule on its batch
-            # slice) but not with seq/tensor, whose shardings cut through
-            # the activations the schedule declares stage-local
+            # slice) but not with seq/tensor/expert, whose shardings cut
+            # through the activations the schedule declares stage-local
             raise ValueError(
                 f"mesh.pipe={m.pipe} composes with data/fsdp only; got "
-                f"seq={m.seq}, tensor={m.tensor}"
+                f"seq={m.seq}, tensor={m.tensor}, expert={m.expert}"
             )
+        if m.pipe > 1 and self.model.moe_num_experts:
+            raise ValueError(
+                "MoE models do not pipeline yet (the aux-loss carry is "
+                "not threaded through the GPipe schedule); use pipe=1"
+            )
+        if m.expert > 1:
+            if not self.model.moe_num_experts:
+                raise ValueError(
+                    f"mesh.expert={m.expert} needs a MoE model "
+                    "(moe_num_experts > 0)"
+                )
+            if self.model.moe_num_experts % m.expert:
+                raise ValueError(
+                    f"moe_num_experts={self.model.moe_num_experts} must "
+                    f"divide over mesh.expert={m.expert}"
+                )
         if m.pipe > 1 and self.shard_params:
             raise ValueError(
                 "mesh.pipe > 1 keeps params replicated across data/fsdp "
@@ -330,7 +381,8 @@ class TrainConfig:
 
     @property
     def data_parallel_size(self) -> int:
-        return self.mesh.data * self.mesh.fsdp
+        # expert is an extra pure-DP batch axis for the non-MoE layers
+        return self.mesh.data * self.mesh.fsdp * self.mesh.expert
 
 
 def _mk(model: Mapping[str, Any], train: Mapping[str, Any]) -> TrainConfig:

@@ -67,19 +67,40 @@ def _init_block(key: jax.Array, cfg: ModelConfig, attn: bool) -> dict:
         "mixer": init_attention_params(k_mix, cfg) if attn else _init_mixer(k_mix, cfg),
     }
     if cfg.d_intermediate > 0:
-        k1, k2 = jax.random.split(k_mlp)
-        p["norm2"] = {"weight": jnp.ones((cfg.d_model,), jnp.float32)}
-        p["mlp"] = {
-            "fc1": init_linear(k1, cfg.d_model, 2 * cfg.d_intermediate, False),
-            "fc2": init_linear(k2, cfg.d_intermediate, cfg.d_model, False),
-        }
-        # fc2 is the second residual projection; depth-rescale like out_proj
-        if cfg.rescale_prenorm_residual:
-            import math
+        import math
 
-            p["mlp"]["fc2"]["kernel"] = p["mlp"]["fc2"]["kernel"] / math.sqrt(
-                2 * cfg.n_layer
-            )
+        rescale = (
+            1.0 / math.sqrt(2 * cfg.n_layer)
+            if cfg.rescale_prenorm_residual else 1.0
+        )
+        p["norm2"] = {"weight": jnp.ones((cfg.d_model,), jnp.float32)}
+        if cfg.moe_num_experts:
+            E = cfg.moe_num_experts
+            k_r, k_e = jax.random.split(k_mlp)
+
+            def one_expert(k):
+                k1, k2 = jax.random.split(k)
+                return (
+                    init_linear(k1, cfg.d_model, 2 * cfg.d_intermediate,
+                                False)["kernel"],
+                    init_linear(k2, cfg.d_intermediate, cfg.d_model,
+                                False)["kernel"] * rescale,
+                )
+
+            w1, w2 = jax.vmap(one_expert)(jax.random.split(k_e, E))
+            p["moe"] = {
+                "router": init_linear(k_r, cfg.d_model, E, False),
+                "w1": w1,  # (E, d, 2*di)
+                "w2": w2,  # (E, di, d)
+            }
+        else:
+            k1, k2 = jax.random.split(k_mlp)
+            p["mlp"] = {
+                "fc1": init_linear(k1, cfg.d_model, 2 * cfg.d_intermediate, False),
+                "fc2": init_linear(k2, cfg.d_intermediate, cfg.d_model, False),
+            }
+            # fc2 is the second residual projection; depth-rescale like out_proj
+            p["mlp"]["fc2"]["kernel"] = p["mlp"]["fc2"]["kernel"] * rescale
     return p
 
 
@@ -90,12 +111,74 @@ def _gated_mlp(params: dict, x: jax.Array, compute_dtype) -> jax.Array:
     return linear(params["fc2"], y * jax.nn.silu(gate.astype(jnp.float32)).astype(y.dtype), compute_dtype)
 
 
+def _moe_mlp(params: dict, cfg: ModelConfig, x: jax.Array, compute_dtype):
+    """Token-choice top-k mixture of gated-MLP experts -> (out, aux).
+
+    GShard/Switch-style dense-dispatch formulation, TPU-first: routing,
+    capacity assignment, dispatch and combine are all static-shape
+    einsums (no gather/scatter, no dynamic shapes), so the MXU runs the
+    expert matmuls and GSPMD turns the dispatch/combine contractions
+    into all-to-alls when experts are sharded over ``mesh.expert``.
+    Tokens over an expert's capacity are dropped (combine weight 0 —
+    the residual connection carries them).  ``aux`` is the Switch
+    load-balance loss E * sum_e f_e * P_e (== 1 at perfect balance),
+    averaged into lm_loss with weight cfg.moe_aux_weight.
+    """
+    E, k = cfg.moe_num_experts, cfg.moe_top_k
+    b, t, d = x.shape
+    n = b * t
+    cap = max(1, -(-int(cfg.moe_capacity_factor * k * n) // E))
+
+    xt = x.reshape(n, d)
+    logits = linear(params["router"], xt, jnp.float32)           # (n, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)                # (n, k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # position of each (choice, token) in its expert's queue — primary
+    # choices of every token get capacity before any secondary choice
+    oh = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)          # (n, k, E)
+    ohf = jnp.swapaxes(oh, 0, 1).reshape(k * n, E)               # priority
+    pos_f = jnp.cumsum(ohf, axis=0) - ohf                        # (k*n, E)
+    pos = jnp.sum(pos_f * ohf, axis=-1).reshape(k, n).T          # (n, k)
+    keep = (pos < cap).astype(gate_vals.dtype)
+    gate_vals = gate_vals * keep
+
+    # (n, k, E, C) one-hot over (expert, slot) -> dispatch/combine (n, E, C)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    sel = oh[..., None] * slot[:, :, None, :] * keep[..., None, None]
+    dispatch = jnp.sum(sel, axis=1)                              # (n, E, C)
+    combine = jnp.sum(sel * gate_vals[..., None, None], axis=1)  # (n, E, C)
+
+    cd = compute_dtype
+    xe = jnp.einsum("nd,nec->ecd", xt.astype(cd), dispatch.astype(cd),
+                    preferred_element_type=jnp.float32).astype(cd)
+    yz = jnp.einsum("ecd,edf->ecf", xe, params["w1"].astype(cd),
+                    preferred_element_type=jnp.float32)          # (E,C,2di)
+    y, gate = jnp.split(yz, 2, axis=-1)
+    h = (y * jax.nn.silu(gate)).astype(cd)
+    ye = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(cd),
+                    preferred_element_type=jnp.float32)          # (E, C, d)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(jnp.float32), ye)
+
+    # Switch aux: fraction routed to e (over all k choices) x mean prob
+    f = jnp.mean(jnp.sum(oh, axis=1), axis=0)                    # (E,)
+    P_mean = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * P_mean) / k
+    return out.reshape(b, t, d).astype(x.dtype), aux
+
+
 def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
                return_state: bool = False):
-    """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP].
+    """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP/MoE].
 
     ``return_state=True`` (prefill) additionally returns the mixer's decode
-    state (conv+SSM caches, or attention KV caches).
+    state (conv+SSM caches, or attention KV caches).  With a MoE model
+    (``cfg.moe_num_experts > 0``) the non-state form returns
+    ``(hidden, residual, aux)`` — the layer's load-balance loss term.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     normed, residual = add_rms_norm(
@@ -120,14 +203,22 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
             )
         else:
             hidden = _mixer_fwd(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
+    aux = jnp.zeros((), jnp.float32)
     if cfg.d_intermediate > 0:
         normed, residual = add_rms_norm(
             hidden, residual, block_params["norm2"]["weight"], cfg.norm_eps,
             residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
         )
-        hidden = _gated_mlp(block_params["mlp"], normed, compute_dtype)
+        if cfg.moe_num_experts:
+            hidden, aux = _moe_mlp(
+                block_params["moe"], cfg, normed, compute_dtype
+            )
+        else:
+            hidden = _gated_mlp(block_params["mlp"], normed, compute_dtype)
     if return_state:
         return hidden, residual, state
+    if cfg.moe_num_experts:
+        return hidden, residual, aux
     return hidden, residual
 
 
@@ -221,11 +312,26 @@ def lm_forward(
     input_ids: jax.Array,
     num_last_tokens: int = 0,
     seq_ctx=None,
-) -> jax.Array:
-    """input_ids (b, t) int32 -> logits (b, t[, num_last_tokens], V) bf16."""
+    return_aux: bool = False,
+):
+    """input_ids (b, t) int32 -> logits (b, t[, num_last_tokens], V) bf16.
+
+    ``return_aux=True`` additionally returns the per-MoE-layer mean of
+    the load-balance aux loss (0.0 for dense models) — what lm_loss
+    folds in with weight ``cfg.moe_aux_weight``.
+    """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = params["embedding"][input_ids].astype(compute_dtype)
     residual = None
+    moe = cfg.moe_num_experts > 0
+    aux_total = jnp.zeros((), jnp.float32)
+
+    def block(bp, cfg_, h, rs, attn, sc):
+        """(h, rs, aux) regardless of dense/MoE — uniform carry shape."""
+        out = _block_fwd(bp, cfg_, h, rs, attn, sc)
+        if moe:
+            return out
+        return (*out, jnp.zeros((), jnp.float32))
 
     if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
         # periodic hybrid: scan over supersteps — trace cost O(period)
@@ -235,26 +341,57 @@ def lm_forward(
         )
         mstack = _group_mamba_stack(params, cfg, p)
 
-        def mbody(carry, bp):
-            h, rs = carry
-            h, rs = _block_fwd(bp, cfg, h, rs, False, seq_ctx)
-            return (h, rs), None
+        if moe:
+            def mbody(carry, bp):
+                h, rs, ax = carry
+                h, rs, a = block(bp, cfg, h, rs, False, seq_ctx)
+                return (h, rs, ax + a), None
 
-        abody = _block_fwd
-        if cfg.remat:
-            mbody = _remat(mbody, cfg)
-            abody = _remat(abody, cfg, static_argnums=(1, 4, 5))
+            def abody_(bp, cfg_, h, rs, ax, attn, sc):
+                h, rs, a = block(bp, cfg_, h, rs, attn, sc)
+                return h, rs, ax + a
 
-        def group(carry, xs):
-            mblk, ablk = xs
-            carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[:r], mblk))
-            carry = abody(ablk, cfg, *carry, True, seq_ctx)
-            carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[r:], mblk))
-            return carry, None
+            abody = abody_
+            if cfg.remat:
+                mbody = _remat(mbody, cfg)
+                abody = _remat(abody, cfg, static_argnums=(1, 5, 6))
 
-        (hidden, residual), _ = jax.lax.scan(
-            group, (hidden, residual), (mstack, params["attn_blocks"])
-        )
+            def group(carry, xs):
+                mblk, ablk = xs
+                carry, _ = jax.lax.scan(
+                    mbody, carry, jax.tree.map(lambda x: x[:r], mblk)
+                )
+                carry = abody(ablk, cfg, *carry, True, seq_ctx)
+                carry, _ = jax.lax.scan(
+                    mbody, carry, jax.tree.map(lambda x: x[r:], mblk)
+                )
+                return carry, None
+
+            (hidden, residual, aux_total), _ = jax.lax.scan(
+                group, (hidden, residual, aux_total),
+                (mstack, params["attn_blocks"]),
+            )
+        else:
+            def mbody(carry, bp):
+                h, rs = carry
+                h, rs = _block_fwd(bp, cfg, h, rs, False, seq_ctx)
+                return (h, rs), None
+
+            abody = _block_fwd
+            if cfg.remat:
+                mbody = _remat(mbody, cfg)
+                abody = _remat(abody, cfg, static_argnums=(1, 4, 5))
+
+            def group(carry, xs):
+                mblk, ablk = xs
+                carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[:r], mblk))
+                carry = abody(ablk, cfg, *carry, True, seq_ctx)
+                carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[r:], mblk))
+                return carry, None
+
+            (hidden, residual), _ = jax.lax.scan(
+                group, (hidden, residual), (mstack, params["attn_blocks"])
+            )
     elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
         mi = ai = 0
@@ -263,10 +400,11 @@ def lm_forward(
             stack = params["attn_blocks"] if attn else params["blocks"]
             j = ai if attn else mi
             bp = jax.tree.map(lambda p, j=j: p[j], stack)
-            body = _block_fwd
+            body = block
             if cfg.remat:
                 body = _remat(body, cfg, static_argnums=(1, 4, 5))
-            hidden, residual = body(bp, cfg, hidden, residual, attn, seq_ctx)
+            hidden, residual, a = body(bp, cfg, hidden, residual, attn, seq_ctx)
+            aux_total = aux_total + a
             if attn:
                 ai += 1
             else:
@@ -275,19 +413,35 @@ def lm_forward(
         # residual must be a concrete array for a scan carry
         residual = jnp.zeros_like(hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype)
 
-        def body(carry, bp):
-            hidden, residual = carry
-            hidden, residual = _block_fwd(bp, cfg, hidden, residual, False, seq_ctx)
-            return (hidden, residual), None
+        if moe:
+            def body(carry, bp):
+                h, rs, ax = carry
+                h, rs, a = _block_fwd(bp, cfg, h, rs, False, seq_ctx)
+                return (h, rs, ax + a), None
 
-        if cfg.remat:
-            body = _remat(body, cfg)
-        (hidden, residual), _ = jax.lax.scan(body, (hidden, residual), params["blocks"])
+            if cfg.remat:
+                body = _remat(body, cfg)
+            (hidden, residual, aux_total), _ = jax.lax.scan(
+                body, (hidden, residual, aux_total), params["blocks"]
+            )
+        else:
+            def body(carry, bp):
+                hidden, residual = carry
+                hidden, residual = _block_fwd(bp, cfg, hidden, residual, False, seq_ctx)
+                return (hidden, residual), None
+
+            if cfg.remat:
+                body = _remat(body, cfg)
+            (hidden, residual), _ = jax.lax.scan(body, (hidden, residual), params["blocks"])
 
     if num_last_tokens > 0:
         hidden = hidden[:, -num_last_tokens:]
         residual = residual[:, -num_last_tokens:]
-    return _final_logits(params, cfg, hidden, residual).astype(compute_dtype)
+    logits = _final_logits(params, cfg, hidden, residual).astype(compute_dtype)
+    if return_aux:
+        n_moe = cfg.n_layer if moe else 1
+        return logits, aux_total / n_moe
+    return logits
 
 
 def lm_loss(
@@ -304,11 +458,16 @@ def lm_loss(
     ``log_softmax`` — the dense (b, t, V) fp32 log-prob tensor (1.6 GB at
     the 280M recipe) never exists; only the two reductions over V do.
     """
-    logits = lm_forward(params, cfg, input_ids, seq_ctx=seq_ctx)
+    logits, aux = lm_forward(
+        params, cfg, input_ids, seq_ctx=seq_ctx, return_aux=True
+    )
     lf = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(lf, axis=-1)
     tgt = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)
+    ce = jnp.mean(lse - tgt)
+    if cfg.moe_num_experts:
+        return ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def lm_loss_pipelined(
@@ -535,7 +694,13 @@ def _block_step(bp, cfg: ModelConfig, hidden, residual, st, attn: bool):
         normed, residual = add_rms_norm(
             hidden, residual, bp["norm2"]["weight"], cfg.norm_eps,
         )
-        hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
+        if cfg.moe_num_experts:
+            hidden, _ = _moe_mlp(
+                bp["moe"], cfg, normed[:, None, :], compute_dtype
+            )
+            hidden = hidden[:, 0]
+        else:
+            hidden = _gated_mlp(bp["mlp"], normed, compute_dtype)
     return hidden, residual, st
 
 

@@ -48,6 +48,14 @@ _TP_RULES: tuple[tuple[tuple[str, ...], int], ...] = (
     (("mixer", "wqkv", "kernel"), -1),
     (("mlp", "fc1", "kernel"), -1),
     (("mlp", "fc2", "kernel"), -2),
+    (("moe", "w1"), -1),                    # (E, d, 2*di): column
+    (("moe", "w2"), -2),                    # (E, di, d): row
+)
+
+# leaves whose first non-layer axis is the MoE expert dimension
+_EXPERT_RULES: tuple[tuple[str, ...], ...] = (
+    ("moe", "w1"),
+    ("moe", "w2"),
 )
 
 
@@ -64,11 +72,19 @@ def _tp_axis(names: list[str], ndim: int, stacked: bool) -> int | None:
 
 
 def _spec_for(names: list[str], shape: tuple[int, ...], fsdp_size: int,
-              tensor_size: int, stacked: bool) -> P:
-    """Tensor-parallel axis first (by rule), then the largest remaining
-    fsdp-divisible axis (skipping the layer axis of stacked params);
-    replicate whatever doesn't divide."""
+              tensor_size: int, stacked: bool, expert_size: int = 1) -> P:
+    """Expert axis first (MoE stacks), then the tensor-parallel axis (by
+    rule), then the largest remaining fsdp-divisible axis (skipping the
+    layer axis of stacked params); replicate whatever doesn't divide."""
     spec: list = [None] * len(shape)
+    if expert_size > 1:
+        for pattern in _EXPERT_RULES:
+            k = len(pattern)
+            if tuple(names[-k:]) == pattern:
+                ax = 1 if stacked else 0
+                if shape[ax] % expert_size == 0:
+                    spec[ax] = "expert"
+                break
     if tensor_size > 1:
         ax = _tp_axis(names, len(shape), stacked)
         if ax is not None and shape[ax] % tensor_size == 0:
@@ -89,7 +105,7 @@ def _spec_for(names: list[str], shape: tuple[int, ...], fsdp_size: int,
 
 
 def param_specs(params, shard: bool, fsdp_size: int, tensor_size: int = 1,
-                pipe_size: int = 1):
+                pipe_size: int = 1, expert_size: int = 1):
     """PartitionSpec pytree matching ``params``.
 
     ``shard=False`` disables FSDP; tensor parallelism applies whenever
@@ -103,7 +119,7 @@ def param_specs(params, shard: bool, fsdp_size: int, tensor_size: int = 1,
         stacked = "blocks" in names or "attn_blocks" in names
         spec = _spec_for(
             names, np.shape(leaf),
-            fsdp_size if shard else 1, tensor_size, stacked,
+            fsdp_size if shard else 1, tensor_size, stacked, expert_size,
         )
         if pipe_size > 1 and stacked and np.ndim(leaf) > 0:
             rest = tuple(spec)[1:]  # layer axis -> pipe; keep fsdp/tp tail
@@ -117,6 +133,7 @@ def param_shardings(params, mesh: Mesh, shard: bool):
     specs = param_specs(
         params, shard, mesh.shape["fsdp"], mesh.shape["tensor"],
         dict(mesh.shape).get("pipe", 1),
+        dict(mesh.shape).get("expert", 1),
     )
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
@@ -159,7 +176,10 @@ def opt_state_shardings(opt_shapes, params, param_sharding_tree, mesh: Mesh):
 
 
 def batch_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
-    """(B, T) batches: B over (data, fsdp), T over seq when SP is on."""
+    """(B, T) batches: B over (data, fsdp, expert) — expert doubles as a
+    pure-DP batch axis for the non-MoE layers — T over seq when SP is on."""
+    if dict(mesh.shape).get("expert", 1) > 1:
+        return P(("data", "fsdp", "expert"), "seq" if seq_sharded else None)
     return P(("data", "fsdp"), "seq" if seq_sharded else None)
 
 

@@ -51,7 +51,11 @@ class Trainer:
         if cfg.mesh.seq > 1:
             from mamba_distributed_tpu.parallel.seq_parallel import SeqContext
 
-            self.seq_ctx = SeqContext(self.mesh, "seq")
+            batch_axes = (
+                ("data", "fsdp", "expert") if cfg.mesh.expert > 1
+                else ("data", "fsdp")
+            )
+            self.seq_ctx = SeqContext(self.mesh, "seq", batch_axes)
         else:
             self.seq_ctx = None
 

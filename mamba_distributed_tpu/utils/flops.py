@@ -112,6 +112,17 @@ def flops_per_token(
         else:
             total += _mamba1_layer_flops(cfg, seq_len)
         if cfg.d_intermediate > 0:
-            total += 6 * cfg.d_model * cfg.d_intermediate
+            mlp = 6 * cfg.d_model * cfg.d_intermediate
+            if cfg.moe_num_experts:
+                # each token runs top_k experts ("model"); the executed
+                # capacity slots include the cf padding ("hardware")
+                mult = (
+                    cfg.moe_top_k * cfg.moe_capacity_factor
+                    if convention == "hardware" else cfg.moe_top_k
+                )
+                total += mlp * mult
+                total += 2 * cfg.d_model * cfg.moe_num_experts  # router
+            else:
+                total += mlp
     total += 2 * cfg.d_model * cfg.vocab_size_padded  # LM head
     return total * (3.0 if training else 1.0)

@@ -202,7 +202,9 @@ def test_tp_with_fsdp_and_dp(tmp_path):
 
 def test_mesh_axis_order():
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, seq=2, tensor=1))
-    assert mesh.axis_names == ("data", "fsdp", "seq", "tensor", "pipe")
+    assert mesh.axis_names == (
+        "data", "fsdp", "seq", "tensor", "pipe", "expert"
+    )
     assert mesh.shape == {
-        "data": 2, "fsdp": 2, "seq": 2, "tensor": 1, "pipe": 1,
+        "data": 2, "fsdp": 2, "seq": 2, "tensor": 1, "pipe": 1, "expert": 1,
     }
