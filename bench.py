@@ -94,7 +94,8 @@ def build_step(spec: dict):
     cfg = get_preset(preset, micro_batch_size=B, seq_len=T, total_batch_size=B * T)
     model_over = {
         k: spec[k]
-        for k in ("ssm_impl", "attn_impl", "remat", "remat_policy")
+        for k in ("ssm_impl", "attn_impl", "remat", "remat_policy",
+                  "chunk_size")
         if k in spec
     }
     if model_over:
@@ -130,7 +131,8 @@ def build_step(spec: dict):
 def time_config(spec: dict, iters: int = 10) -> dict:
     """Time the jitted train step for one configuration on the local chip.
 
-    spec keys (all optional): preset, B, T, ssm_impl, remat, remat_policy.
+    spec keys (all optional): preset, B, T, ssm_impl, attn_impl, remat,
+    remat_policy, chunk_size.
     Returns {**spec, tok_per_sec, mfu, step_ms} or {**spec, error} on
     failure (e.g. OOM at large batch) so sweeps can continue.  Unknown
     spec keys raise immediately — a typo in a sweep config is a bug, not
@@ -139,7 +141,7 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
     known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
-             "remat_policy"}
+             "remat_policy", "chunk_size"}
     unknown = set(spec) - known
     if unknown:
         raise KeyError(

@@ -53,6 +53,14 @@ def load_custom(checkpoint: str, preset: str):
         from mamba_distributed_tpu.training.checkpoint import restore_params_only
 
         params = restore_params_only(checkpoint)
+        got = tuple(params["embedding"].shape)
+        want = (cfg.vocab_size_padded, cfg.d_model)
+        if got != want:
+            raise SystemExit(
+                f"checkpoint/preset mismatch: embedding {got} in "
+                f"{checkpoint!r} but --preset {preset!r} expects {want} — "
+                f"pass the preset the checkpoint was trained with"
+            )
     return params, cfg
 
 

@@ -89,8 +89,11 @@ class ModelConfig:
     # ops/pallas/attention_kernels.py — skips fully-masked blocks).  Under
     # SP, ulysses runs flash after its head all-to-all and ring runs the
     # flash pair kernels per hop (fully-future hops skipped outright).
-    # Decode steps always use the tiny-t XLA path.
-    attn_impl: str = "xla"
+    # Decode steps always use the tiny-t XLA path.  "auto" (default)
+    # resolves to "pallas" on TPU — where the flash kernels measured +12%
+    # train throughput on hybrid-280m (round-4 sweep, MEASUREMENTS.md) —
+    # and "xla" elsewhere (ops/pallas/common.py:resolve_attn_impl).
+    attn_impl: str = "auto"
 
     # --- precision policy (reference: bf16 autocast + fp32 master weights,
     # train.py:72,142,211) ---
@@ -131,9 +134,10 @@ class ModelConfig:
                 f"attn_sp_impl must be 'ring' or 'ulysses', got "
                 f"{self.attn_sp_impl!r}"
             )
-        if self.attn_impl not in ("xla", "pallas"):
+        if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
-                f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}"
+                f"attn_impl must be 'auto', 'xla' or 'pallas', got "
+                f"{self.attn_impl!r}"
             )
         if self.moe_num_experts:
             if self.moe_num_experts < 2:

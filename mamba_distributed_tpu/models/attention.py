@@ -130,20 +130,23 @@ def attention_mixer(
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
 
+    from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
+
+    attn_impl = resolve_attn_impl(cfg.attn_impl)
     if seq_ctx is not None:
         if cfg.attn_sp_impl == "ulysses":
             from mamba_distributed_tpu.parallel.ulysses import (
                 ulysses_attention,
             )
 
-            out = ulysses_attention(seq_ctx, q, k, v, impl=cfg.attn_impl)
+            out = ulysses_attention(seq_ctx, q, k, v, impl=attn_impl)
         else:
             from mamba_distributed_tpu.parallel.ring_attention import (
                 ring_attention,
             )
 
-            out = ring_attention(seq_ctx, q, k, v, impl=cfg.attn_impl)
-    elif cfg.attn_impl == "pallas":
+            out = ring_attention(seq_ctx, q, k, v, impl=attn_impl)
+    elif attn_impl == "pallas":
         from mamba_distributed_tpu.ops.pallas.attention_kernels import (
             flash_sdpa_causal,
         )

@@ -44,7 +44,7 @@ from mamba_distributed_tpu.models.mamba2 import (
     mamba2_mixer,
     mamba2_mixer_step,
 )
-from mamba_distributed_tpu.ops.norm import add_rms_norm
+from mamba_distributed_tpu.ops.norm import add_rms_norm, rms_norm
 
 
 def _init_mixer(key: jax.Array, cfg: ModelConfig) -> dict:
@@ -181,10 +181,19 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
     ``(hidden, residual, aux)`` — the layer's load-balance loss term.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    normed, residual = add_rms_norm(
-        hidden, residual, block_params["norm"]["weight"], cfg.norm_eps,
-        residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
-    )
+    residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+    if hidden is None:
+        # single-carry form (lm_forward scans): ``residual`` is already the
+        # post-add stream; only the norm remains
+        residual = residual.astype(residual_dtype)
+        normed = rms_norm(
+            residual, block_params["norm"]["weight"], cfg.norm_eps
+        ).astype(compute_dtype)
+    else:
+        normed, residual = add_rms_norm(
+            hidden, residual, block_params["norm"]["weight"], cfg.norm_eps,
+            residual_dtype=residual_dtype,
+        )
     state = None
     if attn:
         if return_state:
@@ -223,12 +232,23 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
 
 
 def _final_logits(params, cfg: ModelConfig, hidden, residual):
-    """Final fused add+norm -> (tied) LM head, fp32-accumulated."""
+    """Final fused add+norm -> (tied) LM head, fp32-accumulated.
+
+    ``hidden=None`` means ``residual`` is already the post-add stream
+    (single-carry form) and only the final norm is applied.
+    """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    normed, _ = add_rms_norm(
-        hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
-        residual_dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype,
-    )
+    residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+    if hidden is None:
+        normed = rms_norm(
+            residual.astype(residual_dtype), params["norm_f"]["weight"],
+            cfg.norm_eps,
+        )
+    else:
+        normed, _ = add_rms_norm(
+            hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
+            residual_dtype=residual_dtype,
+        )
     if cfg.tie_embeddings:
         return jnp.dot(
             normed.astype(compute_dtype),
@@ -321,77 +341,59 @@ def lm_forward(
     folds in with weight ``cfg.moe_aux_weight``.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
+    residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
     hidden = params["embedding"][input_ids].astype(compute_dtype)
-    residual = None
+    # Single-carry form: the layer loop carries ONE post-add fp32 stream
+    # instead of the (hidden, residual) pair.  The pair made every remat
+    # boundary save the stream twice — stacked bf16 AND fp32 copies per
+    # layer, ~2.4 GB of saves on the 280M recipe (round-4 trace); the
+    # fp32 add chain and every norm input are bit-identical either way.
+    res = hidden.astype(residual_dtype)
     moe = cfg.moe_num_experts > 0
     aux_total = jnp.zeros((), jnp.float32)
 
-    def block(bp, cfg_, h, rs, attn, sc):
-        """(h, rs, aux) regardless of dense/MoE — uniform carry shape."""
-        out = _block_fwd(bp, cfg_, h, rs, attn, sc)
+    def block(bp, cfg_, res_, attn, sc):
+        """post-add stream -> (new stream, aux) — uniform carry shape."""
+        out = _block_fwd(bp, cfg_, None, res_, attn, sc)
         if moe:
-            return out
-        return (*out, jnp.zeros((), jnp.float32))
+            h, rs, a = out
+        else:
+            (h, rs), a = out, jnp.zeros((), jnp.float32)
+        return rs + h.astype(rs.dtype), a
 
     if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
         # periodic hybrid: scan over supersteps — trace cost O(period)
         p, r = per
-        residual = jnp.zeros_like(
-            hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
-        )
         mstack = _group_mamba_stack(params, cfg, p)
 
-        if moe:
-            def mbody(carry, bp):
-                h, rs, ax = carry
-                h, rs, a = block(bp, cfg, h, rs, False, seq_ctx)
-                return (h, rs, ax + a), None
+        def mbody(carry, bp):
+            rs, ax = carry
+            rs, a = block(bp, cfg, rs, False, seq_ctx)
+            return (rs, ax + a), None
 
-            def abody_(bp, cfg_, h, rs, ax, attn, sc):
-                h, rs, a = block(bp, cfg_, h, rs, attn, sc)
-                return h, rs, ax + a
+        def abody_(bp, cfg_, rs, ax, attn, sc):
+            rs, a = block(bp, cfg_, rs, attn, sc)
+            return rs, ax + a
 
-            abody = abody_
-            if cfg.remat:
-                mbody = _remat(mbody, cfg)
-                abody = _remat(abody, cfg, static_argnums=(1, 5, 6))
+        abody = abody_
+        if cfg.remat:
+            mbody = _remat(mbody, cfg)
+            abody = _remat(abody, cfg, static_argnums=(1, 4, 5))
 
-            def group(carry, xs):
-                mblk, ablk = xs
-                carry, _ = jax.lax.scan(
-                    mbody, carry, jax.tree.map(lambda x: x[:r], mblk)
-                )
-                carry = abody(ablk, cfg, *carry, True, seq_ctx)
-                carry, _ = jax.lax.scan(
-                    mbody, carry, jax.tree.map(lambda x: x[r:], mblk)
-                )
-                return carry, None
-
-            (hidden, residual, aux_total), _ = jax.lax.scan(
-                group, (hidden, residual, aux_total),
-                (mstack, params["attn_blocks"]),
+        def group(carry, xs):
+            mblk, ablk = xs
+            carry, _ = jax.lax.scan(
+                mbody, carry, jax.tree.map(lambda x: x[:r], mblk)
             )
-        else:
-            def mbody(carry, bp):
-                h, rs = carry
-                h, rs = _block_fwd(bp, cfg, h, rs, False, seq_ctx)
-                return (h, rs), None
-
-            abody = _block_fwd
-            if cfg.remat:
-                mbody = _remat(mbody, cfg)
-                abody = _remat(abody, cfg, static_argnums=(1, 4, 5))
-
-            def group(carry, xs):
-                mblk, ablk = xs
-                carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[:r], mblk))
-                carry = abody(ablk, cfg, *carry, True, seq_ctx)
-                carry, _ = jax.lax.scan(mbody, carry, jax.tree.map(lambda x: x[r:], mblk))
-                return carry, None
-
-            (hidden, residual), _ = jax.lax.scan(
-                group, (hidden, residual), (mstack, params["attn_blocks"])
+            carry = abody(ablk, cfg, *carry, True, seq_ctx)
+            carry, _ = jax.lax.scan(
+                mbody, carry, jax.tree.map(lambda x: x[r:], mblk)
             )
+            return carry, None
+
+        (res, aux_total), _ = jax.lax.scan(
+            group, (res, aux_total), (mstack, params["attn_blocks"])
+        )
     elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
         mi = ai = 0
@@ -402,42 +404,37 @@ def lm_forward(
             bp = jax.tree.map(lambda p, j=j: p[j], stack)
             body = block
             if cfg.remat:
-                body = _remat(body, cfg, static_argnums=(1, 4, 5))
-            hidden, residual, a = body(bp, cfg, hidden, residual, attn, seq_ctx)
+                body = _remat(body, cfg, static_argnums=(1, 3, 4))
+            res, a = body(bp, cfg, res, attn, seq_ctx)
             aux_total = aux_total + a
             if attn:
                 ai += 1
             else:
                 mi += 1
     else:
-        # residual must be a concrete array for a scan carry
-        residual = jnp.zeros_like(hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype)
-
         if moe:
             def body(carry, bp):
-                h, rs, ax = carry
-                h, rs, a = _block_fwd(bp, cfg, h, rs, False, seq_ctx)
-                return (h, rs, ax + a), None
+                rs, ax = carry
+                rs, a = block(bp, cfg, rs, False, seq_ctx)
+                return (rs, ax + a), None
 
             if cfg.remat:
                 body = _remat(body, cfg)
-            (hidden, residual, aux_total), _ = jax.lax.scan(
-                body, (hidden, residual, aux_total), params["blocks"]
+            (res, aux_total), _ = jax.lax.scan(
+                body, (res, aux_total), params["blocks"]
             )
         else:
-            def body(carry, bp):
-                hidden, residual = carry
-                hidden, residual = _block_fwd(bp, cfg, hidden, residual, False, seq_ctx)
-                return (hidden, residual), None
+            def body(rs, bp):
+                rs, _ = block(bp, cfg, rs, False, seq_ctx)
+                return rs, None
 
             if cfg.remat:
                 body = _remat(body, cfg)
-            (hidden, residual), _ = jax.lax.scan(body, (hidden, residual), params["blocks"])
+            res, _ = jax.lax.scan(body, res, params["blocks"])
 
     if num_last_tokens > 0:
-        hidden = hidden[:, -num_last_tokens:]
-        residual = residual[:, -num_last_tokens:]
-    logits = _final_logits(params, cfg, hidden, residual).astype(compute_dtype)
+        res = res[:, -num_last_tokens:]
+    logits = _final_logits(params, cfg, None, res).astype(compute_dtype)
     if return_aux:
         n_moe = cfg.n_layer if moe else 1
         return logits, aux_total / n_moe
@@ -496,10 +493,14 @@ def lm_loss_pipelined(
     from mamba_distributed_tpu.parallel.pipeline import pipelined_layers
 
     compute_dtype = jnp.dtype(cfg.compute_dtype)
+    residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
     hidden = params["embedding"][input_ids].astype(compute_dtype)  # (mb,b,t,d)
-    residual = jnp.zeros_like(
-        hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
-    )
+    # single-carry post-add stream (see lm_forward)
+    res = hidden.astype(residual_dtype)
+
+    def sc_block(bp, res_, attn):
+        h, rs = _block_fwd(bp, cfg, None, res_, attn)
+        return rs + h.astype(rs.dtype)
 
     if cfg.attn_layer_idx:
         per = _hybrid_period(cfg)
@@ -510,15 +511,14 @@ def lm_loss_pipelined(
         stacked = (_group_mamba_stack(params, cfg, p), params["attn_blocks"])
 
         def mbody(carry, bp):
-            h, rs = carry
-            return _block_fwd(bp, cfg, h, rs, False), None
+            return sc_block(bp, carry, False), None
 
         def body(carry, group):
             mblk, ablk = group
             carry, _ = jax.lax.scan(
                 mbody, carry, jax.tree.map(lambda x: x[:r], mblk)
             )
-            carry = _block_fwd(ablk, cfg, *carry, True)
+            carry = sc_block(ablk, carry, True)
             carry, _ = jax.lax.scan(
                 mbody, carry, jax.tree.map(lambda x: x[r:], mblk)
             )
@@ -527,16 +527,15 @@ def lm_loss_pipelined(
         stacked = params["blocks"]
 
         def body(carry, bp):
-            h, r_ = carry
-            return _block_fwd(bp, cfg, h, r_, False)
+            return sc_block(bp, carry, False)
 
     if cfg.remat:
         body = _remat(body, cfg)
-    hidden, residual = pipelined_layers(
-        body, stacked, (hidden, residual), mesh, axis=axis,
+    res = pipelined_layers(
+        body, stacked, res, mesh, axis=axis,
         batch_axes=batch_axes,
     )
-    lf = _final_logits(params, cfg, hidden, residual)
+    lf = _final_logits(params, cfg, None, res)
     lse = jax.nn.logsumexp(lf, axis=-1)
     tgt = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(lse - tgt)

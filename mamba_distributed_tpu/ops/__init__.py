@@ -10,6 +10,7 @@ from mamba_distributed_tpu.ops.scan import (
 )
 from mamba_distributed_tpu.ops.ssd import (
     chunk_local,
+    cumsum_mxu,
     segsum,
     ssd_chunked,
     ssd_seq,
@@ -27,6 +28,7 @@ __all__ = [
     "selective_scan_seq",
     "selective_state_update",
     "chunk_local",
+    "cumsum_mxu",
     "segsum",
     "ssd_chunked",
     "ssd_seq",

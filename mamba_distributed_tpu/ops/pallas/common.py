@@ -23,5 +23,34 @@ def resolve_interpret(interpret: bool | None) -> bool:
     env = os.environ.get("MDT_PALLAS_INTERPRET")
     if env is not None:
         return env != "0"
+    return not on_tpu()
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a TPU (tunneled platforms whose
+    backend name isn't "tpu" are detected via device_kind)."""
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    return not (jax.default_backend() == "tpu" or "tpu" in kind)
+    return jax.default_backend() == "tpu" or "tpu" in kind
+
+
+def resolve_attn_impl(impl: str) -> str:
+    """Resolve the ``attn_impl="auto"`` config default.
+
+    On TPU hardware the Pallas flash kernels measured +12% train
+    throughput over the blockwise-XLA SDPA on the hybrid-280m preset
+    (round-4 sweep, MEASUREMENTS.md), so auto picks "pallas" there; on
+    CPU (tests, debugging) auto picks "xla" to avoid paying for the
+    Pallas interpreter in composed graphs.
+
+    ``MDT_PALLAS_INTERPRET`` overrides the device probe the same way it
+    does for ``resolve_interpret``: "0" (the chip-free ``jax.export``
+    TPU-lowering pattern) resolves auto to "pallas" so CPU-host exports
+    targeting TPU bake in the kernels they'd get on hardware; "1" forces
+    the XLA path.
+    """
+    if impl != "auto":
+        return impl
+    env = os.environ.get("MDT_PALLAS_INTERPRET")
+    if env is not None:
+        return "xla" if env != "0" else "pallas"
+    return "pallas" if on_tpu() else "xla"

@@ -40,7 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from mamba_distributed_tpu.ops.pallas.common import resolve_interpret
 from mamba_distributed_tpu.ops.scan import _divisor_chunk
-from mamba_distributed_tpu.ops.ssd import state_passing
+from mamba_distributed_tpu.ops.ssd import cumsum_mxu, state_passing
 
 # every grid cell is independent — let both megacore TensorCores split it
 _PARALLEL3 = pltpu.CompilerParams(
@@ -177,7 +177,7 @@ def _chunked_inputs(x, dt, A, B, C, chunk_size):
 
     dtf = dt.astype(jnp.float32)
     dA = dtf * A.astype(jnp.float32)                 # (b, t, h)
-    a_cum = jnp.cumsum(dA.reshape(b, nc, l, h), axis=2)          # (b, nc, l, h)
+    a_cum = cumsum_mxu(dA.reshape(b, nc, l, h), axis=2)          # (b, nc, l, h)
     chunk_decay = jnp.exp(a_cum[:, :, -1, :])        # (b, nc, h)
     d_to_end = jnp.exp(a_cum[:, :, -1:, :] - a_cum)  # (b, nc, l, h)
 
@@ -479,7 +479,7 @@ def _ssd_pallas_bwd_impl(
     da = cells_to_blh(da5)
     ddt_dir = cells_to_blh(ddt5)
     da = da.at[:, :, -1, :].add(dgamma * chunk_decay)
-    ddA = jnp.flip(jnp.cumsum(jnp.flip(da, 2), axis=2), 2)       # (b, nc, l, h)
+    ddA = cumsum_mxu(da, axis=2, reverse=True)                   # (b, nc, l, h)
     Af = A.astype(jnp.float32)
     ddt = (ddt_dir + ddA * Af[None, None, None]).reshape(b, t, h)
     dA = jnp.sum(ddA * cells_to_blh(cells["dt"]), axis=(0, 1, 2))

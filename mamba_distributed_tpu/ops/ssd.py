@@ -34,6 +34,28 @@ import jax
 import jax.numpy as jnp
 
 
+def cumsum_mxu(x: jax.Array, axis: int = -1, reverse: bool = False) -> jax.Array:
+    """Inclusive (reverse-)cumsum as a triangular matmul.
+
+    ``jnp.cumsum`` lowers to a sequential reduce-window on TPU (measured
+    ~25 ms/step across the 280M model's four cumsum sites, round-4 trace);
+    a (l, l) lower-triangular ones matmul computes the same prefix sums on
+    the MXU at negligible cost and fuses with the surrounding decay math.
+    The transposed triangle gives the reverse cumsum, so the custom-vjp-free
+    gradient (a reverse cumsum) rides the MXU too.
+    """
+    l = x.shape[axis]
+    tri = jnp.tril(jnp.ones((l, l), jnp.float32))
+    if reverse:
+        tri = tri.T
+    xm = jnp.moveaxis(x, axis, -1)
+    out = jnp.einsum(
+        "...s,ls->...l", xm.astype(jnp.float32), tri,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return jnp.moveaxis(out, -1, axis)
+
+
 def segsum(x: jax.Array) -> jax.Array:
     """Segment-sum: out[..., i, j] = sum_{k in (j, i]} x[..., k] for i >= j.
 
@@ -41,7 +63,7 @@ def segsum(x: jax.Array) -> jax.Array:
     triangular decay matrix with ones on the diagonal.
     """
     l = x.shape[-1]
-    cs = jnp.cumsum(x, axis=-1)
+    cs = cumsum_mxu(x, axis=-1)
     d = cs[..., :, None] - cs[..., None, :]
     mask = jnp.tril(jnp.ones((l, l), dtype=bool))
     return jnp.where(mask, d, -jnp.inf)
@@ -153,7 +175,7 @@ def chunk_local(
     Cc = C.reshape(b, nc, l, g, n)
 
     dA = dtc * Af  # (b, nc, l, h), <= 0
-    dA_cum = jnp.cumsum(dA, axis=2)  # inclusive cumsum within chunk
+    dA_cum = cumsum_mxu(dA, axis=2)  # inclusive cumsum within chunk
 
     # --- intra-chunk (diagonal blocks): batched MXU matmuls ---
     # G[i, j] = <C_i, B_j> is group-shared -> (b, nc, g, l, l)
@@ -195,6 +217,12 @@ def chunk_local(
     return y_diag, states, chunk_decay, off_ctx
 
 
+# Above this chunk count the O(nc^2) decay-weight einsum in state_passing
+# yields to the O(log nc) associative scan (tests force the fallback by
+# patching this).
+_STATE_PASSING_EINSUM_MAX_NC = 256
+
+
 def state_passing(
     states: jax.Array,
     chunk_decay: jax.Array,
@@ -207,19 +235,55 @@ def state_passing(
     and final_state (b, h, p, n)).
     """
     b, nc, h, p, n = states.shape
-    decay = chunk_decay[..., None, None]  # (b, nc, h, 1, 1)
+    if nc <= _STATE_PASSING_EINSUM_MAX_NC:
+        # Dominant path: the recurrence as one lower-triangular decay-
+        # weighted einsum on the MXU.  The associative_scan formulation
+        # pads/slices the full (b, nc, h, p, n) array every round (six
+        # whole-array pad ops ≈ 44 ms/step on the 280M config, round-4
+        # trace); the matmul is O(nc^2) in tiny chunk counts and touches
+        # each state tensor exactly once.  Log-space decays keep it exact:
+        # cum is non-increasing, so every exp argument is <= 0.  Clamping
+        # at fp32-tiny only affects per-chunk decays that already
+        # underflowed to zero, where exp(diff) underflows to zero too.
+        ldc = jnp.log(
+            jnp.maximum(
+                chunk_decay.astype(jnp.float32), jnp.finfo(jnp.float32).tiny
+            )
+        )
+        cum = cumsum_mxu(ldc, axis=1)  # (b, nc, h)
+        # W[c, j] = prod of decays (j, c] = exp(cum[c] - cum[j]) for j <= c
+        diff = cum[:, :, None, :] - cum[:, None, :, :]  # (b, c, j, h)
+        tri = jnp.tril(jnp.ones((nc, nc), dtype=bool))[None, :, :, None]
+        # double-where: above the diagonal diff >= 0 can overflow exp and
+        # the dead branch would still NaN the gradient
+        safe = jnp.where(tri, diff, -100.0)
+        W = jnp.where(tri, jnp.exp(safe), 0.0).astype(states.dtype)
+        s_cum = jnp.einsum(
+            "bcjh,bjhpn->bchpn", W, states,
+            preferred_element_type=jnp.float32,
+        ).astype(states.dtype)
+        if initial_state is not None:
+            s0 = initial_state.astype(jnp.float32)[:, None]
+            a_cum = jnp.exp(cum)[..., None, None].astype(jnp.float32)
+            s_cum = (s_cum.astype(jnp.float32) + a_cum * s0).astype(
+                states.dtype
+            )
+    else:
+        decay = chunk_decay[..., None, None]  # (b, nc, h, 1, 1)
 
-    def combine(left, right):
-        a_l, s_l = left
-        a_r, s_r = right
-        # a stays (b, nc, h, 1, 1); broadcasting happens only against states
-        return a_l * a_r, s_l * a_r + s_r
+        def combine(left, right):
+            a_l, s_l = left
+            a_r, s_r = right
+            # a stays (b, nc, h, 1, 1); broadcast only against states
+            return a_l * a_r, s_l * a_r + s_r
 
-    a_cum, s_cum = jax.lax.associative_scan(combine, (decay, states), axis=1)
-    # s_cum[c] = state *after* chunk c assuming zero initial state.
-    if initial_state is not None:
-        s0 = initial_state.astype(states.dtype)[:, None]
-        s_cum = s_cum + a_cum * s0
+        a_cum, s_cum = jax.lax.associative_scan(
+            combine, (decay, states), axis=1
+        )
+        # s_cum[c] = state *after* chunk c assuming zero initial state.
+        if initial_state is not None:
+            s0 = initial_state.astype(states.dtype)[:, None]
+            s_cum = s_cum + a_cum * s0
     final_state = s_cum[:, -1]
     # state entering chunk c = s_cum[c-1]; chunk 0 gets the initial state.
     s0_in = (

@@ -44,6 +44,7 @@ from mamba_distributed_tpu.ops.conv import causal_conv1d
 from mamba_distributed_tpu.ops.ssd import (
     chunk_local,
     combine_chunk_outputs,
+    cumsum_mxu,
     state_passing,
 )
 
@@ -127,10 +128,10 @@ def _seeded_correction(dt, A, C, s_in, chunk_size, compute_dtype):
     p = s_in.shape[2]
 
     dA = (dt.astype(jnp.float32) * A.astype(jnp.float32)).reshape(b, nc, l, h)
-    a_cum = jnp.cumsum(dA, axis=2)                   # in-chunk log-decay
+    a_cum = cumsum_mxu(dA, axis=2)                   # in-chunk log-decay
     chunk_sum = a_cum[:, :, -1, :]                   # (b, nc, h)
     # prod of chunk decays BEFORE chunk c (exclusive prefix)
-    prefix = jnp.exp(jnp.cumsum(chunk_sum, axis=1) - chunk_sum)
+    prefix = jnp.exp(cumsum_mxu(chunk_sum, axis=1) - chunk_sum)
     e_a = jnp.exp(a_cum)                             # (b, nc, l, h)
 
     s_eff = s_in.astype(jnp.float32)[:, None] * prefix[..., None, None]

@@ -237,6 +237,68 @@ class TestSSD:
         D = _rand(keys[5], (h,))
         return x, dt, A, B, C, D
 
+    def test_cumsum_mxu_matches_jnp(self, rng):
+        x = _rand(rng, (2, 5, 7, 3))
+        for axis in (1, -1):
+            np.testing.assert_allclose(
+                np.asarray(ops.cumsum_mxu(x, axis=axis)),
+                np.asarray(jnp.cumsum(x, axis=axis)),
+                atol=1e-5, rtol=1e-5,
+            )
+        # reverse cumsum == flip-cumsum-flip
+        np.testing.assert_allclose(
+            np.asarray(ops.cumsum_mxu(x, axis=1, reverse=True)),
+            np.asarray(jnp.flip(jnp.cumsum(jnp.flip(x, 1), axis=1), 1)),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_state_passing_matmul_matches_scan(self, rng):
+        # the nc<=256 einsum path and the associative-scan fallback must
+        # agree (fwd + grads), including with an initial state and with
+        # per-chunk decays that underflow exp to zero
+        from mamba_distributed_tpu.ops import ssd as ssd_mod
+
+        b, nc, h, p, n = 2, 5, 3, 4, 6
+        keys = jax.random.split(rng, 3)
+        states = _rand(keys[0], (b, nc, h, p, n))
+        log_dec = -jnp.abs(_rand(keys[1], (b, nc, h))) * 2.0
+        log_dec = log_dec.at[0, 2, 0].set(-120.0)  # exp underflows to 0
+        chunk_decay = jnp.exp(log_dec)
+        s0 = _rand(keys[2], (b, h, p, n))
+
+        prev, final = ssd_mod.state_passing(states, chunk_decay, s0)
+        # sequential oracle
+        s = s0
+        exp_prev = []
+        for c in range(nc):
+            exp_prev.append(s)
+            s = s * chunk_decay[:, c, :, None, None] + states[:, c]
+        np.testing.assert_allclose(
+            np.asarray(prev), np.asarray(jnp.stack(exp_prev, 1)),
+            atol=1e-5, rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(final), np.asarray(s), atol=1e-5, rtol=1e-4
+        )
+        # force the associative-scan fallback and pin it to the einsum path
+        orig = ssd_mod._STATE_PASSING_EINSUM_MAX_NC
+        try:
+            ssd_mod._STATE_PASSING_EINSUM_MAX_NC = 0
+            prev_f, final_f = ssd_mod.state_passing(states, chunk_decay, s0)
+        finally:
+            ssd_mod._STATE_PASSING_EINSUM_MAX_NC = orig
+        np.testing.assert_allclose(
+            np.asarray(prev_f), np.asarray(prev), atol=1e-5, rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(final_f), np.asarray(final), atol=1e-5, rtol=1e-4
+        )
+        # gradients are finite (the masked exp must not NaN the backward)
+        g = jax.grad(
+            lambda st, cd: jnp.sum(ssd_mod.state_passing(st, cd, s0)[0] ** 2)
+        )(states, chunk_decay)
+        assert np.isfinite(np.asarray(g)).all()
+
     def test_segsum(self):
         x = jnp.array([[1.0, 2.0, 3.0]])
         s = ops.segsum(x)[0]

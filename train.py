@@ -43,7 +43,8 @@ def parse_args():
     p.add_argument("--mesh-tensor", type=int, default=None)
     p.add_argument("--ssm-impl", choices=["xla", "pallas"], default=None,
                    help="kernel backend for the SSM scan")
-    p.add_argument("--attn-impl", choices=["xla", "pallas"], default=None,
+    p.add_argument("--attn-impl", choices=["auto", "xla", "pallas"],
+                   default=None,
                    help="SDPA backend for hybrid attention layers (pallas: "
                         "flash kernel)")
     p.add_argument("--attn-sp-impl", choices=["ring", "ulysses"], default=None,
