@@ -108,7 +108,9 @@ class ModelConfig:
     remat: bool = True  # per-block activation checkpointing
     # "all": recompute everything (min memory); "dots": save matmul
     # outputs, recompute elementwise (jax dots_with_no_batch_dims policy —
-    # trades HBM for a lighter backward)
+    # trades HBM for a lighter backward); "mixer": save only the
+    # scan/attention outputs so the backward never recomputes the SSD
+    # scan (checkpoint_name "mixer_out" in the mixers)
     remat_policy: str = "all"
 
     # --- kernel backend for the SSD scan: "xla" (einsum formulation) or
@@ -116,9 +118,10 @@ class ModelConfig:
     ssm_impl: str = "xla"
 
     def __post_init__(self):
-        if self.remat_policy not in ("all", "dots"):
+        if self.remat_policy not in ("all", "dots", "mixer"):
             raise ValueError(
-                f"remat_policy must be 'all' or 'dots', got {self.remat_policy!r}"
+                f"remat_policy must be 'all', 'dots' or 'mixer', got "
+                f"{self.remat_policy!r}"
             )
         if self.ssm_impl not in ("xla", "pallas"):
             raise ValueError(

@@ -16,6 +16,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.common import init_linear, linear
@@ -161,6 +162,8 @@ def attention_mixer(
         # O(t*block) memory — never materializes the (t, t) score tensor
         # (config 5 at T=8192); the tiny-t decode path keeps _sdpa_causal
         out = blockwise_sdpa_causal(q, k, v)
+    # remat_policy="mixer" save point (models/lm.py:_remat)
+    out = checkpoint_name(out, "mixer_out")
     y = linear(params["out_proj"], out.reshape(b, t, nh * hd), compute_dtype)
     if return_final_state:
         return y, (k, v, jnp.array(t, jnp.int32))

@@ -262,6 +262,11 @@ def _remat(fn, cfg: ModelConfig, static_argnums=()):
     """Per-block checkpointing with the configured save policy."""
     if cfg.remat_policy == "dots":
         policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif cfg.remat_policy == "mixer":
+        # save the scan/attention outputs (~12-25 MB/layer bf16) so the
+        # backward recomputes only the projections/conv/norms, never the
+        # SSD chunked scan itself
+        policy = jax.checkpoint_policies.save_only_these_names("mixer_out")
     else:
         policy = None
     return jax.checkpoint(fn, policy=policy, static_argnums=static_argnums)

@@ -16,6 +16,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.common import (
@@ -151,6 +152,8 @@ def mamba1_mixer(
                 x, dt, A, B, C, **scan_kw,
                 initial_state=initial_ssm_state, return_final_state=True,
             )
+    # remat_policy="mixer" save point (models/lm.py:_remat)
+    y = checkpoint_name(y, "mixer_out")
     out = linear(params["out_proj"], y, compute_dtype)
     if return_final_state:
         return out, (conv_state, ssm_state)

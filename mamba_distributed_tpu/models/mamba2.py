@@ -17,6 +17,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.common import (
@@ -174,6 +175,10 @@ def mamba2_mixer(
             return_final_state=True,
             compute_dtype=compute_dtype,
         )
+    # remat_policy="mixer": the scan output is the save point — the
+    # backward then never recomputes the SSD scan, the priciest part of
+    # the block (models/lm.py:_remat)
+    y = checkpoint_name(y, "mixer_out")
     y = y.reshape(b, t, di)
     y = rms_norm_gated(
         y, z, params["norm"]["weight"], cfg.norm_eps,

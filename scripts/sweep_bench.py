@@ -21,15 +21,17 @@ from bench import init_backend, time_config  # noqa: E402
 
 DEFAULT_CONFIGS = [
     {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "mixer"},
     {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "dots"},
-    {"B": 8, "ssm_impl": "xla", "remat": False},
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all",
+     "chunk_size": 512},
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "mixer",
+     "chunk_size": 512},
     {"B": 8, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
-    {"B": 8, "ssm_impl": "pallas", "remat": True, "remat_policy": "dots"},
-    {"B": 8, "ssm_impl": "pallas", "remat": False},
     {"B": 16, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
-    {"B": 16, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
+    {"B": 16, "ssm_impl": "xla", "remat": True, "remat_policy": "mixer",
+     "chunk_size": 512},
     {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
-    {"B": 32, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
     # hybrid (config-5 architecture, single-chip scale): does the flash
     # kernel beat the blockwise XLA scan on real hardware?
     {"preset": "hybrid-280m", "B": 8, "attn_impl": "xla"},

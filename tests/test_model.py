@@ -249,6 +249,27 @@ def test_remat_policy_dots_matches():
         assert jnp.allclose(a, b, atol=1e-5), "grads diverge across policies"
 
 
+def test_remat_policy_mixer_matches():
+    """remat_policy='mixer' (save scan outputs, skip the SSD recompute in
+    the backward) is numerically identical to full recompute — for the
+    pure-Mamba stack and for a hybrid (attention mixer_out save point)."""
+    for extra in ({}, {"attn_layer_idx": (1,), "attn_num_heads": 4,
+                       "attn_num_kv_heads": 2}):
+        cfg_all = ModelConfig(**{**TINY, "ssm_layer": "mamba2", **extra})
+        cfg_mix = ModelConfig(**{**TINY, "ssm_layer": "mamba2",
+                                 "remat_policy": "mixer", **extra})
+        params = init_lm_params(jax.random.PRNGKey(0), cfg_all)
+        x = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+        y = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, 64)
+        l1, g1 = jax.value_and_grad(lm_loss)(params, cfg_all, x, y)
+        l2, g2 = jax.value_and_grad(lm_loss)(params, cfg_mix, x, y)
+        assert jnp.allclose(l1, l2, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            assert jnp.allclose(a, b, atol=1e-5), (
+                "grads diverge across policies"
+            )
+
+
 def test_remat_policy_validation():
     import pytest as _pytest
 

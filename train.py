@@ -51,7 +51,8 @@ def parse_args():
                    help="attention strategy under sequence parallelism "
                         "(ring: KV rotation; ulysses: all-to-all head "
                         "sharding, needs heads %% mesh-seq == 0)")
-    p.add_argument("--remat-policy", choices=["all", "dots"], default=None)
+    p.add_argument("--remat-policy", choices=["all", "dots", "mixer"],
+                   default=None)
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first (TPU pods)")
     p.add_argument("--sample-prompt", default=None, metavar="TEXT",
