@@ -200,6 +200,8 @@ def _env_spec() -> dict:
         spec["remat"] = v == "1"
     if os.environ.get("BENCH_REMAT_POLICY"):
         spec["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
+    if os.environ.get("BENCH_CHUNK_SIZE"):
+        spec["chunk_size"] = int(os.environ["BENCH_CHUNK_SIZE"])
     return spec
 
 

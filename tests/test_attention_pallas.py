@@ -142,3 +142,18 @@ def test_flash_tpu_lowering_fwd_and_grad(rng, shapes):
                  (0, 1, 2)),
         q, k, v,
     )
+
+
+def test_resolve_attn_impl_auto(monkeypatch):
+    """auto -> xla on CPU hosts, pallas when MDT_PALLAS_INTERPRET=0 marks a
+    chip-free TPU lowering (so exports bake in the hardware kernels)."""
+    from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
+
+    monkeypatch.delenv("MDT_PALLAS_INTERPRET", raising=False)
+    assert resolve_attn_impl("xla") == "xla"
+    assert resolve_attn_impl("pallas") == "pallas"
+    assert resolve_attn_impl("auto") == "xla"  # CPU test host
+    monkeypatch.setenv("MDT_PALLAS_INTERPRET", "0")
+    assert resolve_attn_impl("auto") == "pallas"
+    monkeypatch.setenv("MDT_PALLAS_INTERPRET", "1")
+    assert resolve_attn_impl("auto") == "xla"
