@@ -35,6 +35,35 @@ def top_k_sample(
     return jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
 
 
+def _decode_params(params: dict, cfg: ModelConfig) -> dict:
+    """Pre-cast matmul kernels + embedding to the compute dtype.
+
+    Decode is weight-bandwidth-bound: every token step re-read the fp32
+    params only for ``linear()`` to cast them to bf16 (~1.1 GB/token at
+    280M — exactly the measured 1.38 ms/token on v5e).  Casting once
+    outside the decode scan halves that traffic, and the values are
+    bit-identical because the per-step cast produced the same bf16
+    numbers.  Conv kernels, biases, norm weights, SSM scalars and the
+    MoE router (routed in fp32) stay fp32 — their math runs in fp32.
+    """
+    cd = jnp.dtype(cfg.compute_dtype)
+
+    def cast(path, leaf):
+        keys = [getattr(p, "key", None) for p in path]
+        if keys and keys[-1] == "embedding":
+            return leaf.astype(cd)
+        if (
+            keys
+            and keys[-1] == "kernel"
+            and len(keys) >= 2
+            and keys[-2] not in ("conv", "router")
+        ):
+            return leaf.astype(cd)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
 @functools.partial(
     jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "temperature")
 )
@@ -53,6 +82,7 @@ def generate(
     truncate at the tokenizer's EOT afterwards, as the caller wishes).
     """
     b, t = prompt_ids.shape
+    params = _decode_params(params, cfg)
     # parallel prefill: one full-sequence forward builds the decode state
     # (the reference re-ran the whole prefix per token instead)
     last_logits, state = lm_prefill(
