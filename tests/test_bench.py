@@ -85,3 +85,29 @@ def test_env_spec_defaults_are_baseline_recipe(monkeypatch):
     spec = bench._env_spec()
     assert spec["preset"] == bench.BASELINE_PRESET
     assert spec["T"] == bench.BASELINE_T
+
+
+def test_sweep_default_configs_are_constructible():
+    """Every spec in the default sweep matrix must build a valid config —
+    a typo'd key or value should fail here, not after claiming the chip."""
+    import dataclasses
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from sweep_bench import DEFAULT_CONFIGS
+    from mamba_distributed_tpu.config import get_preset
+
+    known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
+             "remat_policy", "chunk_size"}
+    for spec in DEFAULT_CONFIGS:
+        assert set(spec) <= known, spec
+        B = spec.get("B", bench.DEFAULT_B)
+        T = spec.get("T", bench.DEFAULT_T)
+        cfg = get_preset(spec.get("preset", bench.DEFAULT_PRESET),
+                         micro_batch_size=B, seq_len=T,
+                         total_batch_size=B * T)
+        over = {k: spec[k] for k in
+                ("ssm_impl", "attn_impl", "remat", "remat_policy",
+                 "chunk_size") if k in spec}
+        if over:
+            # ModelConfig.__post_init__ validates the values
+            dataclasses.replace(cfg.model, **over)
