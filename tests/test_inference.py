@@ -246,3 +246,33 @@ def test_generate_cli_reference_pt(tmp_path, capsys, monkeypatch):
     gen_cli.main()
     out = capsys.readouterr().out
     assert out.count("> tokens") == 1
+
+
+def test_decode_params_cast_selectivity():
+    """The decode pre-cast converts only matmul kernels + embedding;
+    fp32-math leaves (conv kernel, biases, norms, SSM scalars) keep their
+    dtype so decode stays bit-identical to the per-step cast."""
+    import jax.numpy as jnp
+    from mamba_distributed_tpu.config import ModelConfig
+    from mamba_distributed_tpu.inference.generate import _decode_params
+    from mamba_distributed_tpu.models.lm import init_lm_params
+
+    cfg = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
+        d_state=16, chunk_size=8, attn_layer_idx=(1,), attn_num_heads=2,
+        attn_num_kv_heads=1, remat=False,
+    )
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    cast = _decode_params(params, cfg)
+    cd = jnp.dtype(cfg.compute_dtype)
+    assert cast["embedding"].dtype == cd
+    blk = cast["blocks"]["mixer"]
+    assert blk["in_proj"]["kernel"].dtype == cd
+    assert blk["out_proj"]["kernel"].dtype == cd
+    assert blk["conv"]["kernel"].dtype == jnp.float32   # fp32 conv math
+    assert blk["A_log"].dtype == jnp.float32
+    assert blk["dt_bias"].dtype == jnp.float32
+    assert blk["norm"]["weight"].dtype == jnp.float32
+    ab = cast["attn_blocks"]["mixer"]
+    assert ab["wqkv"]["kernel"].dtype == cd
+    assert cast["norm_f"]["weight"].dtype == jnp.float32
