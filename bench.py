@@ -17,7 +17,8 @@ Env knobs (for sweeps; defaults are the shipped configuration):
   BENCH_T          sequence length        (default 1024)
   BENCH_SSM_IMPL   xla | pallas           (default preset's)
   BENCH_REMAT      0 | 1                  (default preset's)
-  BENCH_REMAT_POLICY all | dots           (default preset's)
+  BENCH_REMAT_POLICY all | dots | mixer   (default preset's)
+  BENCH_CHUNK_SIZE SSD chunk length       (default preset's)
   BENCH_ITERS      timed iterations       (default 10)
 """
 
