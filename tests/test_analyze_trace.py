@@ -1,0 +1,53 @@
+"""scripts/analyze_trace.py: bucket rules + end-to-end on a synthetic trace."""
+
+import gzip
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from analyze_trace import analyze, categorize, find_trace  # noqa: E402
+
+
+def test_categorize_rules():
+    assert categorize("convolution_convert_fusion.15") == "matmul fusions"
+    assert categorize("bitcast_dynamic-update-slice_fusion.1") == \
+        "dyn-slice (scan stacking)"
+    assert categorize("copy.775") == "copy/reshape/pad"
+    assert categorize("reshape.861") == "copy/reshape/pad"
+    assert categorize("pad_add_fusion.29") == "copy/reshape/pad"
+    assert categorize("multiply_convert_fusion.81") == \
+        "elementwise/reduce fusions"
+    assert categorize("reduce-window.77") == "reduce-window (cumsum)"
+    assert categorize("convert.9") == "misc"
+
+
+def test_analyze_synthetic_trace(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        # skipped: top-level step + while wrappers
+        {"ph": "X", "pid": 3, "name": "jit_step_fn(123)", "dur": 1e6},
+        {"ph": "X", "pid": 3, "name": "while.6", "dur": 9e5},
+        # counted ops (2 steps -> halved per step)
+        {"ph": "X", "pid": 3, "name": "fusion.1", "dur": 2000.0},
+        {"ph": "X", "pid": 3, "name": "copy.2", "dur": 4000.0},
+        # CPU lane ignored
+        {"ph": "X", "pid": 9, "name": "fusion.9", "dur": 5e6},
+    ]
+    p = d / "vm.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    assert find_trace(str(tmp_path)) == str(p)
+    out = analyze(str(p), steps=2, top=5)
+    assert out["total_ms_per_step"] == 3.0  # (2000+4000)us / 2 steps
+    assert out["categories_ms_per_step"] == {
+        "copy/reshape/pad": 2.0, "elementwise/reduce fusions": 1.0,
+    }
+    assert out["top_ops_ms_per_step"]["copy.2"] == 2.0
