@@ -117,6 +117,13 @@ class ModelConfig:
     # "pallas" (fused VMEM kernels, ops/pallas/) ---
     ssm_impl: str = "xla"
 
+    # --- LM-head + CE formulation: "dense" (one head matmul, logits
+    # materialized once in bf16) or "blocked" (vocab-blocked online
+    # logsumexp, ops/loss.py — no (b, t, V) tensor ever exists; frees
+    # ~0.8 GB at B=8 / ~3.3 GB at the reference's B=32) ---
+    loss_impl: str = "dense"
+    loss_vocab_blocks: int = 8
+
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
             raise ValueError(
@@ -136,6 +143,19 @@ class ModelConfig:
             raise ValueError(
                 f"attn_sp_impl must be 'ring' or 'ulysses', got "
                 f"{self.attn_sp_impl!r}"
+            )
+        if self.loss_impl not in ("dense", "blocked"):
+            raise ValueError(
+                f"loss_impl must be 'dense' or 'blocked', got "
+                f"{self.loss_impl!r}"
+            )
+        if self.loss_impl == "blocked" and (
+            self.loss_vocab_blocks < 1
+            or self.vocab_size_padded % self.loss_vocab_blocks != 0
+        ):
+            raise ValueError(
+                f"loss_vocab_blocks={self.loss_vocab_blocks} must be a "
+                f"positive divisor of padded vocab {self.vocab_size_padded}"
             )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(

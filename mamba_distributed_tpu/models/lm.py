@@ -231,24 +231,40 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
     return hidden, residual
 
 
-def _final_logits(params, cfg: ModelConfig, hidden, residual):
-    """Final fused add+norm -> (tied) LM head, fp32-accumulated.
-
-    ``hidden=None`` means ``residual`` is already the post-add stream
-    (single-carry form) and only the final norm is applied.
-    """
-    compute_dtype = jnp.dtype(cfg.compute_dtype)
-    residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+def _final_norm(params, cfg: ModelConfig, hidden, residual):
+    """Final (fused add+)norm of the stream.  ``hidden=None`` means
+    ``residual`` is already the post-add stream (single-carry form) and
+    only the norm is applied.  Shared by _final_logits and the blocked-CE
+    loss path so their numerics cannot diverge."""
+    residual_dtype = (
+        jnp.float32 if cfg.residual_in_fp32 else jnp.dtype(cfg.compute_dtype)
+    )
     if hidden is None:
-        normed = rms_norm(
+        return rms_norm(
             residual.astype(residual_dtype), params["norm_f"]["weight"],
             cfg.norm_eps,
         )
-    else:
-        normed, _ = add_rms_norm(
-            hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
-            residual_dtype=residual_dtype,
-        )
+    normed, _ = add_rms_norm(
+        hidden, residual, params["norm_f"]["weight"], cfg.norm_eps,
+        residual_dtype=residual_dtype,
+    )
+    return normed
+
+
+def _head_matrix(params, cfg: ModelConfig):
+    """(V, d) LM-head matrix: the tied embedding, or the lm_head kernel
+    transposed (bias-free by construction — init_lm_params builds it with
+    ``init_linear(..., bias=False)``)."""
+    if cfg.tie_embeddings:
+        return params["embedding"]
+    assert "bias" not in params["lm_head"], "blocked CE assumes no head bias"
+    return params["lm_head"]["kernel"].T
+
+
+def _final_logits(params, cfg: ModelConfig, hidden, residual):
+    """Final fused add+norm -> (tied) LM head, fp32-accumulated."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    normed = _final_norm(params, cfg, hidden, residual)
     if cfg.tie_embeddings:
         return jnp.dot(
             normed.astype(compute_dtype),
@@ -331,20 +347,16 @@ def init_lm_params(key: jax.Array, cfg: ModelConfig) -> dict:
     return params
 
 
-def lm_forward(
+def _backbone(
     params: dict,
     cfg: ModelConfig,
     input_ids: jax.Array,
     num_last_tokens: int = 0,
     seq_ctx=None,
-    return_aux: bool = False,
 ):
-    """input_ids (b, t) int32 -> logits (b, t[, num_last_tokens], V) bf16.
-
-    ``return_aux=True`` additionally returns the per-MoE-layer mean of
-    the load-balance aux loss (0.0 for dense models) — what lm_loss
-    folds in with weight ``cfg.moe_aux_weight``.
-    """
+    """Embedding -> layer stack.  Returns (post-add fp32 stream, aux sum) —
+    everything before the final norm + LM head (shared by lm_forward and
+    the blocked-CE loss path)."""
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
     hidden = params["embedding"][input_ids].astype(compute_dtype)
@@ -439,9 +451,28 @@ def lm_forward(
 
     if num_last_tokens > 0:
         res = res[:, -num_last_tokens:]
+    return res, aux_total
+
+
+def lm_forward(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jax.Array,
+    num_last_tokens: int = 0,
+    seq_ctx=None,
+    return_aux: bool = False,
+):
+    """input_ids (b, t) int32 -> logits (b, t[, num_last_tokens], V) bf16.
+
+    ``return_aux=True`` additionally returns the per-MoE-layer mean of
+    the load-balance aux loss (0.0 for dense models) — what lm_loss
+    folds in with weight ``cfg.moe_aux_weight``.
+    """
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    res, aux_total = _backbone(params, cfg, input_ids, num_last_tokens, seq_ctx)
     logits = _final_logits(params, cfg, None, res).astype(compute_dtype)
     if return_aux:
-        n_moe = cfg.n_layer if moe else 1
+        n_moe = cfg.n_layer if cfg.moe_num_experts else 1
         return logits, aux_total / n_moe
     return logits
 
@@ -459,14 +490,32 @@ def lm_loss(
     Formulated as ``logsumexp - gathered logit`` rather than materializing
     ``log_softmax`` — the dense (b, t, V) fp32 log-prob tensor (1.6 GB at
     the 280M recipe) never exists; only the two reductions over V do.
+
+    ``cfg.loss_impl="blocked"`` goes further: the LM-head matmul runs
+    vocab-block-by-block under an online logsumexp (ops/loss.py), so even
+    the (b, t, V) *bf16 logits* tensor (824 MB at the 280M recipe, 3.3 GB
+    at the reference's B=32) never exists — forward or backward.
     """
-    logits, aux = lm_forward(
-        params, cfg, input_ids, seq_ctx=seq_ctx, return_aux=True
-    )
-    lf = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(lf, axis=-1)
-    tgt = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
-    ce = jnp.mean(lse - tgt)
+    if cfg.loss_impl == "blocked":
+        from mamba_distributed_tpu.ops.loss import blocked_cross_entropy
+
+        res, aux = _backbone(params, cfg, input_ids, seq_ctx=seq_ctx)
+        ce = blocked_cross_entropy(
+            _final_norm(params, cfg, None, res),
+            _head_matrix(params, cfg),
+            targets,
+            n_blocks=cfg.loss_vocab_blocks,
+            compute_dtype=jnp.dtype(cfg.compute_dtype),
+        )
+        aux = aux / (cfg.n_layer if cfg.moe_num_experts else 1)
+    else:
+        logits, aux = lm_forward(
+            params, cfg, input_ids, seq_ctx=seq_ctx, return_aux=True
+        )
+        lf = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        tgt = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
+        ce = jnp.mean(lse - tgt)
     if cfg.moe_num_experts:
         return ce + cfg.moe_aux_weight * aux
     return ce

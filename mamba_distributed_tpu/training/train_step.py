@@ -43,6 +43,13 @@ def make_train_step(
         return lm_loss(p, model_cfg, x, y, seq_ctx=seq_ctx)
 
     pipe = cfg.mesh.pipe
+    if pipe > 1 and model_cfg.loss_impl == "blocked":
+        # lm_loss_pipelined runs the dense head; failing loudly beats
+        # silently losing the memory saving the flag was set for
+        raise NotImplementedError(
+            "loss_impl='blocked' is not implemented for pipeline "
+            "parallelism (mesh.pipe > 1) — use the dense loss there"
+        )
 
     def step_fn(params, opt_state, x, y):
         accum = x.shape[0]

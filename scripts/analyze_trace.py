@@ -68,11 +68,15 @@ def analyze(trace_path: str, steps: int, top: int) -> dict:
         and e.get("name") == "process_name"
         and "TPU" in e["args"].get("name", "")
     }
+    # one device lane only: multi-chip traces run the same ops on every
+    # lane concurrently, and summing across lanes would report N-chip
+    # inflated per-step times
+    lane = min(device_pids) if device_pids else None
     agg: collections.Counter = collections.Counter()
     cats: collections.Counter = collections.Counter()
     total = 0.0
     for e in events:
-        if e.get("ph") != "X" or "dur" not in e or e.get("pid") not in device_pids:
+        if e.get("ph") != "X" or "dur" not in e or e.get("pid") != lane:
             continue
         name = e["name"]
         if _SKIP.match(name):
@@ -82,6 +86,7 @@ def analyze(trace_path: str, steps: int, top: int) -> dict:
         cats[categorize(name)] += e["dur"]
     return {
         "trace": trace_path,
+        "device_lanes": len(device_pids),
         "steps": steps,
         "total_ms_per_step": round(total / steps / 1e3, 1),
         "categories_ms_per_step": {

@@ -41,11 +41,18 @@ def test_analyze_synthetic_trace(tmp_path):
         # CPU lane ignored
         {"ph": "X", "pid": 9, "name": "fusion.9", "dur": 5e6},
     ]
+    # a second TPU lane must NOT inflate the totals
+    events += [
+        {"ph": "M", "pid": 4, "name": "process_name",
+         "args": {"name": "/device:TPU:1"}},
+        {"ph": "X", "pid": 4, "name": "fusion.1", "dur": 2000.0},
+    ]
     p = d / "vm.trace.json.gz"
     with gzip.open(p, "wt") as f:
         json.dump({"traceEvents": events}, f)
     assert find_trace(str(tmp_path)) == str(p)
     out = analyze(str(p), steps=2, top=5)
+    assert out["device_lanes"] == 2  # both found, one analyzed
     assert out["total_ms_per_step"] == 3.0  # (2000+4000)us / 2 steps
     assert out["categories_ms_per_step"] == {
         "copy/reshape/pad": 2.0, "elementwise/reduce fusions": 1.0,

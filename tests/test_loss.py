@@ -1,0 +1,93 @@
+"""Vocab-blocked cross-entropy (ops/loss.py) vs the dense formulation."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.models.lm import init_lm_params, lm_loss
+from mamba_distributed_tpu.ops.loss import blocked_cross_entropy
+
+
+def test_op_matches_naive_fp32():
+    k = jax.random.PRNGKey(0)
+    normed = jax.random.normal(k, (2, 8, 16), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 32)
+
+    def naive(n, h):
+        logits = n @ h.T
+        lse = jax.nn.logsumexp(logits, -1)
+        tl = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return jnp.mean(lse - tl)
+
+    l_b = blocked_cross_entropy(normed, head, tgt, 4, jnp.float32)
+    np.testing.assert_allclose(float(l_b), float(naive(normed, head)),
+                               rtol=1e-6)
+    g_b = jax.grad(
+        lambda n, h: blocked_cross_entropy(n, h, tgt, 4, jnp.float32),
+        argnums=(0, 1),
+    )(normed, head)
+    g_n = jax.grad(naive, argnums=(0, 1))(normed, head)
+    for a, b in zip(g_b, g_n):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_block_count_invariance():
+    k = jax.random.PRNGKey(3)
+    normed = jax.random.normal(k, (1, 6, 8), jnp.float32)
+    head = jax.random.normal(jax.random.PRNGKey(4), (24, 8), jnp.float32)
+    tgt = jax.random.randint(jax.random.PRNGKey(5), (1, 6), 0, 24)
+    l1 = blocked_cross_entropy(normed, head, tgt, 1, jnp.float32)
+    l3 = blocked_cross_entropy(normed, head, tgt, 3, jnp.float32)
+    np.testing.assert_allclose(float(l1), float(l3), rtol=1e-6)
+
+
+@pytest.mark.parametrize("tied", [True, False])
+def test_model_blocked_matches_dense(tied):
+    cfg_d = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=60, d_state=16, chunk_size=8,
+        remat=False, loss_vocab_blocks=4, tie_embeddings=tied,
+    )
+    cfg_b = dataclasses.replace(cfg_d, loss_impl="blocked")
+    p = init_lm_params(jax.random.PRNGKey(0), cfg_d)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, 60)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 24), 0, 60)
+    l1, g1 = jax.value_and_grad(lm_loss)(p, cfg_d, x, y)
+    l2, g2 = jax.value_and_grad(lm_loss)(p, cfg_b, x, y)
+    # same bf16 logit round-trip -> loss matches tightly; grads to bf16
+    # accumulation-order tolerance
+    np.testing.assert_allclose(float(l1), float(l2), atol=1e-5, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-2
+        )
+
+
+def test_model_blocked_moe_aux_included():
+    cfg = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, d_state=16, chunk_size=8,
+        remat=False, loss_vocab_blocks=4, d_intermediate=64,
+        moe_num_experts=2, moe_top_k=1,
+    )
+    cfg_b = dataclasses.replace(cfg, loss_impl="blocked")
+    p = init_lm_params(jax.random.PRNGKey(0), cfg)
+    x = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    y = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+    np.testing.assert_allclose(
+        float(lm_loss(p, cfg, x, y)), float(lm_loss(p, cfg_b, x, y)),
+        atol=1e-5, rtol=1e-6,
+    )
+
+
+def test_loss_impl_validation():
+    with pytest.raises(ValueError, match="loss_impl"):
+        ModelConfig(d_model=32, n_layer=2, vocab_size=64, d_state=16,
+                    chunk_size=8, loss_impl="bogus")
+    with pytest.raises(ValueError, match="loss_vocab_blocks"):
+        ModelConfig(d_model=32, n_layer=2, vocab_size=64, d_state=16,
+                    chunk_size=8, loss_impl="blocked", loss_vocab_blocks=7)

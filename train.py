@@ -56,6 +56,9 @@ def parse_args():
     p.add_argument("--chunk-size", type=int, default=None,
                    help="SSD chunk length (numerics-neutral perf knob; "
                         "larger chunks measured faster on v5e)")
+    p.add_argument("--loss-impl", choices=["dense", "blocked"], default=None,
+                   help="LM-head+CE formulation; blocked never "
+                        "materializes the (b, t, V) logits")
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first (TPU pods)")
     p.add_argument("--sample-prompt", default=None, metavar="TEXT",
@@ -124,6 +127,7 @@ def build_config(args):
             ("attn_sp_impl", args.attn_sp_impl),
             ("attn_impl", args.attn_impl),
             ("chunk_size", args.chunk_size),
+            ("loss_impl", args.loss_impl),
         ] if v is not None
     }
     if model_over:
