@@ -117,6 +117,11 @@ class ModelConfig:
     # "pallas" (fused VMEM kernels, ops/pallas/) ---
     ssm_impl: str = "xla"
 
+    # causal-conv formulation: "shift" (width shifted multiply-adds) or
+    # "xla_conv" (grouped conv_general_dilated — XLA's dedicated
+    # depthwise path; sweepable, same math)
+    conv_impl: str = "shift"
+
     # --- LM-head + CE formulation: "dense" (one head matmul, logits
     # materialized once in bf16) or "blocked" (vocab-blocked online
     # logsumexp, ops/loss.py — no (b, t, V) tensor ever exists; frees
@@ -143,6 +148,11 @@ class ModelConfig:
             raise ValueError(
                 f"attn_sp_impl must be 'ring' or 'ulysses', got "
                 f"{self.attn_sp_impl!r}"
+            )
+        if self.conv_impl not in ("shift", "xla_conv"):
+            raise ValueError(
+                f"conv_impl must be 'shift' or 'xla_conv', got "
+                f"{self.conv_impl!r}"
             )
         if self.loss_impl not in ("dense", "blocked"):
             raise ValueError(

@@ -110,6 +110,7 @@ def mamba1_mixer(
             activation="silu",
             initial_state=initial_conv_state,
             return_final_state=True,
+            impl=cfg.conv_impl,
         )
 
     x_db = linear(params["x_proj"], x, compute_dtype)

@@ -132,6 +132,7 @@ def mamba2_mixer(
             activation="silu",
             initial_state=initial_conv_state,
             return_final_state=True,
+            impl=cfg.conv_impl,
         )
     x, B, C = _split_xbc(xBC, cfg)
 

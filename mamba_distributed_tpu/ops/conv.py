@@ -26,6 +26,7 @@ def causal_conv1d(
     activation: str | None = "silu",
     initial_state: jax.Array | None = None,
     return_final_state: bool = False,
+    impl: str = "shift",
 ):
     """Causal depthwise conv over the time axis.
 
@@ -52,10 +53,27 @@ def causal_conv1d(
         assert initial_state.shape == (b, width - 1, d), initial_state.shape
         pad = initial_state.astype(x.dtype)
     xp = jnp.concatenate([pad, x], axis=1)  # (b, t + width - 1, d)
-    y = jnp.zeros((b, t, d), dtype=jnp.promote_types(x.dtype, jnp.float32))
-    for i in range(width):
-        # tap i sees input shifted by (width - 1 - i) steps into the past
-        y = y + xp[:, i : i + t, :].astype(y.dtype) * weight[:, i].astype(y.dtype)
+    if impl == "xla_conv":
+        # grouped conv_general_dilated — XLA's dedicated depthwise path,
+        # one op instead of `width` shifted adds.  Sweepable alternative:
+        # the round-4 trace showed the shifted-add formulation dragging
+        # the activation layout time-minor (pads/copies around the conv).
+        # XLA convs are cross-correlations, so tap order matches as-is.
+        y = jax.lax.conv_general_dilated(
+            xp.astype(jnp.float32),
+            weight.astype(jnp.float32)[:, None, :],  # OIW = (d, 1, width)
+            window_strides=(1,),
+            padding="VALID",
+            dimension_numbers=("NWC", "OIW", "NWC"),
+            feature_group_count=d,
+        )
+    elif impl == "shift":
+        y = jnp.zeros((b, t, d), dtype=jnp.promote_types(x.dtype, jnp.float32))
+        for i in range(width):
+            # tap i sees input shifted by (width - 1 - i) steps into the past
+            y = y + xp[:, i : i + t, :].astype(y.dtype) * weight[:, i].astype(y.dtype)
+    else:
+        raise ValueError(f"unsupported conv impl: {impl}")
     if bias is not None:
         y = y + bias.astype(y.dtype)
     if activation == "silu":

@@ -387,3 +387,31 @@ class TestSSD:
             np.asarray(jnp.stack(ys, 1)), np.asarray(y_ref), atol=1e-4
         )
         np.testing.assert_allclose(np.asarray(s), np.asarray(s_ref), atol=1e-4)
+
+
+class TestConvImpl:
+    def test_xla_conv_matches_shift(self, rng):
+        from mamba_distributed_tpu.ops.conv import causal_conv1d
+
+        keys = jax.random.split(rng, 4)
+        x = _rand(keys[0], (2, 16, 12))
+        w = _rand(keys[1], (12, 4))
+        bias = _rand(keys[2], (12,))
+        s0 = _rand(keys[3], (2, 3, 12))
+        for init in (None, s0):
+            y1, f1 = causal_conv1d(x, w, bias, "silu", init, True, "shift")
+            y2, f2 = causal_conv1d(x, w, bias, "silu", init, True, "xla_conv")
+            np.testing.assert_allclose(
+                np.asarray(y1), np.asarray(y2), atol=1e-5, rtol=1e-5
+            )
+            np.testing.assert_allclose(np.asarray(f1), np.asarray(f2))
+        g1 = jax.grad(lambda a, b_: jnp.sum(
+            causal_conv1d(a, b_, bias, "silu", impl="shift") ** 2
+        ), argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda a, b_: jnp.sum(
+            causal_conv1d(a, b_, bias, "silu", impl="xla_conv") ** 2
+        ), argnums=(0, 1))(x, w)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=1e-4, rtol=1e-4
+            )
