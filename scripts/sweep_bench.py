@@ -28,10 +28,18 @@ DEFAULT_CONFIGS = [
     {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "mixer",
      "chunk_size": 512},
     {"B": 8, "ssm_impl": "pallas", "remat": True, "remat_policy": "all"},
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all",
+     "conv_impl": "xla_conv"},
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all",
+     "loss_impl": "blocked"},
     {"B": 16, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
     {"B": 16, "ssm_impl": "xla", "remat": True, "remat_policy": "mixer",
      "chunk_size": 512},
     {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
+    # the reference's own batch recipe (train.py:43): blocked CE frees the
+    # 3.3 GB logits tensor that plausibly OOMed the B=32 compile in r4
+    {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all",
+     "loss_impl": "blocked", "chunk_size": 512},
     # hybrid (config-5 architecture, single-chip scale): does the flash
     # kernel beat the blockwise XLA scan on real hardware?
     {"preset": "hybrid-280m", "B": 8, "attn_impl": "xla"},
