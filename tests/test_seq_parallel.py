@@ -446,6 +446,17 @@ def test_full_model_loss_seq_sharded_matches(ctx):
     ))
 
 
+def test_full_model_blocked_loss_seq_sharded_matches(ctx):
+    """The vocab-blocked CE composes with sequence parallelism: its scan
+    over vocab blocks sees the seq-sharded normed stream like the dense
+    head does."""
+    _assert_sp_loss_matches(ctx, ModelConfig(
+        d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2", headdim=8,
+        chunk_size=16, d_state=16, compute_dtype="float32",
+        loss_impl="blocked", loss_vocab_blocks=4,
+    ))
+
+
 @pytest.mark.slow
 def test_full_model_hybrid_seq_sharded_matches(ctx):
     """Config-5 shape: SSM blocks + interleaved attention (ring under SP)
