@@ -96,7 +96,8 @@ def build_step(spec: dict):
     model_over = {
         k: spec[k]
         for k in ("ssm_impl", "attn_impl", "remat", "remat_policy",
-                  "chunk_size", "loss_impl", "conv_impl")
+                  "chunk_size", "loss_impl", "conv_impl",
+                  "residual_in_fp32")
         if k in spec
     }
     if model_over:
@@ -142,7 +143,8 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
     known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
-             "remat_policy", "chunk_size", "loss_impl", "conv_impl"}
+             "remat_policy", "chunk_size", "loss_impl", "conv_impl",
+             "residual_in_fp32"}
     unknown = set(spec) - known
     if unknown:
         raise KeyError(

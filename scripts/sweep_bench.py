@@ -36,6 +36,11 @@ DEFAULT_CONFIGS = [
     {"B": 16, "ssm_impl": "xla", "remat": True, "remat_policy": "mixer",
      "chunk_size": 512},
     {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all"},
+    # informational: bf16 residual stream (numerics-changing — the
+    # reference's residual_in_fp32=True is semantic; this row only
+    # quantifies what the fp32 stream costs)
+    {"B": 8, "ssm_impl": "xla", "remat": True, "remat_policy": "all",
+     "residual_in_fp32": False},
     # the reference's own batch recipe (train.py:43): blocked CE frees the
     # 3.3 GB logits tensor that plausibly OOMed the B=32 compile in r4
     {"B": 32, "ssm_impl": "xla", "remat": True, "remat_policy": "all",

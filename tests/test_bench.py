@@ -97,7 +97,7 @@ def test_sweep_default_configs_are_constructible():
     from mamba_distributed_tpu.config import get_preset
 
     known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
-             "remat_policy", "chunk_size", "loss_impl", "conv_impl"}
+             "remat_policy", "chunk_size", "loss_impl", "conv_impl", "residual_in_fp32"}
     for spec in DEFAULT_CONFIGS:
         assert set(spec) <= known, spec
         B = spec.get("B", bench.DEFAULT_B)
@@ -107,7 +107,8 @@ def test_sweep_default_configs_are_constructible():
                          total_batch_size=B * T)
         over = {k: spec[k] for k in
                 ("ssm_impl", "attn_impl", "remat", "remat_policy",
-                 "chunk_size", "loss_impl", "conv_impl") if k in spec}
+                 "chunk_size", "loss_impl", "conv_impl",
+                 "residual_in_fp32") if k in spec}
         if over:
             # ModelConfig.__post_init__ validates the values
             dataclasses.replace(cfg.model, **over)
