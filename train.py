@@ -59,6 +59,8 @@ def parse_args():
     p.add_argument("--loss-impl", choices=["dense", "blocked"], default=None,
                    help="LM-head+CE formulation; blocked never "
                         "materializes the (b, t, V) logits")
+    p.add_argument("--conv-impl", choices=["shift", "xla_conv"], default=None,
+                   help="causal-conv formulation (same math)")
     p.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() first (TPU pods)")
     p.add_argument("--sample-prompt", default=None, metavar="TEXT",
@@ -128,6 +130,7 @@ def build_config(args):
             ("attn_impl", args.attn_impl),
             ("chunk_size", args.chunk_size),
             ("loss_impl", args.loss_impl),
+            ("conv_impl", args.conv_impl),
         ] if v is not None
     }
     if model_over:
