@@ -39,6 +39,12 @@ BASELINE_T = 1024
 
 # shipped single-chip defaults (shared by time_config and _env_spec)
 DEFAULT_B = 8
+
+# ModelConfig fields a bench/sweep spec may override (single source of
+# truth for build_step, time_config, and the sweep-matrix validity test)
+MODEL_SPEC_KEYS = ("ssm_impl", "attn_impl", "remat", "remat_policy",
+                   "chunk_size", "loss_impl", "conv_impl",
+                   "residual_in_fp32")
 DEFAULT_T = BASELINE_T
 DEFAULT_PRESET = BASELINE_PRESET
 
@@ -93,13 +99,7 @@ def build_step(spec: dict):
     T = spec.get("T", DEFAULT_T)
     preset = spec.get("preset", DEFAULT_PRESET)
     cfg = get_preset(preset, micro_batch_size=B, seq_len=T, total_batch_size=B * T)
-    model_over = {
-        k: spec[k]
-        for k in ("ssm_impl", "attn_impl", "remat", "remat_policy",
-                  "chunk_size", "loss_impl", "conv_impl",
-                  "residual_in_fp32")
-        if k in spec
-    }
+    model_over = {k: spec[k] for k in MODEL_SPEC_KEYS if k in spec}
     if model_over:
         cfg = dataclasses.replace(
             cfg, model=dataclasses.replace(cfg.model, **model_over)
@@ -142,9 +142,7 @@ def time_config(spec: dict, iters: int = 10) -> dict:
     """
     from mamba_distributed_tpu.utils.flops import flops_per_token, peak_flops_per_chip
 
-    known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
-             "remat_policy", "chunk_size", "loss_impl", "conv_impl",
-             "residual_in_fp32"}
+    known = {"preset", "B", "T", *MODEL_SPEC_KEYS}
     unknown = set(spec) - known
     if unknown:
         raise KeyError(

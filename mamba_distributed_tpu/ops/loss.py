@@ -54,9 +54,8 @@ def _forward_scan(normed, head, targets, n_blocks, compute_dtype):
     bs = V // n_blocks
     blocks = head.reshape(n_blocks, bs, d)
 
-    def body(carry, blk):
+    def body(carry, head_blk):
         m, s, tgt, off = carry
-        head_blk, = blk
         logits = _block_logits(normed, head_blk, compute_dtype)  # (b,t,bs)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
@@ -75,7 +74,7 @@ def _forward_scan(normed, head, targets, n_blocks, compute_dtype):
         jnp.zeros((b, t), jnp.float32),
         jnp.zeros((), jnp.int32),
     )
-    (m, s, tgt, _), _ = jax.lax.scan(body, init, (blocks,))
+    (m, s, tgt, _), _ = jax.lax.scan(body, init, blocks)
     return m + jnp.log(s), tgt
 
 
@@ -92,9 +91,8 @@ def _bwd(n_blocks, compute_dtype, res, g):
     b, t = targets.shape
     scale = g / (b * t)  # d(mean)/d(per-position loss)
 
-    def body(carry, blk):
+    def body(carry, head_blk):
         dnormed, off = carry
-        head_blk, = blk
         logits = _block_logits(normed, head_blk, compute_dtype)
         p = jnp.exp(logits - lse[..., None])  # softmax block, fp32
         in_blk = (targets >= off) & (targets < off + bs)
@@ -115,7 +113,7 @@ def _bwd(n_blocks, compute_dtype, res, g):
         return (dnormed, off + bs), dblk
 
     init = (jnp.zeros(normed.shape, jnp.float32), jnp.zeros((), jnp.int32))
-    (dnormed, _), dhead = jax.lax.scan(body, init, (blocks,))
+    (dnormed, _), dhead = jax.lax.scan(body, init, blocks)
     return dnormed.astype(normed.dtype), dhead.reshape(V, d), None
 
 

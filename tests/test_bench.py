@@ -96,8 +96,7 @@ def test_sweep_default_configs_are_constructible():
     from sweep_bench import DEFAULT_CONFIGS
     from mamba_distributed_tpu.config import get_preset
 
-    known = {"preset", "B", "T", "ssm_impl", "attn_impl", "remat",
-             "remat_policy", "chunk_size", "loss_impl", "conv_impl", "residual_in_fp32"}
+    known = {"preset", "B", "T", *bench.MODEL_SPEC_KEYS}
     for spec in DEFAULT_CONFIGS:
         assert set(spec) <= known, spec
         B = spec.get("B", bench.DEFAULT_B)
@@ -105,10 +104,7 @@ def test_sweep_default_configs_are_constructible():
         cfg = get_preset(spec.get("preset", bench.DEFAULT_PRESET),
                          micro_batch_size=B, seq_len=T,
                          total_batch_size=B * T)
-        over = {k: spec[k] for k in
-                ("ssm_impl", "attn_impl", "remat", "remat_policy",
-                 "chunk_size", "loss_impl", "conv_impl",
-                 "residual_in_fp32") if k in spec}
+        over = {k: spec[k] for k in bench.MODEL_SPEC_KEYS if k in spec}
         if over:
             # ModelConfig.__post_init__ validates the values
             dataclasses.replace(cfg.model, **over)
