@@ -20,6 +20,13 @@ Env knobs (for sweeps; defaults are the shipped configuration):
   BENCH_REMAT_POLICY all | dots | mixer   (default preset's)
   BENCH_CHUNK_SIZE SSD chunk length       (default preset's)
   BENCH_ITERS      timed iterations       (default 10)
+  BENCH_CLAIM_ATTEMPTS  backend-claim attempts; each failed claim can
+                   block ~25 min in the axon relay (default 2; battery
+                   wrappers with their own retry loop set 1)
+  BENCH_CLAIM_RETRY_S   sleep between claim attempts (default 60)
+  BENCH_LAST_GOOD_PATH  where the on-chip default-recipe fallback record
+                   lives (default ./bench_last_good.json; emitted with
+                   provenance when the pool is unclaimable)
 """
 
 from __future__ import annotations
@@ -51,6 +58,10 @@ DEFAULT_PRESET = BASELINE_PRESET
 
 def _progress(msg: str) -> None:
     print(f"[bench +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _metric_name(preset: str) -> str:
+    return f"train_tokens_per_sec_per_chip_{preset.replace('-', '_')}"
 
 
 def init_backend():
@@ -206,20 +217,103 @@ def _env_spec() -> dict:
     return spec
 
 
-def _fail(stage: str, detail: str, device=None) -> None:
-    """Emit ONE parseable JSON error line and exit 1.
+# Written after every successful on-chip run; read back as the fallback
+# when the pooled TPU is unclaimable at driver time (VERDICT r4: the one
+# claim window of the round closed hours before the driver ran bench.py,
+# so BENCH_r04.json recorded null despite a full in-window battery).
+LAST_GOOD_PATH = os.environ.get(
+    "BENCH_LAST_GOOD_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_last_good.json"),
+)
+
+
+def _git_rev() -> str | None:
+    try:
+        import subprocess
+
+        return subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _record_last_good(out: dict) -> None:
+    rec = {**out,
+           "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "git_rev": _git_rev()}
+    try:
+        # atomic replace: a SIGTERM mid-write (battery timeout) must never
+        # truncate the only fallback record
+        tmp = LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.write("\n")
+        os.replace(tmp, LAST_GOOD_PATH)
+    except OSError as e:  # never let bookkeeping kill a good measurement
+        _progress(f"could not write {LAST_GOOD_PATH}: {e}")
+
+
+def _fail(stage: str, detail: str, device=None, fallback: bool = True,
+          spec: dict | None = None) -> None:
+    """Emit ONE parseable JSON line and exit.
 
     Every failure mode — above all backend init when the pooled TPU is
     unclaimable — must leave the driver a structured record, never a raw
-    traceback with `parsed: null` (VERDICT r3 weak #1).
+    traceback with `parsed: null` (VERDICT r3 weak #1).  If a previous
+    successful run left bench_last_good.json, that measurement is emitted
+    with provenance (`source: last_good@<timestamp>`) instead of a null
+    value, so a pool outage at driver time can't erase an in-window
+    result (VERDICT r4 next-round item 5); exit 0 in that case because
+    the line carries a real number.
     """
+    err = f"{stage}: {detail[:300]}"
+    last = None
+    if fallback:  # operator errors (bad env spec) must NOT emit stale numbers
+        try:
+            with open(LAST_GOOD_PATH) as f:
+                last = json.load(f)
+            if not isinstance(last, dict):
+                last = None
+        except (OSError, ValueError):
+            last = None
+        # only a record of the SAME benchmark may stand in: match on the
+        # metric name (preset) and seq_len — B is each run's own choice,
+        # like vs_baseline's per-chip comparison (module docstring) — and
+        # reject if any model-knob override (ssm_impl, chunk_size, ...)
+        # differs from what the record measured
+        if last is not None:
+            batch = last.get("batch")
+            rec_t = batch[1] if isinstance(batch, list) and len(batch) == 2 else None
+            if (spec is None
+                    or last.get("metric") != _metric_name(spec["preset"])
+                    or rec_t != spec["T"]
+                    or any(k in spec for k in MODEL_SPEC_KEYS)):
+                # records are only written for the pristine default spec,
+                # so any knob override in the request is a different
+                # benchmark — no stand-in
+                last = None
+    if last and last.get("value") is not None:
+        out = {
+            **last,
+            # git_rev (when present) stays top-level: the fallback number
+            # was measured on THAT commit, not necessarily the current one
+            "source": f"last_good@{last.get('measured_at', 'unknown')}",
+            "fallback_error": err,
+        }
+        out.pop("measured_at", None)
+        print(json.dumps(out), flush=True)
+        raise SystemExit(0)
     print(
         json.dumps(
             {
                 "metric": "train_tokens_per_sec_per_chip",
                 "value": None,
                 "unit": "tokens/sec/chip",
-                "error": f"{stage}: {detail[:300]}",
+                "error": err,
                 "device": device,
             }
         ),
@@ -229,22 +323,47 @@ def _fail(stage: str, detail: str, device=None) -> None:
 
 
 def main() -> None:
-    try:
-        dev = init_backend()
-    except Exception as e:
-        _fail("backend_unavailable", f"{type(e).__name__}: {e}")
+    # env parsing first: a malformed variable is an operator error and
+    # must emit its structured line BEFORE any ~25-min claim attempt
     try:
         spec = _env_spec()
         iters = int(os.environ.get("BENCH_ITERS", "10"))
+        attempts = max(1, int(os.environ.get("BENCH_CLAIM_ATTEMPTS", "2")))
+        retry_s = max(0, int(os.environ.get("BENCH_CLAIM_RETRY_S", "60")))
     except (SystemExit, ValueError) as e:
-        _fail("bad_env_spec", str(e), dev.device_kind)
+        _fail("bad_env_spec", str(e), fallback=False)
+
+    # Bounded claim retry: each failed claim blocks ~25 min inside the
+    # axon relay before raising, so the default keeps a second attempt
+    # only (BENCH_CLAIM_ATTEMPTS=1 for single-shot sweep wrappers).
+    # Only the pool-outage error class retries — a deterministic failure
+    # (bad platform, broken install) would just double the block.
+    dev = None
+    for i in range(attempts):
+        try:
+            dev = init_backend()
+            break
+        except Exception as e:
+            _progress(f"claim attempt {i + 1}/{attempts} failed: {e}")
+            retryable = "UNAVAILABLE" in str(e) or "DEADLINE" in str(e)
+            if i + 1 == attempts or not retryable:
+                # a deterministic failure (bad platform, broken install) is
+                # not an outage — masking it with a stale success would hide
+                # a permanently broken environment behind exit 0 forever
+                _fail("backend_unavailable", f"{type(e).__name__}: {e}",
+                      fallback=retryable, spec=spec)
+            time.sleep(retry_s)
     r = time_config(spec, iters=iters)
     if "error" in r:
-        print(json.dumps({"value": None, "device": dev.device_kind, **r}), flush=True)
+        # on-chip per-config failure (e.g. OOM): the chip WAS claimed and
+        # a fresh measurement failed — a stale success must not stand in.
+        # Echo the spec for attribution, like sweep rows do.
+        print(json.dumps({"value": None, "device": dev.device_kind, **r}),
+              flush=True)
         raise SystemExit(1)
 
     out = {
-        "metric": f"train_tokens_per_sec_per_chip_{spec['preset'].replace('-', '_')}",
+        "metric": _metric_name(spec["preset"]),
         "value": r["tok_per_sec"],
         "unit": "tokens/sec/chip",
         # two conventions (docs/KERNELS.md): the >=45% target is judged on
@@ -262,6 +381,14 @@ def main() -> None:
     # vs_baseline is only defined for the reference's model + seq_len
     if spec["preset"] == BASELINE_PRESET and spec["T"] == BASELINE_T:
         out["vs_baseline"] = round(r["tok_per_sec"] / BASELINE_TOK_PER_SEC, 4)
+    # the fallback record preserves the *on-chip, pristine-default-recipe*
+    # number across pool outages; a CPU smoke run, a non-baseline preset,
+    # or a knob-overridden sweep point must never clobber it
+    if ("tpu" in (dev.device_kind or dev.platform).lower()
+            and "vs_baseline" in out
+            and spec.get("B", DEFAULT_B) == DEFAULT_B
+            and not any(k in spec for k in MODEL_SPEC_KEYS)):
+        _record_last_good(out)
     print(json.dumps(out), flush=True)
 
 

@@ -19,15 +19,18 @@ def test_time_config_reports_errors_instead_of_raising():
     assert r["ssm_impl"] == "bogus"  # spec echoed for attribution
 
 
-def test_main_emits_structured_json_when_backend_unavailable(monkeypatch, capsys):
-    """A pool outage must produce one parseable JSON line, not a raw
-    traceback (the r2/r3 failure mode)."""
+def test_main_emits_structured_json_when_backend_unavailable(
+        monkeypatch, capsys, tmp_path):
+    """A pool outage with no prior measurement must produce one parseable
+    JSON line, not a raw traceback (the r2/r3 failure mode)."""
     import json
 
     def boom():
         raise RuntimeError("Unable to initialize backend 'axon': UNAVAILABLE")
 
     monkeypatch.setattr(bench, "init_backend", boom)
+    monkeypatch.setenv("BENCH_CLAIM_ATTEMPTS", "1")
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "missing.json"))
     with pytest.raises(SystemExit) as ei:
         bench.main()
     assert ei.value.code == 1
@@ -37,6 +40,75 @@ def test_main_emits_structured_json_when_backend_unavailable(monkeypatch, capsys
     assert rec["value"] is None and rec["device"] is None
     assert rec["error"].startswith("backend_unavailable: RuntimeError")
     assert "UNAVAILABLE" in rec["error"]
+
+
+def test_main_falls_back_to_last_good_on_outage(monkeypatch, capsys, tmp_path):
+    """With a recorded in-window measurement, a pool outage at driver time
+    emits that number with provenance and exits 0 (VERDICT r4 item 5:
+    BENCH_r05.json must carry a value even under an outage)."""
+    import json
+
+    last = {"metric": "train_tokens_per_sec_per_chip_mamba2_280m",
+            "value": 15437.4, "unit": "tokens/sec/chip",
+            "batch": [8, 1024],
+            "vs_baseline": 0.0887, "measured_at": "2026-07-31T07:35Z"}
+    p = tmp_path / "last_good.json"
+    p.write_text(json.dumps(last))
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE")
+
+    monkeypatch.setattr(bench, "init_backend", boom)
+    monkeypatch.setenv("BENCH_CLAIM_ATTEMPTS", "1")
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(p))
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 0  # the line carries a real number
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["value"] == 15437.4
+    assert rec["source"] == "last_good@2026-07-31T07:35Z"
+    assert rec["fallback_error"].startswith("backend_unavailable")
+    assert "measured_at" not in rec  # folded into source
+
+
+def test_committed_last_good_is_valid():
+    """The committed fallback record must parse, carry a number, and match
+    the shipped default spec (metric + T) — otherwise the driver-outage
+    path degrades back to null."""
+    import json
+
+    with open(os.path.join(REPO, "bench_last_good.json")) as f:
+        rec = json.load(f)
+    assert rec["value"] and rec["unit"] == "tokens/sec/chip"
+    assert rec["measured_at"]
+    assert "vs_baseline" in rec
+    assert rec["metric"] == bench._metric_name(bench.DEFAULT_PRESET)
+    assert rec["batch"][1] == bench.DEFAULT_T
+
+
+def test_fallback_rejects_mismatched_spec(monkeypatch, capsys, tmp_path):
+    """A last-good record for a different preset/seq_len must NOT stand in
+    for the requested benchmark (code-review r5 finding)."""
+    import json
+
+    last = {"metric": "train_tokens_per_sec_per_chip_mamba2_280m",
+            "value": 15437.4, "unit": "tokens/sec/chip",
+            "batch": [8, 1024], "measured_at": "2026-07-31T07:35Z"}
+    p = tmp_path / "last_good.json"
+    p.write_text(json.dumps(last))
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE")
+
+    monkeypatch.setattr(bench, "init_backend", boom)
+    monkeypatch.setenv("BENCH_CLAIM_ATTEMPTS", "1")
+    monkeypatch.setenv("BENCH_T", "4096")  # mismatched seq_len
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(p))
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 1
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["value"] is None  # no stale stand-in for a different spec
 
 
 def test_flops_conventions():
