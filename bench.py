@@ -27,6 +27,9 @@ Env knobs (for sweeps; defaults are the shipped configuration):
   BENCH_LAST_GOOD_PATH  where the on-chip default-recipe fallback record
                    lives (default ./bench_last_good.json; emitted with
                    provenance when the pool is unclaimable)
+  BENCH_NO_FALLBACK=1   disable the last-good stand-in entirely (battery
+                   wrappers want a clean exit-1 outage signal; the
+                   fallback exists for the driver's end-of-round run)
 """
 
 from __future__ import annotations
@@ -272,6 +275,8 @@ def _fail(stage: str, detail: str, device=None, fallback: bool = True,
     """
     err = f"{stage}: {detail[:300]}"
     last = None
+    if os.environ.get("BENCH_NO_FALLBACK") == "1":
+        fallback = False
     if fallback:  # operator errors (bad env spec) must NOT emit stale numbers
         try:
             with open(LAST_GOOD_PATH) as f:
