@@ -49,6 +49,11 @@ def _decode_params(params: dict, cfg: ModelConfig) -> dict:
     cd = jnp.dtype(cfg.compute_dtype)
 
     def cast(path, leaf):
+        # denylist contract: every "kernel" leaf is a bf16-matmul weight
+        # UNLESS its parent is named here because its math must stay fp32.
+        # Adding a new fp32-math matmul param under a new key REQUIRES
+        # extending this tuple + test_decode_params_cast_selectivity
+        # (tests/test_inference.py), which pins the casted/uncasted split.
         keys = [getattr(p, "key", None) for p in path]
         if keys and keys[-1] == "embedding":
             return leaf.astype(cd)

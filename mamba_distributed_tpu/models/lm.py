@@ -257,7 +257,11 @@ def _head_matrix(params, cfg: ModelConfig):
     ``init_linear(..., bias=False)``)."""
     if cfg.tie_embeddings:
         return params["embedding"]
-    assert "bias" not in params["lm_head"], "blocked CE assumes no head bias"
+    if "bias" in params["lm_head"]:  # not an assert: must survive python -O
+        raise ValueError(
+            "blocked CE assumes a bias-free lm_head; a bias would be "
+            "silently ignored, training against a wrong loss"
+        )
     return params["lm_head"]["kernel"].T
 
 

@@ -114,7 +114,10 @@ def _bwd(n_blocks, compute_dtype, res, g):
 
     init = (jnp.zeros(normed.shape, jnp.float32), jnp.zeros((), jnp.int32))
     (dnormed, _), dhead = jax.lax.scan(body, init, blocks)
-    return dnormed.astype(normed.dtype), dhead.reshape(V, d), None
+    # cast to head.dtype: custom_vjp cotangents must match the primal aval,
+    # and head params may one day be held in bf16 (ADVICE r4)
+    return (dnormed.astype(normed.dtype),
+            dhead.reshape(V, d).astype(head.dtype), None)
 
 
 blocked_cross_entropy.defvjp(_fwd, _bwd)

@@ -42,14 +42,25 @@ def resolve_attn_impl(impl: str) -> str:
     CPU (tests, debugging) auto picks "xla" to avoid paying for the
     Pallas interpreter in composed graphs.
 
-    ``MDT_PALLAS_INTERPRET`` overrides the device probe the same way it
-    does for ``resolve_interpret``: "0" (the chip-free ``jax.export``
-    TPU-lowering pattern) resolves auto to "pallas" so CPU-host exports
-    targeting TPU bake in the kernels they'd get on hardware; "1" forces
-    the XLA path.
+    ``MDT_ATTN_IMPL`` ("xla" | "pallas") overrides the probe directly and
+    keeps the env contract single-purpose (ADVICE r4: overloading
+    ``MDT_PALLAS_INTERPRET`` here was easy to misread).  Failing that,
+    ``MDT_PALLAS_INTERPRET`` still steers auto for backwards
+    compatibility — note the asymmetry: env=1 means "interpret Pallas
+    kernels" for ``resolve_interpret`` but resolves *attention* to the
+    XLA path, so ssm_impl="pallas" + attn_impl="auto" under env=1 runs
+    interpreted SSM kernels next to XLA attention.  "0" (the chip-free
+    ``jax.export`` TPU-lowering pattern) resolves auto to "pallas" so
+    CPU-host exports targeting TPU bake in the kernels they'd get on
+    hardware.
     """
     if impl != "auto":
         return impl
+    env = os.environ.get("MDT_ATTN_IMPL")
+    if env is not None:
+        if env not in ("xla", "pallas"):
+            raise ValueError(f"MDT_ATTN_IMPL must be xla|pallas, got {env!r}")
+        return env
     env = os.environ.get("MDT_PALLAS_INTERPRET")
     if env is not None:
         return "xla" if env != "0" else "pallas"

@@ -1,9 +1,11 @@
-"""Pipeline parallelism over the layer stack (prototype).
+"""Pipeline parallelism over the layer stack.
 
 Neither the reference nor any BASELINE configuration uses pipeline
-parallelism (SURVEY.md §2.3 lists it "out of scope"); this module exists
-so the framework covers the full parallelism menu.  It is deliberately
-standalone — nothing in the trainer depends on it.
+parallelism (SURVEY.md §2.3 lists it "out of scope"); it is part of the
+framework's full parallelism menu.  The trainer wires it in whenever the
+mesh has a ``pipe`` axis > 1 (training/train_step.py builds the train
+step around :func:`pipelined_layers`, composing with data parallelism;
+``__graft_entry__.dryrun_multichip`` exercises that path end-to-end).
 
 TPU-idiomatic formulation: the scan-over-layers parameter stack is
 sharded on its *layer* axis over a ``stage`` mesh axis, and a GPipe-style

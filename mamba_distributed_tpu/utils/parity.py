@@ -71,6 +71,54 @@ def _first_n_train(log: dict, n: int) -> list[float]:
     return [seen[s] for s in sorted(seen)]
 
 
+def _val_at(log: dict, step: int) -> float | None:
+    for s, loss in log["val"]:
+        if s == step:
+            return loss
+    return None
+
+
+def _val_checkpoint_check(
+    ours: dict, ref: dict, step: int, mode: str, tol: float,
+    min_drop_frac: float,
+) -> tuple[str, bool, str] | None:
+    """Score a shared val checkpoint (the reference logs val every 250
+    steps: ``250 val 5.4865`` is the first, /root/reference/log/
+    log_mamba.txt).  Returns None when the reference has no val point at
+    ``step`` (nothing to score against)."""
+    ref_v = _val_at(ref, step)
+    if ref_v is None:
+        return None
+    our_v = _val_at(ours, step)
+    name = f"val@{step}"
+    if our_v is None or not math.isfinite(our_v):
+        return (name, False, f"ours has no finite val point at step {step} "
+                f"(ref {ref_v:.4f})")
+    if mode == "strict":
+        ok = abs(our_v - ref_v) <= tol
+        return (name, ok, f"ours {our_v:.4f} vs ref {ref_v:.4f} "
+                f"(|diff| {abs(our_v - ref_v):.4f} <= {tol})")
+    # fingerprint: data/scale differ, so score the *relative* fall from
+    # the t=0 val loss against the reference's fall.  A log without the
+    # val@0 anchor cannot be scored — fail loud rather than degrade to a
+    # near-no-op magnitude bound (r5 review).
+    ref0, our0 = _val_at(ref, 0), _val_at(ours, 0)
+    if ref0 is None or our0 is None:
+        return (name, False,
+                f"ours {our_v:.4f} vs ref {ref_v:.4f} — missing the val@0 "
+                "anchor needed to normalize the fall (run with val_every "
+                "covering step 0)")
+    ref_drop = ref0 - ref_v
+    our_drop = our0 - our_v
+    frac = our_drop / ref_drop if ref_drop > 0 else float("nan")
+    ok = frac >= min_drop_frac
+    return (name, ok,
+            f"ours fell {our_drop:.3f} ({our0:.3f}->{our_v:.3f}) vs ref "
+            f"{ref_drop:.3f} ({ref0:.3f}->{ref_v:.3f}): {frac:.0%} >= "
+            f"{min_drop_frac:.0%}; data/scale differ so the relative "
+            "fall is the comparable quantity")
+
+
 def compare_strict(
     ours: dict, ref: dict, steps: int = 30, tol: float = 0.35
 ) -> ParityResult:
@@ -96,6 +144,11 @@ def compare_strict(
             ("per-step |loss diff|", ok,
              f"max {worst:.4f} at step {at} (tol {tol})")
         )
+    # inclusive endpoint: --steps 250 must score the val@250 checkpoint
+    for ckpt in range(250, steps + 1, 250):
+        c = _val_checkpoint_check(ours, ref, ckpt, "strict", tol, 0.0)
+        if c:
+            checks.append(c)
     ok_all = all(p for _, p, _ in checks)
     return ParityResult(ok_all, "strict", n, checks)
 
@@ -150,6 +203,14 @@ def compare_fingerprint(
          "differs (synthetic zipf vs FineWeb) so only the order of "
          "magnitude is comparable")
     )
+    # score every val checkpoint inside the compared window, endpoint
+    # inclusive (the reference's cadence is 250: first ``250 val 5.4865``)
+    for ckpt in range(250, steps + 1, 250):
+        c = _val_checkpoint_check(
+            ours, ref, ckpt, "fingerprint", 0.0, min_drop_frac
+        )
+        if c:
+            checks.append(c)
     ok_all = all(p for _, p, _ in checks)
     return ParityResult(ok_all, "fingerprint", n, checks)
 

@@ -41,6 +41,11 @@ run 2400 bench  "$OUT/bench_result.json" \
 # docs/KERNELS.md records the bet; the trace under $OUT/profile decides it
 run 2400 profile "$OUT/profile_step.log"   \
   env PROFILE_DIR="$OUT/profile" python scripts/profile_step.py
+# Pallas-SSM trace: the evidence for VERDICT r5's beat-or-retire call on
+# the SSD kernels (where do the extra ~330 ms/step go vs the XLA path?)
+run 2400 profile_pallas "$OUT/profile_pallas.log" \
+  env PROFILE_DIR="$OUT/profile_pallas" BENCH_SSM_IMPL=pallas \
+  python scripts/profile_step.py
 
 # Assemble the report.  Each section header carries the stage STATUS so a
 # partially-failed battery is legible; if ANY stage failed the report goes
@@ -80,6 +85,12 @@ for s in "${STATUS[@]}"; do [ "$s" = FAILED ] && DEST=MEASUREMENTS_partial.md; d
   echo '```'
   tail -5 "$OUT/profile_step.log" 2>/dev/null
   echo "trace dir: $OUT/profile"
+  echo '```'
+  echo
+  echo "## Pallas-SSM profiler trace (beat-or-retire evidence) — ${STATUS[profile_pallas]:-not-run}"
+  echo '```'
+  tail -5 "$OUT/profile_pallas.log" 2>/dev/null
+  echo "trace dir: $OUT/profile_pallas"
   echo '```'
 } > "$DEST"
 echo "$(date -u +%H:%M:%S) battery complete -> $DEST" >&2

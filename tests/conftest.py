@@ -25,6 +25,23 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Refuse a fast+slow double-mark at collection time: ``-m fast`` is
+    the sub-2-minute tier, and pytest's -m matches ANY marker on the item,
+    so a module-level fast mark on a file with slow tests would silently
+    drag them in (modules with slow tests must mark fast per-test)."""
+    both = [
+        item.nodeid for item in items
+        if item.get_closest_marker("slow") is not None
+        and item.get_closest_marker("fast") is not None
+    ]
+    if both:  # not an assert: must survive python -O
+        raise pytest.UsageError(
+            f"tests marked BOTH fast and slow (mark fast per-test in "
+            f"modules that contain slow tests): {both[:5]}"
+        )
+
+
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)

@@ -10,6 +10,10 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from analyze_trace import analyze, categorize, find_trace  # noqa: E402
 
+import pytest
+
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
+
 
 def test_categorize_rules():
     assert categorize("convolution_convert_fusion.15") == "matmul fusions"

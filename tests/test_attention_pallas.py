@@ -157,3 +157,22 @@ def test_resolve_attn_impl_auto(monkeypatch):
     assert resolve_attn_impl("auto") == "pallas"
     monkeypatch.setenv("MDT_PALLAS_INTERPRET", "1")
     assert resolve_attn_impl("auto") == "xla"
+
+
+def test_resolve_attn_impl_dedicated_env_override(monkeypatch):
+    """MDT_ATTN_IMPL beats the MDT_PALLAS_INTERPRET heuristic (ADVICE r4:
+    keep the interpret env var single-purpose), and rejects junk."""
+    import pytest
+
+    from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
+
+    monkeypatch.setenv("MDT_PALLAS_INTERPRET", "1")  # would say "xla"
+    monkeypatch.setenv("MDT_ATTN_IMPL", "pallas")
+    assert resolve_attn_impl("auto") == "pallas"
+    monkeypatch.setenv("MDT_ATTN_IMPL", "xla")
+    assert resolve_attn_impl("auto") == "xla"
+    # explicit impl is never overridden by env
+    assert resolve_attn_impl("pallas") == "pallas"
+    monkeypatch.setenv("MDT_ATTN_IMPL", "triton")
+    with pytest.raises(ValueError, match="MDT_ATTN_IMPL"):
+        resolve_attn_impl("auto")

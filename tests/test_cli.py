@@ -4,7 +4,6 @@ Everything runs as real subprocesses on the CPU backend, zero-egress
 (toy BPE files, toy HellaSwag jsonl) — the same drive the verify recipe
 does by hand (.claude/skills/verify/SKILL.md)."""
 
-import json
 import os
 import subprocess
 import sys
@@ -69,21 +68,22 @@ def test_cli_train_generate_eval_roundtrip(tmp_path):
     assert p.returncode == 0, p.stderr[-2000:]
     assert p.stdout.strip().startswith(">")
 
-    # --- HellaSwag CLI on a toy jsonl ---
-    hs = tmp_path / "hs.jsonl"
-    with open(hs, "w") as f:
-        for i in range(3):
-            f.write(json.dumps({
-                "ctx": "the cat", "label": i % 4,
-                "endings": ["sat", "ran", "flew", "swam"],
-            }) + "\n")
+    # --- HellaSwag CLI on the committed synthetic jsonl, emitting a real
+    # acc_norm line (VERDICT r4 item 7) ---
+    import re
+
+    hs = os.path.join(REPO, "tests", "data", "hellaswag_tiny.jsonl")
     p = _run(
         ["eval.py", "-m", "custom", "--checkpoint", str(tmp_path / "ckpt"),
-         "--preset", "mamba2-tiny", "--data-file", str(hs),
-         "--bpe-dir", str(bpe), "--limit", "3",
+         "--preset", "mamba2-tiny", "--data-file", hs,
+         "--bpe-dir", str(bpe), "--limit", "16",
          "--log-file", str(tmp_path / "hs_out.txt")],
         env,
     )
     assert p.returncode == 0, p.stderr[-2000:]
-    out = (tmp_path / "hs_out.txt").read_text().split()
-    assert out[0] == "3"  # reference log-line format: "N correct/N acc"
+    assert "acc_norm" in p.stdout  # result dict printed by eval.py
+    line = (tmp_path / "hs_out.txt").read_text()
+    # exact reference writer format (ref eval.py:180-183 appends
+    # f"{total} {correct_norm}/{total} {acc_norm:.4f}", sample artifact
+    # "2000 648/2000 0.3240")
+    assert re.fullmatch(r"16 \d{1,2}/16 [01]\.\d{4}", line), repr(line)

@@ -9,6 +9,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
+
 from mamba_distributed_tpu.data import ShardedTokenLoader, ensure_synthetic_shards
 
 

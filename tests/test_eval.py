@@ -8,8 +8,11 @@ argmin, cap — are tokenizer-independent (reference eval.py:72-183).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mamba_distributed_tpu.eval import evaluate_hellaswag, render_example
+
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
 
 
 def fake_encode(text: str) -> list[int]:

@@ -12,6 +12,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
+
 from mamba_distributed_tpu.data.gpt2_bpe import (
     GPT2BPE,
     bytes_to_unicode,

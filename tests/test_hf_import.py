@@ -11,6 +11,8 @@ import dataclasses
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
+
 torch = pytest.importorskip("torch")
 
 from mamba_distributed_tpu.config import ModelConfig

@@ -68,6 +68,35 @@ def test_model_blocked_matches_dense(tied):
         )
 
 
+def test_blocked_head_bias_raises_valueerror():
+    """A biased lm_head under blocked CE must fail loud even under
+    python -O (ValueError, not assert — ADVICE r4)."""
+    from mamba_distributed_tpu.models.lm import _head_matrix
+
+    cfg = ModelConfig(
+        d_model=32, n_layer=2, vocab_size=60, d_state=16, chunk_size=8,
+        remat=False, tie_embeddings=False,
+    )
+    p = init_lm_params(jax.random.PRNGKey(0), cfg)
+    assert _head_matrix(p, cfg).shape == (64, 32)  # vocab padded to 64; bias-free: fine
+    p["lm_head"]["bias"] = jnp.zeros((60,))
+    with pytest.raises(ValueError, match="bias-free"):
+        _head_matrix(p, cfg)
+
+
+def test_blocked_bwd_head_cotangent_matches_param_dtype():
+    """custom_vjp cotangent dtype must mirror the head param dtype or
+    bf16-held heads fail the aval check at trace time (ADVICE r4)."""
+    k = jax.random.PRNGKey(7)
+    normed = jax.random.normal(k, (1, 6, 8), jnp.bfloat16)
+    head = jax.random.normal(jax.random.PRNGKey(8), (24, 8), jnp.bfloat16)
+    tgt = jax.random.randint(jax.random.PRNGKey(9), (1, 6), 0, 24)
+    g = jax.grad(
+        lambda h: blocked_cross_entropy(normed, h, tgt, 4, jnp.bfloat16)
+    )(head)
+    assert g.dtype == jnp.bfloat16
+
+
 def test_model_blocked_moe_aux_included():
     cfg = ModelConfig(
         d_model=32, n_layer=2, vocab_size=64, d_state=16, chunk_size=8,

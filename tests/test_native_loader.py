@@ -6,9 +6,9 @@ import pytest
 from mamba_distributed_tpu.data import native
 from mamba_distributed_tpu.data.loader import ShardedTokenLoader
 
-pytestmark = pytest.mark.skipif(
+pytestmark = [pytest.mark.fast, pytest.mark.skipif(
     not native.available(), reason="native toolchain unavailable"
-)
+)]
 
 
 @pytest.fixture(scope="module")

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
 from jax.sharding import PartitionSpec as P
 
 from mamba_distributed_tpu.config import (
@@ -65,6 +66,7 @@ def losses_of(tmp, steps=4, **kw):
     return out, t
 
 
+@pytest.mark.fast
 def test_eight_devices_present():
     assert len(jax.devices()) == 8
 
@@ -132,6 +134,7 @@ def test_hybrid_tp_fsdp_dp_matches_single_device(tmp_path):
     np.testing.assert_allclose(ref, tp, rtol=2e-4)
 
 
+@pytest.mark.fast
 def test_fsdp_shards_opt_state(tmp_path):
     tr = Trainer(
         make_cfg(tmp_path, mesh=MeshConfig(fsdp=8), shard=True, micro=1),
@@ -144,6 +147,7 @@ def test_fsdp_shards_opt_state(tmp_path):
     assert sharded, "no optimizer-state leaf sharded under FSDP"
 
 
+@pytest.mark.fast
 def test_param_specs_never_shard_layer_axis():
     cfg = ModelConfig(**TINY_MODEL)
     params = jax.eval_shape(
@@ -158,6 +162,7 @@ def test_param_specs_never_shard_layer_axis():
             assert s[0] is None, f"layer axis sharded: {s}"
 
 
+@pytest.mark.fast
 def test_replicated_specs_when_not_sharding():
     cfg = ModelConfig(**TINY_MODEL)
     params = jax.eval_shape(
@@ -200,6 +205,7 @@ def test_tp_with_fsdp_and_dp(tmp_path):
     np.testing.assert_allclose(ref, mix, rtol=5e-4)
 
 
+@pytest.mark.fast
 def test_mesh_axis_order():
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, seq=2, tensor=1))
     assert mesh.axis_names == (

@@ -9,6 +9,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
+
 from mamba_distributed_tpu.utils.parity import (
     compare,
     compare_fingerprint,
@@ -98,6 +100,60 @@ def test_fingerprint_rejects_flat_curve():
     flat = parse_log("\n".join(f"{s} train 10.8300" for s in range(30)))
     res = compare_fingerprint(flat, ref, steps=30)
     assert not res.ok
+
+
+def _long_like(n=260, init=10.99, floor=6.0, val250=None):
+    """Synthesize a 260-step log with val points at 0 and 250."""
+    lines = [f"0 val {init:.4f}"]
+    for s in range(n):
+        loss = floor + (init - floor) * math.exp(-s / 40.0)
+        lines.append(f"{s} train {loss:.6f}")
+        if s == 250:
+            v = val250 if val250 is not None else loss
+            lines.append(f"250 val {v:.4f}")
+    return "\n".join(lines)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_LOG), reason="reference absent")
+def test_fingerprint_scores_val250_checkpoint():
+    """steps>250 makes fingerprint mode score the @250 val point (the
+    reference's first val checkpoint: 250 val 5.4865) by relative fall
+    (VERDICT r4 item 6)."""
+    ref = parse_log_file(REF_LOG)
+    good = parse_log(_long_like(val250=6.0))
+    res = compare_fingerprint(good, ref, steps=260)
+    names = [n for n, _, _ in res.checks]
+    assert "val@250" in names, res.report()
+    assert res.ok, res.report()
+    # a val@250 that barely fell vs its own val@0 must fail the check
+    bad = parse_log(_long_like(val250=10.5))
+    res_bad = compare_fingerprint(bad, ref, steps=260)
+    v = dict((n, p) for n, p, _ in res_bad.checks)
+    assert not v["val@250"], res_bad.report()
+    # a run missing the val point entirely must also fail it
+    no_val = parse_log("\n".join(
+        ["0 val 10.99"] + [f"{s} train {10.99 - s * 0.015:.6f}"
+                           for s in range(260)]))
+    res_nv = compare_fingerprint(no_val, ref, steps=260)
+    v = dict((n, p) for n, p, _ in res_nv.checks)
+    assert not v["val@250"], res_nv.report()
+
+
+@pytest.mark.skipif(not os.path.exists(REF_LOG), reason="reference absent")
+def test_strict_scores_val250_checkpoint():
+    """strict mode: |val@250 diff| within tol; the reference against
+    itself passes, a shifted copy fails."""
+    ref = parse_log_file(REF_LOG)
+    res = compare_strict(ref, ref, steps=260)
+    names = [n for n, _, _ in res.checks]
+    assert "val@250" in names and res.ok, res.report()
+    shifted = {
+        "train": ref["train"],
+        "val": [(s, v + (1.0 if s == 250 else 0.0)) for s, v in ref["val"]],
+    }
+    res_bad = compare_strict(shifted, ref, steps=260)
+    v = dict((n, p) for n, p, _ in res_bad.checks)
+    assert not v["val@250"], res_bad.report()
 
 
 def test_compare_mode_dispatch():

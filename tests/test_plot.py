@@ -10,6 +10,10 @@ sys.path.insert(0, REPO)
 
 from plot import parse_log  # noqa: E402
 
+import pytest
+
+pytestmark = pytest.mark.fast  # sub-2-min inner-loop tier
+
 LOG = """0 val 10.9578
 0 train 11.018519
 1 train 10.998294
