@@ -4,13 +4,19 @@ TPU-native counterpart of the Triton SSD kernels the reference depends on
 (``mamba_ssm/ops/triton/ssd_chunk_scan.py`` etc., mamba-ssm 2.2.2) — but
 re-derived for the MXU/VMEM model, not translated:
 
-  * one grid cell = (batch, chunk, head); the (l x l) decay matrix
-    ``L`` is rebuilt from the cumulative log-decay *inside VMEM* per cell,
-    never touching HBM (the XLA path's biggest intermediate);
-  * the two sequential pieces stay at the XLA level where they belong:
-    the inter-chunk state recurrence is a tiny ``associative_scan``
-    (ops/ssd.state_passing), and grouped B/C are indexed per head via
-    the BlockSpec index map (never repeated into (b, t, h, n) form);
+  * FORWARD: one fused ``pallas_call`` on grid (batch, head, chunk) with
+    the chunk axis sequential — the inter-chunk state lives in VMEM
+    scratch across chunk iterations (round 5; the earlier two-kernel +
+    XLA state-passing pipeline doubled the call count and round-tripped
+    every chunk state through HBM).  The (l x l) decay matrix ``L`` is
+    rebuilt from the cumulative log-decay *inside VMEM* per cell, never
+    touching HBM (the XLA path's biggest intermediate); grouped B/C are
+    indexed per head via the BlockSpec index map (never repeated into
+    (b, t, h, n) form);
+  * BACKWARD (grid (batch, chunk, head), fully parallel): the sequential
+    inter-chunk pieces stay at the XLA level where they belong — state
+    recompute via ``ops/ssd.state_passing``, state cotangent via a
+    reverse ``associative_scan``;
   * every kernel body is strictly 2-D (l- or p-major tiles): the real
     Mosaic compiler rejects lane-splitting shape casts like
     ``(l, hb*p) -> (l, hb, p)`` at its infer-vector-layout pass — a
@@ -63,55 +69,11 @@ def _chunk_states_kernel(x_ref, w_ref, B_ref, out_ref, *, compute_dtype):
     )
 
 
-def _chunk_output_kernel(
-    x_ref, dt_ref, ac_ref, at_ref, e_ref, B_ref, C_ref, prev_ref, y_ref,
-    *, compute_dtype
-):
-    """y = (G odot L) @ (x*dt) + (C*exp(a)) @ prev_state^T for one cell.
-
-    ``ac``/``at`` are the in-chunk cumulative log-decay in column (l, 1)
-    and row (1, l) layouts (both fed from XLA — Mosaic supports neither
-    lane-splitting reshapes nor small transposes in-kernel), ``e`` is
-    exp(a) (l, 1).
-    """
-    ac = ac_ref[0, 0, 0]          # (l, 1) fp32
-    at = at_ref[0, 0, 0]          # (1, l) fp32
-    dt = dt_ref[0, 0, 0]          # (l, 1)
-    e = e_ref[0, 0, 0]            # (l, 1)
-    Bb = B_ref[0, 0, 0].astype(compute_dtype)      # (l, n)
-    Cb = C_ref[0, 0, 0].astype(compute_dtype)      # (l, n)
-    l = ac.shape[0]
-    x = x_ref[0, 0, 0]            # (l, p)
-    prev = prev_ref[0, 0, 0]      # (p, n) fp32
-
-    # G is group-shared; recomputed per cell (cheap vs one HBM round-trip).
-    # NT-form dot_general: no in-kernel transpose (Mosaic-safe)
-    G = jax.lax.dot_general(
-        Cb, Bb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )                                                          # (l, l)
-
-    # decay matrix rebuilt in VMEM: L[i, j] = exp(a_i - a_j) on i >= j
-    ii = jax.lax.broadcasted_iota(jnp.int32, (l, l), 0)
-    jj = jax.lax.broadcasted_iota(jnp.int32, (l, l), 1)
-    tril = ii >= jj
-    M = jnp.where(tril, G * jnp.exp(ac - at), 0.0)             # (l, l)
-
-    xdt = (x.astype(jnp.float32) * dt).astype(compute_dtype)   # (l, p)
-    y = jnp.dot(M.astype(compute_dtype), xdt,
-                preferred_element_type=jnp.float32)            # (l, p)
-
-    # off-diagonal: carried-state contribution  (C*e^a) @ prev^T
-    cd = (Cb.astype(jnp.float32) * e).astype(compute_dtype)    # (l, n)
-    y = y + jax.lax.dot_general(
-        cd, prev.astype(compute_dtype), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    y_ref[0, 0, 0] = y.astype(y_ref.dtype)         # (l, p)
-
-
 def _cell_specs(h: int, l: int, p: int, n: int, g: int):
-    """Grid-cell BlockSpecs shared by the fwd and bwd kernels.
+    """Grid-cell BlockSpecs for the BACKWARD kernels (grid (b, nc, h)).
+    The fused forward builds its own specs inline — its grid is
+    (b, h, nc) with the chunk axis sequential, so the index-map argument
+    order differs; keep the two in sync by hand when changing layouts.
 
     Every block spans the FULL trailing two array dims, which makes it
     unconditionally legal under Mosaic's (8, 128)-or-full-dim tiling
@@ -197,10 +159,72 @@ def _chunked_inputs(x, dt, A, B, C, chunk_size):
     return cells, chunk_decay, (b, nc, l, h, p, g, n)
 
 
+def _ssd_fused_fwd_kernel(
+    x_ref, dt_ref, ac_ref, at_ref, e_ref, w_ref, g_ref, B_ref, C_ref,
+    h0_ref, y_ref, hT_ref, state, *, compute_dtype, nc,
+):
+    """ONE cell = (batch, head, chunk) with the chunk axis SEQUENTIAL:
+    the inter-chunk state lives in VMEM scratch across chunk iterations,
+    so the per-chunk states never round-trip HBM and the whole forward is
+    a single pallas_call (round-5 fusion: the two-kernel + XLA
+    state-passing pipeline cost ~2x the calls and ~100 MB/layer of state
+    traffic; same math, same strictly-2-D bodies).
+    """
+    ci = pl.program_id(2)
+
+    @pl.when(ci == 0)
+    def _init():
+        state[...] = h0_ref[0, 0]                    # (p, n) fp32
+
+    ac = ac_ref[0, 0, 0]                             # (l, 1) fp32
+    at = at_ref[0, 0, 0]                             # (1, l) fp32
+    dt = dt_ref[0, 0, 0]                             # (l, 1)
+    e = e_ref[0, 0, 0]                               # (l, 1) = exp(a)
+    w = w_ref[0, 0, 0]                               # (l, 1) = dt*exp(aL-a)
+    Bb = B_ref[0, 0, 0]                              # (l, n)
+    Cb = C_ref[0, 0, 0]                              # (l, n)
+    l = ac.shape[0]
+    x = x_ref[0, 0, 0]                               # (l, p)
+    prev = state[...]                                # (p, n) fp32
+
+    # --- intra-chunk output: (G .* L) @ (x*dt)  [NT dots, no transposes]
+    G = jax.lax.dot_general(
+        Cb.astype(compute_dtype), Bb.astype(compute_dtype),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )                                                # (l, l)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (l, l), 0)
+    jj = jax.lax.broadcasted_iota(jnp.int32, (l, l), 1)
+    M = jnp.where(ii >= jj, G * jnp.exp(ac - at), 0.0)
+    xdt = (x.astype(jnp.float32) * dt).astype(compute_dtype)
+    y = jnp.dot(M.astype(compute_dtype), xdt,
+                preferred_element_type=jnp.float32)  # (l, p)
+
+    # --- carried-state contribution: (C*e^a) @ prev^T
+    cd = (Cb.astype(jnp.float32) * e).astype(compute_dtype)
+    y = y + jax.lax.dot_general(
+        cd, prev.astype(compute_dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    y_ref[0, 0, 0] = y.astype(y_ref.dtype)
+
+    # --- state update: new = exp(a_last)*prev + x^T @ (w*B)
+    Bd = (Bb.astype(jnp.float32) * w).astype(compute_dtype)      # (l, n)
+    S = jax.lax.dot_general(                         # (p, n)
+        x.astype(compute_dtype), Bd, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    gamma = g_ref[0, 0, 0]                           # (1, 1) chunk decay
+    state[...] = gamma * prev + S
+
+    @pl.when(ci == nc - 1)
+    def _emit_final():
+        hT_ref[0, 0] = state[...]
+
+
 def _ssd_pallas_fwd_impl(
     x, dt, A, B, C, chunk_size, initial_state, compute_dtype, interpret
 ):
-    """Forward via the two kernels + XLA state passing.
+    """Forward via ONE fused kernel (sequential chunk axis, VMEM state).
 
     Shapes: x (b,t,h,p); dt (b,t,h) [bias-added+softplused]; A (h,);
     B/C (b,t,g,n).  Returns (y_no_D (b,t,h,p) fp32-accurate, final_state).
@@ -209,32 +233,41 @@ def _ssd_pallas_fwd_impl(
     b, nc, l, h, p, g, n = dims
     t = nc * l
 
-    grid = (b, nc, h)
-    xhp_spec, dt_spec, at_spec, bc_spec, st_spec = _cell_specs(h, l, p, n, g)
+    h0 = (jnp.zeros((b, h, p, n), jnp.float32) if initial_state is None
+          else initial_state.astype(jnp.float32))
+    # chunk decay exp(a_last) as (b, nc, h, 1, 1) cells — a (1, 1) block
+    # read beats an in-kernel last-row scalar index under Mosaic
+    gamma_cells = chunk_decay[:, :, :, None, None]
 
-    states = pl.pallas_call(
-        functools.partial(_chunk_states_kernel, compute_dtype=compute_dtype),
-        out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
-        grid=grid,
-        in_specs=[xhp_spec, dt_spec, bc_spec],
-        out_specs=st_spec,
-        compiler_params=_PARALLEL3,
-        interpret=interpret,
-    )(cells["x"], cells["w"], cells["B"])
+    # grid (b, h, nc): chunk axis LAST and sequential so the scratch state
+    # carries; b x h cells stay parallel for the megacore split
+    def cell5(last_two):
+        return pl.BlockSpec((1, 1, 1) + last_two,
+                            lambda bi, hi, ci: (bi, ci, hi, 0, 0))
 
-    prev_states, final_state = state_passing(states, chunk_decay, initial_state)
+    bc5 = pl.BlockSpec((1, 1, 1, l, n),
+                       lambda bi, hi, ci: (bi, ci, (hi * g) // h, 0, 0))
+    h_spec = pl.BlockSpec((1, 1, p, n), lambda bi, hi, ci: (bi, hi, 0, 0))
 
-    y = pl.pallas_call(
-        functools.partial(_chunk_output_kernel, compute_dtype=compute_dtype),
-        out_shape=jax.ShapeDtypeStruct((b, nc, h, l, p), x.dtype),
-        grid=grid,
-        in_specs=[xhp_spec, dt_spec, dt_spec, at_spec, dt_spec, bc_spec,
-                  bc_spec, st_spec],
-        out_specs=xhp_spec,
-        compiler_params=_PARALLEL3,
+    y, final_state = pl.pallas_call(
+        functools.partial(_ssd_fused_fwd_kernel,
+                          compute_dtype=compute_dtype, nc=nc),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, nc, h, l, p), x.dtype),
+            jax.ShapeDtypeStruct((b, h, p, n), jnp.float32),
+        ),
+        grid=(b, h, nc),
+        in_specs=[cell5((l, p)), cell5((l, 1)), cell5((l, 1)),
+                  cell5((1, l)), cell5((l, 1)), cell5((l, 1)),
+                  cell5((1, 1)), bc5, bc5, h_spec],
+        out_specs=(cell5((l, p)), h_spec),
+        scratch_shapes=[pltpu.VMEM((p, n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(cells["x"], cells["dt"], cells["a"], cells["at"], cells["e"],
-      cells["B"], cells["C"], prev_states)
+      cells["w"], gamma_cells, cells["B"], cells["C"], h0)
 
     return _from_cells(y, b, t, h, p), final_state
 
