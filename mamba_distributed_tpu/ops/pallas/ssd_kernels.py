@@ -13,10 +13,11 @@ re-derived for the MXU/VMEM model, not translated:
     touching HBM (the XLA path's biggest intermediate); grouped B/C are
     indexed per head via the BlockSpec index map (never repeated into
     (b, t, h, n) form);
-  * BACKWARD (grid (batch, chunk, head), fully parallel): the sequential
-    inter-chunk pieces stay at the XLA level where they belong — state
-    recompute via ``ops/ssd.state_passing``, state cotangent via a
-    reverse ``associative_scan``;
+  * BACKWARD: the entering states are recomputed (states kernel + XLA
+    ``ops/ssd.state_passing`` — the remat trade), then ONE fused cell
+    kernel walks the chunk axis in REVERSE (grid (batch, head, chunk),
+    chunk sequential) carrying the state cotangent gP in VMEM scratch
+    and emitting all per-cell input gradients plus dgamma/dinit;
   * every kernel body is strictly 2-D (l- or p-major tiles): the real
     Mosaic compiler rejects lane-splitting shape casts like
     ``(l, hb*p) -> (l, hb, p)`` at its infer-vector-layout pass — a
@@ -30,8 +31,8 @@ of ``_mamba_chunk_scan_combined_bwd`` in the reference dep's
 chunk-locally (same remat trade the Triton path makes), the direct
 state gradient and the dx/ddt/dB/dC/dA cell gradients each come from a
 Pallas kernel that rebuilds the (l x l) decay matrices in VMEM, and only
-the tiny inter-chunk pieces (reverse associative scan over chunk states,
-the cumsum-chain dt/A grads) stay at the XLA level.  Gradient parity vs
+the tiny inter-chunk pieces (state_passing for the recompute, the
+cumsum-chain dt/A grads) stay at the XLA level.  Gradient parity vs
 the XLA autodiff of ``ssd_chunked`` is pinned by tests/test_pallas.py.
 """
 
@@ -70,19 +71,20 @@ def _chunk_states_kernel(x_ref, w_ref, B_ref, out_ref, *, compute_dtype):
 
 
 def _cell_specs(h: int, l: int, p: int, n: int, g: int):
-    """Grid-cell BlockSpecs for the BACKWARD kernels (grid (b, nc, h)).
-    The fused forward builds its own specs inline — its grid is
-    (b, h, nc) with the chunk axis sequential, so the index-map argument
-    order differs; keep the two in sync by hand when changing layouts.
+    """Grid-cell BlockSpecs for the backward's states-RECOMPUTE kernel
+    (grid (b, nc, h), fully parallel).  The fused forward and fused
+    backward build their own specs inline — their grids are (b, h, nc)
+    with the chunk axis sequential (reversed index maps in the backward),
+    so the index-map argument order differs; keep them in sync by hand
+    when changing layouts.
 
     Every block spans the FULL trailing two array dims, which makes it
     unconditionally legal under Mosaic's (8, 128)-or-full-dim tiling
     rule, and every kernel-visible tile is 2-D — the head axis lives in
     the grid, never inside a block (layouts built by _chunked_inputs):
-      x/y/dy  (b, nc, h, l, p)       one head per cell
-      dt/a/e  (b, nc, h, l, 1)       lane-degenerate per-head columns
-      at      (b, nc, h, 1, l)       row layout of the log-decay
-      B/C     (b, nc, g, l, n)       cell's group via the index map
+      x       (b, nc, h, l, p)       one head per cell
+      w       (b, nc, h, l, 1)       lane-degenerate per-head columns
+      B       (b, nc, g, l, n)       cell's group via the index map
       states  (b, nc, h, p, n)       (p, n) trailing dims; p % 8 asserted
     """
     xhp_spec = pl.BlockSpec(
@@ -91,16 +93,13 @@ def _cell_specs(h: int, l: int, p: int, n: int, g: int):
     dt_spec = pl.BlockSpec(
         (1, 1, 1, l, 1), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
     )
-    at_spec = pl.BlockSpec(
-        (1, 1, 1, 1, l), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
-    )
     bc_spec = pl.BlockSpec(
         (1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, (hi * g) // h, 0, 0)
     )
     st_spec = pl.BlockSpec(
         (1, 1, 1, p, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)
     )
-    return xhp_spec, dt_spec, at_spec, bc_spec, st_spec
+    return xhp_spec, dt_spec, bc_spec, st_spec
 
 
 def _to_cells(v, b, nc, l, h, tail):
@@ -280,59 +279,59 @@ def _ssd_pallas_fwd_impl(
 #   S      = sum_j e^{a_L-a_j} dt_j x_j (x) B_j     (per-chunk state summary)
 #   P_{c+1} = gamma_c P_c + S_c,  gamma_c = e^{a_L}  (inter-chunk recurrence)
 #   y_off  = diag(e^a) C @ P_c^T
-# The backward mirrors it: (1) Pallas kernel for the direct state gradient
-# dP_c = dY^T (e^a .* C); (2) XLA *reverse* associative scan for
-# gP_c = dP_c + gamma_c gP_{c+1} (=> dS_c = gP_{c+1}, dgamma_c = <dS_c, P_c>);
-# (3) one Pallas cell kernel for dx/ddt/da/dB/dC with L rebuilt in VMEM;
-# (4) XLA epilogue pushing the in-chunk log-decay gradient `da` through the
-# cumsum chain into ddt and dA.
+# The backward mirrors it: (1) the forward's states kernel + XLA
+# state_passing recompute the entering states P_c (remat, same trade as
+# the Triton backward); (2) ONE fused cell kernel walks the chunk axis in
+# REVERSE (index maps ci -> nc-1-ci, sequential grid dim) carrying the
+# state cotangent gP in VMEM scratch — gP_c = dP_c + gamma_c gP_{c+1}
+# with dP_c = dY^T (e^a .* C) computed in-cell, dS_c = gP_{c+1} consumed
+# before the update, and dgamma_c = <dS_c, P_c> emitted per cell (the
+# round-4 design ran a separate dP kernel plus an XLA reverse
+# associative_scan, round-tripping two (b, nc, h, p, n) arrays through
+# HBM); (3) an XLA epilogue pushes the in-chunk log-decay gradient `da`
+# through the cumsum chain into ddt and dA.
 # ---------------------------------------------------------------------------
 
 
-def _dstate_direct_kernel(dy_ref, e_ref, C_ref, out_ref, *, compute_dtype):
-    """Direct gradient of the chunk-entering state: dP = dY^T @ (e^a .* C)."""
-    e = e_ref[0, 0, 0]                               # (l, 1) fp32, <= 1
-    Cb = C_ref[0, 0, 0]                              # (l, n)
-    dy = dy_ref[0, 0, 0]                             # (l, p)
-
-    eC = (e * Cb.astype(jnp.float32)).astype(compute_dtype)      # (l, n)
-    # dY^T @ eC: contract the sublane dim of both -> (p, n)
-    out_ref[0, 0, 0] = jax.lax.dot_general(
-        dy.astype(compute_dtype), eC, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-
-
-def _ssd_bwd_cell_kernel(
-    x_ref, dt_ref, ac_ref, at_ref, e_ref, d_ref, B_ref, C_ref, prev_ref,
-    dy_ref, dS_ref, dx_ref, ddt_ref, da_ref, dB_ref, dC_ref,
-    *, compute_dtype,
+def _ssd_fused_bwd_kernel(
+    x_ref, dt_ref, ac_ref, at_ref, e_ref, d_ref, g_ref, B_ref, C_ref,
+    prev_ref, dy_ref, dfin_ref,
+    dx_ref, ddt_ref, da_ref, dB_ref, dC_ref, dg_ref, dinit_ref,
+    gP, *, compute_dtype, nc,
 ):
-    """All per-cell input gradients for one (batch, chunk, head).
+    """All per-cell input gradients for one (batch, head, chunk-reversed).
 
     Strictly 2-D bodies (see module docstring): sublane-axis sums go
     through ones-vector matmuls instead of transposes, and all decay
-    factors (e = exp(a), d = exp(a_last - a), row/col a) arrive
-    precomputed from XLA.
+    factors (e = exp(a), d = exp(a_last - a), gamma = exp(a_last),
+    row/col a) arrive precomputed from XLA.
 
     Outputs: dx (l,p); ddt_direct (l,1) [the dt*x product-rule term];
     da (l,1) [grad wrt the in-chunk cumulative log-decay, pushed through
     the cumsum chain by the XLA epilogue]; dB/dC (l,n) per head
-    [summed over a group's heads outside].
+    [summed over a group's heads outside]; dgamma (1,1); dinit (p,n)
+    [the state cotangent after chunk 0, emitted on the last iteration].
     """
     cd = compute_dtype
+    ci = pl.program_id(2)
+
+    @pl.when(ci == 0)                                # actual chunk nc-1
+    def _seed():
+        gP[...] = dfin_ref[0, 0]                     # dfinal or zeros
+
     ac = ac_ref[0, 0, 0]                             # (l, 1) fp32
     at = at_ref[0, 0, 0]                             # (1, l) fp32
     dt = dt_ref[0, 0, 0]                             # (l, 1) fp32
     e = e_ref[0, 0, 0]                               # (l, 1) = exp(a)
     d = d_ref[0, 0, 0]                               # (l, 1) decay-to-end
+    gamma = g_ref[0, 0, 0]                           # (1, 1) = exp(a_last)
     l = ac.shape[0]
     x = x_ref[0, 0, 0].astype(jnp.float32)           # (l, p)
     Bb = B_ref[0, 0, 0]                              # (l, n)
     Cb = C_ref[0, 0, 0]                              # (l, n)
     P = prev_ref[0, 0, 0]                            # (p, n) fp32
     dy = dy_ref[0, 0, 0].astype(jnp.float32)         # (l, p)
-    dS = dS_ref[0, 0, 0]                             # (p, n) fp32
+    dS = gP[...]                                     # = gP_{c+1} (p, n)
     ones = jnp.ones((l, 1), jnp.float32)
 
     u = x * dt                                       # (l, p)
@@ -409,12 +408,32 @@ def _ssd_bwd_cell_kernel(
     dB_ref[0, 0, 0] = dB_acc
     dC_ref[0, 0, 0] = dC_acc
 
+    # --- inter-chunk recurrence cotangents --------------------------------
+    # dgamma_c = <dS_c, P_c>: lane-reduce then a ones-matmul over sublanes
+    sp = jnp.sum(dS * P, axis=1, keepdims=True)      # (p, 1)
+    dg_ref[0, 0, 0] = jax.lax.dot_general(           # (1, 1)
+        jnp.ones((P.shape[0], 1), jnp.float32), sp,
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    # gP_c = dP_c + gamma_c * gP_{c+1},  dP_c = dY^T @ (e^a .* C)
+    eC = (e * Cb.astype(jnp.float32)).astype(cd)     # (l, n)
+    dP = jax.lax.dot_general(                        # (p, n)
+        dy.astype(cd), eC, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    gP[...] = dP + gamma * dS
+
+    @pl.when(ci == nc - 1)                           # actual chunk 0
+    def _emit_dinit():
+        dinit_ref[0, 0] = gP[...]
+
 
 def _ssd_pallas_bwd_impl(
     x, dt, A, B, C, dy, chunk_size, compute_dtype, interpret,
     initial_state=None, dfinal=None,
 ):
-    """Full backward: recompute chunk states, reverse-scan, cell kernel.
+    """Full backward: recompute chunk states, then ONE fused reverse-walk
+    cell kernel (state cotangent carried in VMEM scratch).
 
     ``initial_state`` (b, h, p, n) makes the recomputed entering states
     match a forward that was seeded (decode prefill / SP shards), and its
@@ -426,7 +445,7 @@ def _ssd_pallas_bwd_impl(
     b, nc, l, h, p, g, n = dims
     t = nc * l
     grid = (b, nc, h)
-    xhp_spec, dt_spec, at_spec, bc_spec, st_spec = _cell_specs(h, l, p, n, g)
+    xhp_spec, dt_spec, bc_spec, st_spec = _cell_specs(h, l, p, n, g)
     dyr = _to_cells(dy, b, nc, l, h, (p,))
 
     # recompute the chunk summaries + entering states (remat, like the
@@ -442,68 +461,59 @@ def _ssd_pallas_bwd_impl(
     )(cells["x"], cells["w"], cells["B"])
     prev_states, _ = state_passing(states, chunk_decay, initial_state)
 
-    # direct state gradient from each chunk's off-diagonal output
-    dP = pl.pallas_call(
-        functools.partial(_dstate_direct_kernel, compute_dtype=compute_dtype),
-        out_shape=jax.ShapeDtypeStruct((b, nc, h, p, n), jnp.float32),
-        grid=grid,
-        in_specs=[xhp_spec, dt_spec, bc_spec],
-        out_specs=st_spec,
-        compiler_params=_PARALLEL3,
-        interpret=interpret,
-    )(dyr, cells["e"], cells["C"])
+    # ONE fused kernel walks the chunk axis in reverse (sequential grid
+    # dim, index maps ci -> nc-1-ci) carrying the state cotangent gP in
+    # VMEM scratch; a final-state cotangent seeds gP exactly like the old
+    # virtual-chunk trick seeded the associative scan
+    dfin = (jnp.zeros((b, h, p, n), jnp.float32) if dfinal is None
+            else dfinal.astype(jnp.float32))
+    gamma_cells = chunk_decay[:, :, :, None, None]   # (b, nc, h, 1, 1)
 
-    # reverse associative scan: gP_c = dP_c + gamma_c * gP_{c+1}.  A final-
-    # state cotangent seeds it as a virtual chunk nc with dP = dfinal (its
-    # own decay entry is never consumed), so gP_c picks up the
-    # prod(gamma)-propagated dfinal term for free.
-    decay = chunk_decay[..., None, None]             # (b, nc, h, 1, 1)
-    if dfinal is not None:
-        dP = jnp.concatenate(
-            [dP, dfinal.astype(dP.dtype)[:, None]], axis=1
+    def cell5r(last_two):
+        return pl.BlockSpec(
+            (1, 1, 1) + last_two,
+            lambda bi, hi, ci: (bi, nc - 1 - ci, hi, 0, 0),
         )
-        decay = jnp.concatenate([decay, jnp.ones_like(decay[:, :1])], axis=1)
 
-    def combine(left, right):
-        a_l, s_l = left
-        a_r, s_r = right
-        return a_l * a_r, s_l * a_r + s_r
-
-    _, gP_rev = jax.lax.associative_scan(
-        combine, (jnp.flip(decay, 1), jnp.flip(dP, 1)), axis=1
+    bc5r = pl.BlockSpec(
+        (1, 1, 1, l, n),
+        lambda bi, hi, ci: (bi, nc - 1 - ci, (hi * g) // h, 0, 0),
     )
-    gP = jnp.flip(gP_rev, 1)                         # (b, nc(+1), h, p, n)
-    if dfinal is not None:
-        dS = gP[:, 1:]                               # virtual chunk = dfinal
-    else:
-        dS = jnp.concatenate([gP[:, 1:], jnp.zeros_like(gP[:, :1])], axis=1)
-    # gradient wrt the state entering chunk 0 == wrt initial_state
-    dinit = gP[:, 0] if initial_state is not None else None
-    dgamma = jnp.sum(dS * prev_states, axis=(3, 4))  # (b, nc, h)
+    st5r = pl.BlockSpec(
+        (1, 1, 1, p, n), lambda bi, hi, ci: (bi, nc - 1 - ci, hi, 0, 0)
+    )
+    h_spec = pl.BlockSpec((1, 1, p, n), lambda bi, hi, ci: (bi, hi, 0, 0))
 
-    dx_c, ddt5, da5, dB_cell, dC_cell = pl.pallas_call(
-        functools.partial(_ssd_bwd_cell_kernel, compute_dtype=compute_dtype),
+    dx_c, ddt5, da5, dB_cell, dC_cell, dg5, dinit_arr = pl.pallas_call(
+        functools.partial(_ssd_fused_bwd_kernel,
+                          compute_dtype=compute_dtype, nc=nc),
         out_shape=(
             jax.ShapeDtypeStruct((b, nc, h, l, p), x.dtype),
             jax.ShapeDtypeStruct((b, nc, h, l, 1), jnp.float32),
             jax.ShapeDtypeStruct((b, nc, h, l, 1), jnp.float32),
             jax.ShapeDtypeStruct((b, nc, h, l, n), jnp.float32),
             jax.ShapeDtypeStruct((b, nc, h, l, n), jnp.float32),
+            jax.ShapeDtypeStruct((b, nc, h, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, p, n), jnp.float32),
         ),
-        grid=grid,
-        in_specs=[xhp_spec, dt_spec, dt_spec, at_spec, dt_spec, dt_spec,
-                  bc_spec, bc_spec, st_spec, xhp_spec, st_spec],
-        out_specs=(
-            xhp_spec,
-            dt_spec,
-            dt_spec,
-            pl.BlockSpec((1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
-            pl.BlockSpec((1, 1, 1, l, n), lambda bi, ci, hi: (bi, ci, hi, 0, 0)),
+        grid=(b, h, nc),
+        in_specs=[cell5r((l, p)), cell5r((l, 1)), cell5r((l, 1)),
+                  cell5r((1, l)), cell5r((l, 1)), cell5r((l, 1)),
+                  cell5r((1, 1)), bc5r, bc5r, st5r, cell5r((l, p)), h_spec],
+        out_specs=(cell5r((l, p)), cell5r((l, 1)), cell5r((l, 1)),
+                   cell5r((l, n)), cell5r((l, n)), cell5r((1, 1)), h_spec),
+        scratch_shapes=[pltpu.VMEM((p, n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-        compiler_params=_PARALLEL3,
         interpret=interpret,
     )(cells["x"], cells["dt"], cells["a"], cells["at"], cells["e"],
-      cells["d"], cells["B"], cells["C"], prev_states, dyr, dS)
+      cells["d"], gamma_cells, cells["B"], cells["C"], prev_states, dyr,
+      dfin)
+
+    # gradient wrt the state entering chunk 0 == wrt initial_state
+    dinit = dinit_arr if initial_state is not None else None
+    dgamma = dg5[..., 0, 0]                          # (b, nc, h)
 
     # --- XLA epilogue: push `da` through the cumsum chain -----------------
     def cells_to_blh(v):  # (b, nc, h, l, 1) -> (b, nc, l, h)
