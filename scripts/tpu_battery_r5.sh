@@ -32,6 +32,12 @@ claimer_live() {
   # so 'pytest tests/test_bench.py' never matches
   for pid in $(pgrep -f 'battery2\.sh|tpu_battery\.sh|run_parity\.sh|python[0-9.]* (-u )?([^ ]*/)?(scripts/(tpu_smoke|sweep_bench|bench_decode|profile_step)|bench|train|eval)\.py'); do
     [ "$pid" = "$$" ] && continue
+    # Claude-harness wrapper shells quote the launched command inside
+    # their own cmdline (and carry the harness env, not the child's) —
+    # they never hold a claim themselves
+    if grep -aq 'shell-snapshots' "/proc/$pid/cmdline" 2>/dev/null; then
+      continue
+    fi
     env="$(tr '\0' '\n' < "/proc/$pid/environ" 2>/dev/null)"
     # BENCH_PLATFORM takes precedence in bench.init_backend, so only a
     # cpu BENCH_PLATFORM — or a cpu JAX_PLATFORMS with no BENCH_PLATFORM
