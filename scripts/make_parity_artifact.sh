@@ -1,0 +1,38 @@
+#!/bin/bash
+# Post-run artifact packaging for the >=250-step logged curve (VERDICT r4
+# item 6-7): render the loss plot with the reference overlaid, score the
+# val@250 checkpoint via the parity harness, and emit a HellaSwag
+# acc_norm line from the run's checkpoint over the committed synthetic
+# jsonl (zero-egress: toy byte-level BPE).
+#
+#   bash scripts/make_parity_artifact.sh [LOG_DIR] [CKPT_DIR] [PRESET] [STEPS]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+LOG_DIR="${1:-log_parity_cpu}"
+CKPT="${2:-/tmp/mini_ckpt}"
+PRESET="${3:-mamba2-mini}"
+STEPS="${4:-260}"
+
+export JAX_PLATFORMS=cpu
+
+python plot.py --log "$LOG_DIR/log.txt" --out "$LOG_DIR/validation_loss.png" \
+  --ref-log /root/reference/log/log_mamba.txt
+
+python scripts/compare_parity.py "$LOG_DIR/log.txt" --mode fingerprint \
+  --steps "$STEPS" | tee "$LOG_DIR/parity_${STEPS}.txt"
+
+# toy byte-level BPE (the environment is zero-egress; the jsonl is the
+# committed synthetic fixture, so scores are pipeline witnesses, not
+# HellaSwag-comparable numbers — the line format IS reference-exact)
+python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from tests.conftest import make_toy_bpe
+make_toy_bpe("/tmp/toy_bpe")
+EOF
+
+python eval.py -m custom --checkpoint "$CKPT" --preset "$PRESET" \
+  --data-file tests/data/hellaswag_tiny.jsonl --bpe-dir /tmp/toy_bpe \
+  --limit 16 --log-file "$LOG_DIR/hellaswag_eval.txt"
+echo
+echo "artifacts in $LOG_DIR: log.txt validation_loss.png parity_${STEPS}.txt hellaswag_eval.txt"
