@@ -73,6 +73,10 @@ DEFAULT_CONFIGS = [
      "chunk_size": 512, "remat_policy": "mixer", "loss_impl": "blocked"},
     {"preset": "hybrid-280m", "B": 8, "attn_impl": "xla",
      "chunk_size": 512, "remat_policy": "mixer", "loss_impl": "blocked"},
+    # Mamba-1 (what the reference's empty ssm_cfg actually builds,
+    # SURVEY 2.4): first on-chip ranking of the selective-scan paths
+    {"preset": "mamba1-280m", "B": 8, "ssm_impl": "xla"},
+    {"preset": "mamba1-280m", "B": 8, "ssm_impl": "pallas"},
 ]
 
 
