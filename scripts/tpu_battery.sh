@@ -23,20 +23,24 @@ run() {  # run <timeout-s> <name> <outfile> <cmd...>
   fi
 }
 
+# Stage order = value density: if the claim window closes mid-battery,
+# the cheap high-value artifacts (sweep ranking, shipped-default bench
+# incl. the bench_last_good refresh, decode) are already on disk before
+# the long parity run starts.
 run 4500 smoke  "$OUT/tpu_smoke.jsonl"    python scripts/tpu_smoke.py || exit 1
 run 4500 sweep  "$OUT/sweep_results.jsonl" python scripts/sweep_bench.py
-run 2400 parity "$OUT/parity_run.log"      bash scripts/run_parity.sh 30
-# fingerprint mode: the parity run uses synthetic zipf shards, so only
-# data-independent checks apply (scripts/compare_parity.py --help)
-run 120 parity_cmp "$OUT/parity_compare.txt" \
-  python scripts/compare_parity.py log_parity/log.txt --mode fingerprint
-run 2400 decode "$OUT/decode_result.json"  python scripts/bench_decode.py
 # single claim attempt (this wrapper IS the retry loop; two ~25-min claim
 # blocks would overrun the stage timeout) and no last-good stand-in (the
 # fallback is for the DRIVER's outage path — in here a fallback line would
 # mislabel a lost claim as a fresh measurement)
 run 2400 bench  "$OUT/bench_result.json" \
   env BENCH_CLAIM_ATTEMPTS=1 BENCH_NO_FALLBACK=1 python bench.py
+run 2400 decode "$OUT/decode_result.json"  python scripts/bench_decode.py
+run 2400 parity "$OUT/parity_run.log"      bash scripts/run_parity.sh 30
+# fingerprint mode: the parity run uses synthetic zipf shards, so only
+# data-independent checks apply (scripts/compare_parity.py --help)
+run 120 parity_cmp "$OUT/parity_compare.txt" \
+  python scripts/compare_parity.py log_parity/log.txt --mode fingerprint
 # XLA trace for the fusion questions (did add+RMSNorm / conv fuse?) —
 # docs/KERNELS.md records the bet; the trace under $OUT/profile decides it
 run 2400 profile "$OUT/profile_step.log"   \
