@@ -1,0 +1,54 @@
+"""Rank sweep_bench results and recommend shipping defaults.
+
+  python scripts/rank_sweep.py /tmp/battery_r5/sweep_results.jsonl
+
+Reads the JSONL a sweep run printed (one object per row, errors
+included), groups rows by preset, ranks by tok_per_sec, and prints the
+deltas vs each preset's first (baseline-config) row — the table that
+drives the "flip the preset defaults" decision after a claim window.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(path: str) -> int:
+    rows, errors = [], []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if "best" in r:
+                continue
+            (errors if "error" in r else rows).append(r)
+
+    by_preset: dict[str, list[dict]] = {}
+    for r in rows:
+        by_preset.setdefault(r.get("preset", "mamba2-280m"), []).append(r)
+
+    for preset, group in by_preset.items():
+        base = group[0]["tok_per_sec"]
+        print(f"== {preset} (first row {base:,.0f} tok/s = 1.00x)")
+        for r in sorted(group, key=lambda r: -r["tok_per_sec"]):
+            knobs = {k: v for k, v in r.items()
+                     if k not in ("tok_per_sec", "mfu_model", "mfu_hw",
+                                  "step_ms", "loss", "preset")}
+            print(f"  {r['tok_per_sec']:>9,.0f} tok/s  x{r['tok_per_sec']/base:4.2f}"
+                  f"  mfu_model {r.get('mfu_model', 0):.4f}  {knobs}")
+        print()
+
+    if errors:
+        print(f"== {len(errors)} failed rows")
+        for r in errors:
+            spec = {k: v for k, v in r.items() if k != "error"}
+            print(f"  {spec}\n    {r['error'][:160]}")
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1] if len(sys.argv) > 1 else
+                          "/tmp/battery_r5/sweep_results.jsonl"))
