@@ -11,28 +11,50 @@ drives the "flip the preset defaults" decision after a claim window.
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import DEFAULT_PRESET  # noqa: E402  (single source of truth)
 
 
 def main(path: str) -> int:
-    rows, errors = [], []
+    rows, errors, truncated = [], [], 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            r = json.loads(line)
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                # a claim dropped mid-sweep leaves a partial trailing line;
+                # rank what completed (the matrix is value-ordered)
+                truncated += 1
+                continue
             if "best" in r:
                 continue
             (errors if "error" in r else rows).append(r)
 
     by_preset: dict[str, list[dict]] = {}
-    for r in rows:
-        by_preset.setdefault(r.get("preset", "mamba2-280m"), []).append(r)
+    anchored_ok: dict[str, bool] = {}
+    for r in rows + errors:  # file order; errors only influence anchoring
+        p = r.get("preset", DEFAULT_PRESET)
+        by_preset.setdefault(p, [])
+        if "error" in r:
+            anchored_ok.setdefault(p, False)
+        else:
+            anchored_ok.setdefault(p, True)
+            by_preset[p].append(r)
 
     for preset, group in by_preset.items():
+        if not group:
+            continue
         base = group[0]["tok_per_sec"]
-        print(f"== {preset} (first row {base:,.0f} tok/s = 1.00x)")
+        note = "" if anchored_ok[preset] else \
+            "  [baseline row FAILED; anchored on first successful row]"
+        print(f"== {preset} (first row {base:,.0f} tok/s = 1.00x){note}")
         for r in sorted(group, key=lambda r: -r["tok_per_sec"]):
             knobs = {k: v for k, v in r.items()
                      if k not in ("tok_per_sec", "mfu_model", "mfu_hw",
@@ -46,6 +68,9 @@ def main(path: str) -> int:
         for r in errors:
             spec = {k: v for k, v in r.items() if k != "error"}
             print(f"  {spec}\n    {r['error'][:160]}")
+    if truncated:
+        print(f"== {truncated} unparseable line(s) skipped (claim dropped "
+              "mid-sweep?)")
     return 0 if rows else 1
 
 
