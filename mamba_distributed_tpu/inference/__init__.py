@@ -1,5 +1,19 @@
 """Inference: recurrent O(1)-per-token generation + sampling."""
 
-from mamba_distributed_tpu.inference.generate import generate, top_k_sample
+from mamba_distributed_tpu.inference.bucketing import (
+    next_pow2_bucket,
+    pad_to_bucket,
+)
+from mamba_distributed_tpu.inference.generate import (
+    generate,
+    top_k_sample,
+    vocab_pad_mask,
+)
 
-__all__ = ["generate", "top_k_sample"]
+__all__ = [
+    "generate",
+    "next_pow2_bucket",
+    "pad_to_bucket",
+    "top_k_sample",
+    "vocab_pad_mask",
+]

@@ -1,0 +1,62 @@
+"""Power-of-two prompt-length bucketing for prefill.
+
+Every distinct prompt length is a distinct jit trace signature, so a
+serving workload with heterogeneous prompts would recompile the prefill
+for each new length.  Bucketing pads the prompt up to the next power of
+two and masks the pad positions, bounding the number of traces at
+log2(max_prompt_len) for any workload.
+
+The pad is on the LEFT and the mask zeroes the mixer inputs at pad
+positions (``token_mask`` in models/lm.lm_prefill), which makes the
+padded prefill numerically equivalent to the unpadded one for pure-SSM
+stacks: a zero conv/SSM input contributes nothing to the scan, and the
+state entering the first real token is exactly the zero initial state.
+Equivalent, not bit-identical — padding shifts the chunked scan's
+chunk boundaries, so the SSM state's sums re-associate (~1e-7 in fp32;
+the conv cache IS bit-identical).  Anything needing exact token
+streams must compare padded-vs-padded, which is how the serving
+engine's parity contract works: engine and solo ``generate()`` pad the
+same prompt identically.  (Hybrid stacks with attention layers can't
+mask pads this way — real queries would still attend to pad keys — so
+callers skip bucketing when ``cfg.attn_layer_idx`` is non-empty.)
+
+Shared by ``inference/generate.py`` and the serving prefill path
+(``serving/engine.py``); the trace-count test in tests/test_serving.py
+pins the one-trace-per-bucket contract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Smallest bucket: below this, padding waste is negligible and going
+# finer would multiply trace count for no compile-time win.
+MIN_BUCKET = 8
+
+
+def next_pow2_bucket(t: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Smallest power-of-two >= t (and >= min_bucket)."""
+    if t < 1:
+        raise ValueError(f"prompt length must be >= 1, got {t}")
+    b = max(min_bucket, 1)
+    while b < t:
+        b *= 2
+    return b
+
+
+def pad_to_bucket(
+    prompt_ids: jax.Array, bucket: int
+) -> tuple[jax.Array, jax.Array]:
+    """Left-pad (b, t) int32 prompts to (b, bucket) + float {0,1} mask.
+
+    Pad positions hold token id 0 — the value never reaches the scan
+    state because the mask zeroes the mixer inputs there.
+    """
+    b, t = prompt_ids.shape
+    if bucket < t:
+        raise ValueError(f"bucket {bucket} < prompt length {t}")
+    pad = bucket - t
+    padded = jnp.pad(prompt_ids, ((0, 0), (pad, 0)))
+    mask = jnp.pad(jnp.ones((b, t), jnp.float32), ((0, 0), (pad, 0)))
+    return padded, mask

@@ -10,6 +10,22 @@ instead of re-running the full growing prefix through the model each token
 Everything (prefill scan + decode scan) is one jit; token-for-token the
 logits match the full-sequence forward (pinned by tests/test_model.py
 decode-parity and tests/test_inference.py).
+
+Serving contracts (mamba_distributed_tpu/serving/ reuses all of this):
+
+* Prompt lengths are bucketed to powers of two for pure-SSM stacks
+  (inference/bucketing.py) so heterogeneous prompts share jit traces —
+  the padded prefill is numerically equivalent to the unpadded one
+  (~1e-7 summation-order noise for off-bucket lengths; pass
+  ``length_bucketing=False`` to reproduce pre-bucketing streams
+  exactly).
+* The per-step sampling key is ``fold_in(key, i)`` — reproducible from
+  (request key, tokens-generated counter) alone, which is what lets the
+  serving engine's slot-pooled decode emit the same token stream as a
+  solo ``generate`` call with the same key (tests/test_serving.py).
+* ``eos_id`` moves EOT stopping into the decode loop: finished rows emit
+  ``eos_id`` deterministically for the rest of the budget.  ``None``
+  keeps the old truncate-on-host contract.
 """
 
 from __future__ import annotations
@@ -20,7 +36,14 @@ import jax
 import jax.numpy as jnp
 
 from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
 from mamba_distributed_tpu.models.lm import lm_prefill, lm_step
+
+# Python-side-effect trace counter: _generate_impl bumps this exactly
+# once per jit trace (retraces are what the bucketing exists to bound —
+# pinned by tests/test_serving.py::test_generate_length_bucketing_traces;
+# the serving engine keeps its own counters in serving/engine.py).
+TRACE_COUNTS = {"generate": 0}
 
 
 def top_k_sample(
@@ -33,6 +56,15 @@ def top_k_sample(
     vals, idx = jax.lax.top_k(logits, k)
     choice = jax.random.categorical(key, vals / temperature)
     return jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
+
+
+def vocab_pad_mask(cfg: ModelConfig) -> jax.Array:
+    """(V_padded,) additive mask: 0 for real tokens, -inf for the
+    vocab-padding rows (tied zero-padded embeddings give them logit 0.0,
+    which would outrank real negative logits)."""
+    return jnp.where(
+        jnp.arange(cfg.vocab_size_padded) < cfg.vocab_size, 0.0, -jnp.inf
+    )
 
 
 def _decode_params(params: dict, cfg: ModelConfig) -> dict:
@@ -70,8 +102,59 @@ def _decode_params(params: dict, cfg: ModelConfig) -> dict:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k", "temperature")
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "top_k", "temperature"),
 )
+def _generate_impl(
+    params: dict,
+    cfg: ModelConfig,
+    prompt_ids: jax.Array,
+    token_mask: jax.Array | None,
+    key: jax.Array,
+    max_new_tokens: int,
+    top_k: int,
+    temperature: float,
+    eos_id: jax.Array,
+) -> jax.Array:
+    """(b, T_bucket) padded prompt -> (b, T_bucket + max_new_tokens).
+
+    ``eos_id`` is a traced int32 scalar (-1 => no EOS stopping, the same
+    sentinel the serving tick uses) so switching tokenizers never
+    recompiles."""
+    TRACE_COUNTS["generate"] += 1  # python side effect: runs once per trace
+    b, t = prompt_ids.shape
+    params = _decode_params(params, cfg)
+    # parallel prefill: one full-sequence forward builds the decode state
+    # (the reference re-ran the whole prefix per token instead)
+    last_logits, state = lm_prefill(
+        params, cfg, prompt_ids, max_len=t + max_new_tokens,
+        token_mask=token_mask,
+    )
+
+    pad_mask = vocab_pad_mask(cfg)
+    has_eos = eos_id >= 0
+
+    def decode(carry, i):
+        state, logits, done = carry
+        # fold_in (not split) so the serving engine can reproduce step i's
+        # key from (request key, per-slot counter) without a static budget
+        tok = top_k_sample(
+            jax.random.fold_in(key, i), logits + pad_mask, top_k, temperature
+        )
+        # `done` implies has_eos (it is only ever set below), so finished
+        # rows deterministically keep emitting the eos token
+        tok = jnp.where(done, eos_id, tok)
+        done = done | (has_eos & (tok == eos_id))
+        logits, state = lm_step(params, cfg, state, tok)
+        return (state, logits, done), tok
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _), new_tokens = jax.lax.scan(
+        decode, (state, last_logits, done0), jnp.arange(max_new_tokens)
+    )
+    return jnp.concatenate([prompt_ids, jnp.moveaxis(new_tokens, 0, 1)], axis=1)
+
+
 def generate(
     params: dict,
     cfg: ModelConfig,
@@ -80,32 +163,32 @@ def generate(
     max_new_tokens: int = 32,
     top_k: int = 50,
     temperature: float = 1.0,
+    eos_id: int | None = None,
+    length_bucketing: bool = True,
 ) -> jax.Array:
     """prompt_ids (b, t) int32 -> (b, t + max_new_tokens) sampled tokens.
 
-    EOT stopping is a host-side concern (jit generates the full budget;
-    truncate at the tokenizer's EOT afterwards, as the caller wishes).
+    ``eos_id=None``: EOT stopping is a host-side concern (the full budget
+    is generated; truncate at the tokenizer's EOT afterwards, as the
+    caller wishes).  With ``eos_id`` set, rows that sample it keep
+    emitting ``eos_id`` deterministically for the rest of the budget, so
+    the output is directly truncatable and token-for-token reproducible
+    by the serving engine.
+
+    ``length_bucketing`` pads the prompt to a power-of-two bucket (pure-
+    SSM stacks only) so any workload of heterogeneous prompt lengths
+    compiles O(log max_len) traces instead of one per distinct length.
     """
     b, t = prompt_ids.shape
-    params = _decode_params(params, cfg)
-    # parallel prefill: one full-sequence forward builds the decode state
-    # (the reference re-ran the whole prefix per token instead)
-    last_logits, state = lm_prefill(
-        params, cfg, prompt_ids, max_len=t + max_new_tokens
+    if length_bucketing and not cfg.attn_layer_idx:
+        padded, mask = pad_to_bucket(prompt_ids, next_pow2_bucket(t))
+    else:
+        padded, mask = prompt_ids, None
+    out = _generate_impl(
+        params, cfg, padded, mask, key, max_new_tokens, top_k, temperature,
+        jnp.int32(-1 if eos_id is None else eos_id),
     )
-
-    # never sample the vocab-padding rows (tied zero-padded embeddings give
-    # them logit 0.0, which would outrank real negative logits)
-    pad_mask = jnp.where(
-        jnp.arange(cfg.vocab_size_padded) < cfg.vocab_size, 0.0, -jnp.inf
-    )
-
-    def decode(carry, k_i):
-        state, logits = carry
-        tok = top_k_sample(k_i, logits + pad_mask, top_k, temperature)
-        logits, state = lm_step(params, cfg, state, tok)
-        return (state, logits), tok
-
-    keys = jax.random.split(key, max_new_tokens)
-    (_, _), new_tokens = jax.lax.scan(decode, (state, last_logits), keys)
-    return jnp.concatenate([prompt_ids, jnp.moveaxis(new_tokens, 0, 1)], axis=1)
+    if padded.shape[1] == t:
+        return out
+    # splice the unpadded prompt back onto the generated suffix
+    return jnp.concatenate([prompt_ids, out[:, padded.shape[1]:]], axis=1)

@@ -172,11 +172,13 @@ def _moe_mlp(params: dict, cfg: ModelConfig, x: jax.Array, compute_dtype):
 
 
 def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
-               return_state: bool = False):
+               return_state: bool = False, token_mask=None):
     """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP/MoE].
 
     ``return_state=True`` (prefill) additionally returns the mixer's decode
-    state (conv+SSM caches, or attention KV caches).  With a MoE model
+    state (conv+SSM caches, or attention KV caches).  ``token_mask``
+    (prefill only) zeroes the mixer's scan inputs at left-pad positions
+    (inference/bucketing.py).  With a MoE model
     (``cfg.moe_num_experts > 0``) the non-state form returns
     ``(hidden, residual, aux)`` — the layer's load-balance loss term.
     """
@@ -196,6 +198,11 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
         )
     state = None
     if attn:
+        if token_mask is not None:
+            raise ValueError(
+                "token_mask prefill is SSM-only: attention layers would "
+                "still attend to the pad keys (skip bucketing for hybrids)"
+            )
         if return_state:
             hidden, state = attention_mixer(
                 block_params["mixer"], cfg, normed, return_final_state=True
@@ -208,7 +215,8 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
         if return_state:
             mix = mamba2_mixer if cfg.ssm_layer == "mamba2" else mamba1_mixer
             hidden, state = mix(
-                block_params["mixer"], cfg, normed, return_final_state=True
+                block_params["mixer"], cfg, normed, return_final_state=True,
+                token_mask=token_mask,
             )
         else:
             hidden = _mixer_fwd(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
@@ -609,12 +617,19 @@ def count_params(params) -> int:
 
 
 def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
-               max_len: int = 0):
+               max_len: int = 0, token_mask: jax.Array | None = None):
     """Parallel prefill: one full-sequence forward that also returns the
     per-layer decode state (conv cache, SSM state, attention KV caches
     padded to ``max_len``).  The sequential per-token prefill this replaces
     is what the reference effectively did by re-running the prefix
     (SURVEY.md §3.3).  Shares ``_block_fwd`` with lm_forward.
+
+    ``token_mask`` (b, t) {0,1} marks LEFT-padded bucketed prompts
+    (inference/bucketing.py): pad positions contribute nothing to the
+    conv/SSM state, so the returned state matches the unpadded
+    prefill's — the conv cache bit-exactly, the SSM state up to
+    chunk-regrouping rounding (~1e-7 fp32).  Pure-SSM stacks only —
+    attention layers reject it (_block_fwd).
 
     Returns (last_logits (b, V) fp32, state) — state feeds lm_step.
     """
@@ -632,6 +647,12 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
         k, v, length = state
         pad = [(0, 0), (0, max_len - k.shape[1]), (0, 0), (0, 0)]
         return jnp.pad(k, pad), jnp.pad(v, pad), length
+
+    if cfg.attn_layer_idx and token_mask is not None:
+        raise ValueError(
+            "token_mask prefill is SSM-only (attention layers would attend "
+            "to pad keys); call with the exact prompt length instead"
+        )
 
     if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
         # periodic hybrid: superstep scan mirroring lm_forward's
@@ -699,7 +720,8 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
         def body(carry, bp):
             hidden, residual = carry
             hidden, residual, st = _block_fwd(
-                bp, cfg, hidden, residual, False, return_state=True
+                bp, cfg, hidden, residual, False, return_state=True,
+                token_mask=token_mask,
             )
             return (hidden, residual), st
 

@@ -81,10 +81,17 @@ def mamba1_mixer(
     initial_ssm_state: jax.Array | None = None,
     return_final_state: bool = False,
     seq_ctx=None,
+    token_mask: jax.Array | None = None,
 ):
     """Full-sequence Mamba-1 mixer forward.
 
     u (b, t, d_model) -> y (b, t, d_model) [, (conv_state, ssm_state)].
+
+    ``token_mask`` (b, t) {0,1} zeroes the conv/scan inputs at masked
+    positions (left-padded bucketed prefill, inference/bucketing.py):
+    with x=0 the selective scan's update term dt*B*x vanishes and the
+    state only decays, so a zero initial state stays zero through the
+    pad prefix — same contract as mamba2_mixer.
     """
     di = cfg.d_inner
     ds = cfg.effective_d_state
@@ -97,6 +104,10 @@ def mamba1_mixer(
     xz = linear(params["in_proj"], u, compute_dtype)
     x, z = xz[..., :di], xz[..., di:]
 
+    if token_mask is not None:
+        if seq_ctx is not None:
+            raise ValueError("token_mask is a single-device prefill feature")
+        x = x * token_mask[..., None].astype(x.dtype)
     if seq_ctx is not None:
         from mamba_distributed_tpu.parallel.seq_parallel import sp_conv1d
 
@@ -112,6 +123,8 @@ def mamba1_mixer(
             return_final_state=True,
             impl=cfg.conv_impl,
         )
+    if token_mask is not None:
+        x = x * token_mask[..., None].astype(x.dtype)
 
     x_db = linear(params["x_proj"], x, compute_dtype)
     dt = x_db[..., :dtr]

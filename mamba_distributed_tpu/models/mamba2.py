@@ -93,6 +93,7 @@ def mamba2_mixer(
     initial_ssm_state: jax.Array | None = None,
     return_final_state: bool = False,
     seq_ctx=None,
+    token_mask: jax.Array | None = None,
 ):
     """Full-sequence Mamba-2 mixer forward.
 
@@ -104,6 +105,12 @@ def mamba2_mixer(
       seq_ctx: optional ``parallel.seq_parallel.SeqContext`` — when given,
         the conv halo and SSD chunk-state passing run across the mesh's
         ``seq`` axis instead of locally; decode-state carry is rejected.
+      token_mask: optional (b, t) {0,1} — zeroes the conv/SSM inputs at
+        masked positions so a left-padded prompt produces the same scan
+        state as the unpadded one (inference/bucketing.py).  Masked
+        BEFORE the conv (pad inputs must look like the zero initial conv
+        state) and AFTER it (the conv bias + silu would otherwise leak a
+        nonzero x/B into the SSM update at pad positions).
 
     Returns: y (b, t, d_model) [, (conv_state, ssm_state)].
     """
@@ -117,6 +124,10 @@ def mamba2_mixer(
     zxbcdt = linear(params["in_proj"], u, compute_dtype)
     z, xBC, dt = _split_zxbcdt(zxbcdt, cfg)
 
+    if token_mask is not None:
+        if seq_ctx is not None:
+            raise ValueError("token_mask is a single-device prefill feature")
+        xBC = xBC * token_mask[..., None].astype(xBC.dtype)
     if seq_ctx is not None:
         from mamba_distributed_tpu.parallel.seq_parallel import sp_conv1d
 
@@ -134,6 +145,8 @@ def mamba2_mixer(
             return_final_state=True,
             impl=cfg.conv_impl,
         )
+    if token_mask is not None:
+        xBC = xBC * token_mask[..., None].astype(xBC.dtype)
     x, B, C = _split_xbc(xBC, cfg)
 
     x = x.reshape(b, t, nh, cfg.headdim)

@@ -1,0 +1,33 @@
+"""Continuous-batching serving with a pooled recurrent-state cache.
+
+docs/SERVING.md has the architecture; the short version:
+
+  state_cache  fixed-capacity slot pool of per-layer conv+SSM states
+               (+ per-slot sampling params), jit insert/evict
+  engine       one compiled decode tick advances all occupied slots;
+               admission between ticks, no retracing
+  scheduler    FCFS queue + request lifecycle (queued -> prefill ->
+               decode -> finished)
+"""
+
+from mamba_distributed_tpu.serving.engine import ServingEngine
+from mamba_distributed_tpu.serving.scheduler import (
+    FCFSScheduler,
+    GenerationRequest,
+    GenerationResult,
+    RequestStatus,
+    TokenEvent,
+)
+from mamba_distributed_tpu.serving.state_cache import evict, init_pool, insert
+
+__all__ = [
+    "FCFSScheduler",
+    "GenerationRequest",
+    "GenerationResult",
+    "RequestStatus",
+    "ServingEngine",
+    "TokenEvent",
+    "evict",
+    "init_pool",
+    "insert",
+]

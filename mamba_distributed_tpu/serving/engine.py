@@ -1,0 +1,322 @@
+"""Continuous-batching serving engine over the pooled recurrent-state cache.
+
+One compiled decode tick advances EVERY occupied slot by ``tokens_per_tick``
+tokens; finished and empty slots are masked, and new requests are admitted
+into freed slots between ticks — bucketed prefill (inference/bucketing.py)
+plus ``state_cache.insert`` write a request's state into its slot without
+retracing anything.  Decode is weight-bandwidth-bound, so filling more
+slots costs (nearly) nothing per tick: aggregate tokens/sec scales with
+occupancy (docs/SERVING.md; scripts/bench_serving.py measures it against
+sequential ``generate()`` calls).
+
+Parity contract: a request's token stream is bit-identical to a solo
+``generate(params, cfg, prompt[None], key, ...)`` call with the same key
+whenever ``request.top_k == engine.max_top_k`` (the static top-k width),
+regardless of what else shares the batch.  The three pieces that make
+this hold, all pinned by tests/test_serving.py:
+
+* both pad the same prompt to the same bucket, so engine prefill and
+  the solo call's are the identical computation (models/lm.lm_prefill
+  token_mask; bucket size deliberately not a knob);
+* the step-i sampling key is ``fold_in(request_key, i)``, reproducible
+  from the per-slot counter alone — and a vmapped per-row
+  ``categorical`` draws the same bits as generate's batch-1 call;
+* ``lm_step`` is row-independent, so co-batched strangers can't
+  perturb a slot's logits.
+
+Requests with ``top_k < max_top_k`` are served via masking (positions
+beyond the slot's k get -inf) — a valid top-k draw, but from a different
+noise stream than a solo ``generate(top_k=k)`` call would use.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
+from mamba_distributed_tpu.inference.generate import _decode_params, vocab_pad_mask
+from mamba_distributed_tpu.models.lm import lm_prefill, lm_step
+from mamba_distributed_tpu.serving import state_cache
+from mamba_distributed_tpu.serving.scheduler import (
+    FCFSScheduler,
+    GenerationRequest,
+    GenerationResult,
+    RequestStatus,
+    TokenEvent,
+    _Tracked,
+)
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+# Python-side-effect trace counters (one bump per jit trace) — the
+# bucketing exists to bound these; tests/test_serving.py pins them.
+TRACE_COUNTS = {"prefill": 0, "tick": 0}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _cast_params(params: dict, cfg: ModelConfig) -> dict:
+    """Module-level jitted decode cast so every engine instance over the
+    same params/cfg shares one compilation (the bench builds two)."""
+    return _decode_params(params, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig):
+    """Bucketed batch-1 prompt prefill -> (last_logits (1, V), state)."""
+    TRACE_COUNTS["prefill"] += 1
+    return lm_prefill(params, cfg, ids, token_mask=mask)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "k_max", "steps"), donate_argnums=(1,)
+)
+def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
+    """Advance every slot ``steps`` tokens.  Returns (pool', tokens
+    (steps, S), emitted (steps, S), done (steps, S)) — ``emitted[j, s]``
+    marks a real token (slot live at sub-step j), ``done[j, s]`` the
+    slot's finish state after it; the rest is masked garbage.  The host
+    consumes ``done`` rather than re-deriving the finish rule, so there
+    is exactly one copy of it (here).
+
+    Mirrors generate()'s decode loop exactly: sample from the carried
+    logits with key fold_in(key, step), then lm_step.  Slots that hit
+    their eos keep feeding it forward (same as generate's eos_id path);
+    slots that are empty or budget-done still compute — that waste is
+    the price of a single static-shape trace, and it is reclaimed by
+    admitting new requests into those slots between ticks.
+    """
+    TRACE_COUNTS["tick"] += 1
+    pad_mask = vocab_pad_mask(cfg)
+    col = jnp.arange(k_max)[None, :]
+
+    def one(pool, _):
+        meta = pool["meta"]
+        live = meta["active"] & ~meta["done"]
+        has_eos = meta["eos_id"] >= 0
+        keys = jax.vmap(jax.random.fold_in)(meta["key"], meta["step"])
+        vals, idx = jax.lax.top_k(pool["logits"] + pad_mask, k_max)
+        vals = jnp.where(col < meta["top_k"][:, None], vals, -jnp.inf)
+        # per-row categorical: same bits as generate's batch-1 draw
+        choice = jax.vmap(
+            lambda k, v, t: jax.random.categorical(k, v / t)
+        )(keys, vals, meta["temperature"])
+        tok = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
+        tok = jnp.where(meta["done"] & has_eos, meta["eos_id"], tok)
+        logits, state = lm_step(params, cfg, pool["state"], tok)
+        step = meta["step"] + live.astype(jnp.int32)
+        done = meta["done"] | (
+            live & ((has_eos & (tok == meta["eos_id"])) | (step >= meta["max_new"]))
+        )
+        new_pool = {
+            "state": state,
+            "logits": logits,
+            "meta": {**meta, "step": step, "done": done},
+        }
+        return new_pool, (tok, live, done)
+
+    pool, (tokens, emitted, done) = jax.lax.scan(one, pool, None, length=steps)
+    return pool, tokens, emitted, done
+
+
+class ServingEngine:
+    """Continuous-batching host loop: FCFS admission -> compiled ticks.
+
+    Args:
+      params: trained fp32 params (cast once to the decode layout here).
+      cfg: pure-SSM ModelConfig (attention hybrids are rejected by the
+        slot pool — ROADMAP open item).
+      capacity: slot count S — the max concurrent requests.
+      max_top_k: static top-k width of the compiled sampler; per-request
+        ``top_k`` may be anything in [1, max_top_k] (see parity note in
+        the module docstring).
+      tokens_per_tick: decode sub-steps fused into one compiled tick.
+        Larger amortizes dispatch; smaller admits waiting requests
+        sooner (admission only happens between ticks).
+      retain_results: keep every finished request's GenerationResult in
+        ``self.results`` (what ``run()`` reads).  A long-lived streaming
+        server consuming TokenEvents should pass False — retention
+        grows host memory without bound — and the final event's
+        ``done``/``finish_reason`` carries the completion signal.
+      metrics: a ServingMetrics, or None to create one.
+
+    Prefill buckets are the module defaults of inference/bucketing.py —
+    deliberately not a knob, so the engine and a solo ``generate()``
+    call can never pad the same prompt differently (the parity
+    contract depends on identical padding).
+    """
+
+    def __init__(
+        self,
+        params: dict,
+        cfg: ModelConfig,
+        capacity: int = 8,
+        max_top_k: int = 50,
+        tokens_per_tick: int = 8,
+        retain_results: bool = True,
+        metrics: ServingMetrics | None = None,
+    ):
+        if not 1 <= max_top_k <= cfg.vocab_size_padded:
+            raise ValueError(
+                f"max_top_k={max_top_k} must be in [1, {cfg.vocab_size_padded}]"
+            )
+        if tokens_per_tick < 1:
+            raise ValueError("tokens_per_tick must be >= 1")
+        self.cfg = cfg
+        self.capacity = capacity
+        self.max_top_k = max_top_k
+        self.tokens_per_tick = tokens_per_tick
+        self.retain_results = retain_results
+        self.pool = state_cache.init_pool(cfg, capacity)  # validates cfg
+        self._params = _cast_params(params, cfg=cfg)
+        self.scheduler = FCFSScheduler()
+        self.metrics = metrics or ServingMetrics(capacity)
+        self._free: list[int] = list(range(capacity))
+        self._slots: dict[int, _Tracked] = {}
+        self.results: dict[int, GenerationResult] = {}
+
+    # ------------------------------------------------------------- admission
+
+    def submit(self, request: GenerationRequest) -> int:
+        """Queue a request; returns its request_id."""
+        if not 1 <= request.top_k <= self.max_top_k:
+            raise ValueError(
+                f"request top_k={request.top_k} must be in "
+                f"[1, max_top_k={self.max_top_k}]"
+            )
+        tracked = self.scheduler.submit(request)
+        return tracked.request_id
+
+    def _admit(self, tracked: _Tracked) -> None:
+        slot = self._free.pop(0)
+        tracked.status = RequestStatus.PREFILL
+        r = tracked.request
+        t0 = time.perf_counter()
+        try:
+            prompt = jnp.asarray(r.prompt_ids, jnp.int32)[None, :]
+            padded, mask = pad_to_bucket(
+                prompt, next_pow2_bucket(prompt.shape[1])
+            )
+            # async dispatch: admitting k queued requests between ticks
+            # queues k prefills+inserts without a host sync each — the
+            # next tick's token fetch is the one synchronization point
+            logits, state = _prefill(self._params, padded, mask, cfg=self.cfg)
+            self.pool = state_cache.insert(
+                self.pool, slot, state, logits, r.resolve_key(),
+                r.max_new_tokens, r.top_k, r.temperature,
+                -1 if r.eos_id is None else r.eos_id,
+            )
+        except Exception:
+            # a failed prefill must neither leak the slot (capacity would
+            # shrink for the process lifetime) nor drop the request — it
+            # goes back to the queue head so a caller catching the raise
+            # still sees it in `pending` and can retry or cancel
+            self._free.insert(0, slot)
+            self.scheduler.requeue(tracked)
+            raise
+        # dt is host dispatch time (prefill runs async; the next tick's
+        # fetch absorbs device completion)
+        self.metrics.record_prefill(
+            int(prompt.shape[1]), time.perf_counter() - t0
+        )
+        tracked.slot = slot
+        tracked.status = RequestStatus.DECODE
+        self._slots[slot] = tracked
+
+    # ------------------------------------------------------------- decoding
+
+    @property
+    def pending(self) -> int:
+        """Requests not yet finished (queued + in-flight)."""
+        return self.scheduler.depth + len(self._slots)
+
+    def step(self) -> list[TokenEvent]:
+        """Admit what fits, run one compiled tick, stream the tokens.
+
+        Returns the tick's TokenEvents in emission order; finished
+        requests are evicted and their GenerationResults recorded in
+        ``self.results``.
+        """
+        while self._free and self.scheduler.depth:
+            self._admit(self.scheduler.pop())
+        if not self._slots:
+            return []
+        occupied = len(self._slots)
+        t0 = time.perf_counter()
+        self.pool, tokens, emitted, done = _tick(
+            self._params, self.pool, cfg=self.cfg, k_max=self.max_top_k,
+            steps=self.tokens_per_tick,
+        )
+        tokens = np.asarray(tokens)  # (steps, S) — the host sync point
+        emitted = np.asarray(emitted)
+        done = np.asarray(done)
+        dt = time.perf_counter() - t0
+
+        events: list[TokenEvent] = []
+        for j in range(self.tokens_per_tick):
+            for slot, tracked in self._slots.items():
+                if not emitted[j, slot]:
+                    continue
+                r = tracked.request
+                tok = int(tokens[j, slot])
+                tracked.new_tokens.append(tok)
+                # the finish RULE lives in _tick; the host only reads its
+                # verdict and labels the reason from the emitted token
+                if done[j, slot]:
+                    tracked.status = RequestStatus.FINISHED
+                    tracked.finish_reason = (
+                        "eos" if (r.eos_id is not None and tok == r.eos_id)
+                        else "length"
+                    )
+                events.append(TokenEvent(
+                    tracked.request_id, tok, len(tracked.new_tokens) - 1,
+                    bool(done[j, slot]), tracked.finish_reason,
+                ))
+        for slot in [s for s, t in self._slots.items()
+                     if t.status is RequestStatus.FINISHED]:
+            tracked = self._slots.pop(slot)
+            self.pool = state_cache.evict(self.pool, slot)
+            self._free.append(slot)
+            if self.retain_results:
+                r = tracked.request
+                self.results[tracked.request_id] = GenerationResult(
+                    request_id=tracked.request_id,
+                    prompt_ids=r.prompt_ids,
+                    new_tokens=np.asarray(tracked.new_tokens, np.int32),
+                    finish_reason=tracked.finish_reason,
+                )
+        self._free.sort()
+        self.metrics.record_tick(
+            occupied=occupied, queue_depth=self.scheduler.depth,
+            tokens_emitted=len(events), dt_s=dt,
+        )
+        return events
+
+    # ------------------------------------------------------------- frontends
+
+    def serve(self, requests=()):  # -> Iterator[TokenEvent]
+        """Minimal serving frontend: accept requests, stream tokens back.
+
+        Yields TokenEvents as ticks complete; more requests may be
+        ``submit``-ted concurrently from the consuming side between
+        yields (the generator re-checks ``pending`` each tick).
+        """
+        for r in requests:
+            self.submit(r)
+        while self.pending:
+            yield from self.step()
+
+    def run(self, requests=()) -> list[GenerationResult]:
+        """Submit ``requests``, drain the engine, return results in
+        submission order."""
+        if not self.retain_results:
+            raise ValueError("run() needs retain_results=True; stream "
+                             "via serve() instead")
+        ids = [self.submit(r) for r in requests]
+        for _ in self.serve():
+            pass
+        return [self.results[i] for i in ids]

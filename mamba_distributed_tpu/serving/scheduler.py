@@ -1,0 +1,140 @@
+"""Request lifecycle + FCFS admission for the serving engine.
+
+A request moves QUEUED -> PREFILL -> DECODE -> FINISHED:
+
+  QUEUED    in the scheduler's FCFS queue, waiting for a free slot
+  PREFILL   bucketed full-prompt forward building its recurrent state
+  DECODE    occupying a slot; one token per engine tick
+  FINISHED  sampled its ``eos_id`` or exhausted ``max_new_tokens``
+
+The scheduler is deliberately minimal — an arrival-order deque plus the
+lifecycle bookkeeping.  Admission happens between compiled decode ticks
+(serving/engine.py), so policy changes (priorities, prefill batching,
+preemption) are host-side swaps that never touch compiled code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    """One generation job.  ``seed`` derives the sampling key; passing the
+    same key to a solo ``generate()`` call reproduces this request's
+    tokens exactly (the engine parity contract, tests/test_serving.py)."""
+
+    prompt_ids: np.ndarray  # (t,) int32
+    max_new_tokens: int = 32
+    top_k: int = 50
+    temperature: float = 1.0
+    eos_id: int | None = None
+    seed: int = 0
+    key: jax.Array | None = None  # overrides seed when given
+    # echo of the id the scheduler assigned at the LAST submit of this
+    # object (the authoritative id lives on the scheduler's tracker, so
+    # resubmission is safe); submit()/TokenEvents carry the real one
+    request_id: int | None = None
+
+    def resolve_key(self) -> jax.Array:
+        key = self.key if self.key is not None else jax.random.PRNGKey(self.seed)
+        if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+            # new-style typed keys: unwrap to the raw uint32 pair the slot
+            # pool stores (fold_in over raw data draws the same bits)
+            key = jax.random.key_data(key)
+        return key
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One streamed token (serve()/step() output, in emission order)."""
+
+    request_id: int
+    token: int
+    index: int  # 0-based position within the generated suffix
+    done: bool
+    finish_reason: str | None = None  # "eos" | "length" when done
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    request_id: int
+    prompt_ids: np.ndarray
+    new_tokens: np.ndarray  # generated suffix (includes eos when hit)
+    finish_reason: str  # "eos" | "length"
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """prompt + generated suffix, ``generate()``-shaped."""
+        return np.concatenate([self.prompt_ids, self.new_tokens])
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """Host-side mirror of one in-flight request.  ``request_id`` lives
+    here (not on the GenerationRequest) so submitting the same request
+    object twice yields two independent streams."""
+
+    request: GenerationRequest
+    request_id: int = -1
+    status: RequestStatus = RequestStatus.QUEUED
+    slot: int | None = None
+    new_tokens: list[int] = dataclasses.field(default_factory=list)
+    finish_reason: str | None = None
+
+
+class FCFSScheduler:
+    """First-come-first-served admission queue."""
+
+    def __init__(self) -> None:
+        self._queue: deque[_Tracked] = deque()
+        self._next_id = 0
+
+    def submit(self, request: GenerationRequest) -> _Tracked:
+        prompt = np.asarray(request.prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if request.top_k < 1:
+            raise ValueError("top_k must be >= 1")
+        if request.temperature <= 0.0:
+            raise ValueError("temperature must be > 0")
+        request.prompt_ids = prompt
+        # the scheduler's counter is authoritative: every submit gets a
+        # fresh id, so resubmitting an object can't collide two streams
+        tracked = _Tracked(request=request, request_id=self._next_id)
+        self._next_id += 1
+        request.request_id = tracked.request_id  # convenience echo
+        self._queue.append(tracked)
+        return tracked
+
+    def pop(self) -> _Tracked | None:
+        """Next request to admit (arrival order), or None when empty."""
+        return self._queue.popleft() if self._queue else None
+
+    def requeue(self, tracked: _Tracked) -> None:
+        """Put a popped-but-not-admitted request back at the queue head
+        (a failed prefill must not drop it)."""
+        tracked.status = RequestStatus.QUEUED
+        self._queue.appendleft(tracked)
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def __iter__(self) -> Iterator[_Tracked]:
+        return iter(self._queue)

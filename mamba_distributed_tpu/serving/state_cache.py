@@ -1,0 +1,132 @@
+"""Pooled recurrent-state cache: the slot pool under the serving engine.
+
+Mamba's decode state is O(1) per sequence — a (d_conv-1)-wide conv cache
+plus one (nheads, headdim, d_state) SSM state per layer — so a serving
+"KV cache" collapses to a fixed-capacity pool of S slots whose arrays
+never change shape: admitting, advancing, and finishing requests are all
+writes into a preallocated batch axis ("Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching for Inference",
+PAPERS.md; the slot-pool idiom follows the ragged-paged-attention
+serving pattern, minus the paging that attention's growing KV needs).
+
+The pool is a plain pytree:
+
+  pool = {
+    "state":  init_lm_state(cfg, batch=capacity)   # (L, S, ...) leaves
+    "logits": (S, V_padded) fp32                    # last logits per slot
+    "meta": {
+      "active":      (S,) bool   # slot holds a live request
+      "done":        (S,) bool   # request finished, awaiting eviction
+      "key":         (S, 2) u32  # request base PRNG key
+      "step":        (S,) i32    # tokens generated so far
+      "max_new":     (S,) i32    # per-request budget
+      "top_k":       (S,) i32    # per-slot top-k (<= the engine's static k_max)
+      "temperature": (S,) f32
+      "eos_id":      (S,) i32    # -1 => no EOS stopping
+    },
+  }
+
+``insert``/``evict`` are jit-compiled with the pool donated: the slot
+index is a traced scalar, so admitting a request into ANY slot reuses
+one trace, and the update lowers to ``dynamic_update_slice`` on the
+donated buffers — no reallocation, no retrace, which is what keeps the
+decode loop hot while requests come and go (serving/engine.py).
+
+Pure-SSM stacks only: per-slot attention KV caches need a per-row
+length (the stacked cache carries one scalar), a ROADMAP open item.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.models.lm import init_lm_state
+
+
+def init_pool(cfg: ModelConfig, capacity: int) -> dict:
+    """Allocate an empty slot pool for ``capacity`` concurrent requests."""
+    if cfg.attn_layer_idx:
+        raise ValueError(
+            "the serving slot pool is pure-SSM only: stacked attention KV "
+            "caches share one length scalar, so per-slot lengths can't be "
+            "pooled yet (ROADMAP open item)"
+        )
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    S = capacity
+    return {
+        "state": init_lm_state(cfg, batch=S),
+        "logits": jnp.zeros((S, cfg.vocab_size_padded), jnp.float32),
+        "meta": {
+            "active": jnp.zeros((S,), bool),
+            "done": jnp.zeros((S,), bool),
+            "key": jnp.zeros((S, 2), jnp.uint32),
+            "step": jnp.zeros((S,), jnp.int32),
+            "max_new": jnp.ones((S,), jnp.int32),
+            "top_k": jnp.ones((S,), jnp.int32),
+            "temperature": jnp.ones((S,), jnp.float32),
+            "eos_id": jnp.full((S,), -1, jnp.int32),
+        },
+    }
+
+
+def _set_row(arr: jax.Array, slot: jax.Array, value) -> jax.Array:
+    """Write one row of a (S, ...) array at a traced slot index."""
+    v = jnp.asarray(value, arr.dtype).reshape((1,) + arr.shape[1:])
+    return jax.lax.dynamic_update_slice_in_dim(arr, v, slot, axis=0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def insert(
+    pool: dict,
+    slot: jax.Array,
+    state: dict,
+    logits: jax.Array,
+    key: jax.Array,
+    max_new: jax.Array,
+    top_k: jax.Array,
+    temperature: jax.Array,
+    eos_id: jax.Array,
+) -> dict:
+    """Admit a prefilled request (batch-1 ``state`` + last ``logits``)
+    into ``slot``.  One trace serves every (slot, request) combination —
+    all arguments are traced, the pool buffers are donated."""
+    # state leaves are layer-stacked (L, 1, ...) -> write batch axis 1
+    new_state = jax.tree.map(
+        lambda p, n: jax.lax.dynamic_update_slice_in_dim(
+            p, n.astype(p.dtype), slot, axis=1
+        ),
+        pool["state"],
+        state,
+    )
+    meta = pool["meta"]
+    new_meta = {
+        "active": _set_row(meta["active"], slot, True),
+        "done": _set_row(meta["done"], slot, False),
+        "key": _set_row(meta["key"], slot, key),
+        "step": _set_row(meta["step"], slot, 0),
+        "max_new": _set_row(meta["max_new"], slot, max_new),
+        "top_k": _set_row(meta["top_k"], slot, top_k),
+        "temperature": _set_row(meta["temperature"], slot, temperature),
+        "eos_id": _set_row(meta["eos_id"], slot, eos_id),
+    }
+    return {
+        "state": new_state,
+        "logits": _set_row(pool["logits"], slot, logits),
+        "meta": new_meta,
+    }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def evict(pool: dict, slot: jax.Array) -> dict:
+    """Free ``slot``: mark it empty.  The stale state/logits stay in
+    place — the next ``insert`` overwrites them, and the decode tick
+    masks inactive slots, so no scrubbing is needed."""
+    meta = dict(pool["meta"])
+    meta["active"] = _set_row(meta["active"], slot, False)
+    meta["done"] = _set_row(meta["done"], slot, False)
+    return {"state": pool["state"], "logits": pool["logits"], "meta": meta}

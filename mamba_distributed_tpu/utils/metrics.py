@@ -92,3 +92,82 @@ class MetricsLogger:
             f"{step} val {loss:.4f}",
             {"step": step, "kind": "val", "loss": round(loss, 4)},
         )
+
+
+class ServingMetrics:
+    """Serving-engine counters: queue depth, slot occupancy, throughput.
+
+    The engine (serving/engine.py) calls ``record_prefill`` once per
+    admission and ``record_tick`` once per compiled decode tick;
+    ``summary()`` rolls everything up for bench output
+    (scripts/bench_serving.py).  With ``jsonl_path`` set, every tick also
+    appends one structured record — same one-JSON-object-per-line format
+    as MetricsLogger's metrics.jsonl, tagged ``"kind": "serving_tick"``.
+
+    Decode is weight-bandwidth-bound, so ``mean_slot_occupancy`` is the
+    throughput model: each tick reads the full weights once regardless of
+    how many slots are live, and every occupied slot rides that same read
+    — batch-fill is (nearly) free aggregate tokens/sec (docs/SERVING.md).
+    """
+
+    def __init__(self, capacity: int, jsonl_path: str | None = None):
+        self.capacity = capacity
+        self.jsonl_path = jsonl_path
+        self.ticks = 0
+        self.decode_tokens = 0
+        self.decode_time_s = 0.0
+        self.prefills = 0
+        self.prefill_tokens = 0
+        self.prefill_time_s = 0.0
+        self._occupied_sum = 0
+        self._queue_depth_sum = 0
+        self.peak_queue_depth = 0
+
+    def record_prefill(self, prompt_tokens: int, dt_s: float) -> None:
+        self.prefills += 1
+        self.prefill_tokens += prompt_tokens
+        self.prefill_time_s += dt_s
+
+    def record_tick(
+        self, occupied: int, queue_depth: int, tokens_emitted: int, dt_s: float
+    ) -> None:
+        self.ticks += 1
+        self.decode_tokens += tokens_emitted
+        self.decode_time_s += dt_s
+        self._occupied_sum += occupied
+        self._queue_depth_sum += queue_depth
+        self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
+        if self.jsonl_path:
+            # per-write open, same idiom as MetricsLogger._append above:
+            # crash-safe (every line is flushed+closed) and ticks are
+            # O(10ms+) model steps, so the syscall pair is noise
+            record = {
+                "kind": "serving_tick", "tick": self.ticks,
+                "occupied": occupied, "capacity": self.capacity,
+                "queue_depth": queue_depth,
+                "tokens_emitted": tokens_emitted,
+                "tick_ms": round(dt_s * 1000, 3),
+            }
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(_jsonable(record)) + "\n")
+
+    def summary(self) -> dict:
+        return {
+            "ticks": self.ticks,
+            "decode_tokens": self.decode_tokens,
+            "decode_tokens_per_sec": (
+                round(self.decode_tokens / self.decode_time_s, 1)
+                if self.decode_time_s else None
+            ),
+            "mean_slot_occupancy": (
+                round(self._occupied_sum / (self.ticks * self.capacity), 4)
+                if self.ticks else 0.0
+            ),
+            "mean_queue_depth": (
+                round(self._queue_depth_sum / self.ticks, 2) if self.ticks else 0.0
+            ),
+            "peak_queue_depth": self.peak_queue_depth,
+            "prefills": self.prefills,
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_time_s": round(self.prefill_time_s, 4),
+        }

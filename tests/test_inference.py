@@ -174,6 +174,55 @@ def test_eval_cli_restores_own_checkpoints(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_generate_eos_id_stops_deterministically(rng):
+    """With eos_id set, rows that sample it emit eos for the rest of the
+    budget; up to the first eos the stream is unchanged (satellite: EOT
+    stopping inside the decode loop)."""
+    cfg = cfg_for("mamba2")
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, 64)
+    key = jax.random.PRNGKey(3)
+    base = np.asarray(
+        generate(params, cfg, prompt, key, max_new_tokens=10)
+    )[0, 6:]
+    eos = int(base[3])  # a token we know the stream contains
+    out = np.asarray(
+        generate(params, cfg, prompt, key, max_new_tokens=10, eos_id=eos)
+    )[0, 6:]
+    first = int(np.nonzero(base == eos)[0][0])
+    np.testing.assert_array_equal(out[: first + 1], base[: first + 1])
+    assert (out[first:] == eos).all()
+
+
+def test_generate_bucketing_matches_exact_length(rng):
+    """A bucketed (left-padded, masked) prefill is numerically equivalent
+    to the exact-length one: prefill logits/state agree to fp tolerance
+    (padding shifts chunk boundaries, so not bit-exact) and greedy
+    decode streams match on this backend (near-tie argmax flips are the
+    only way they could differ)."""
+    from mamba_distributed_tpu.inference import next_pow2_bucket, pad_to_bucket
+    from mamba_distributed_tpu.models.lm import lm_prefill
+
+    for layer in ("mamba2", "mamba1"):
+        cfg = cfg_for(layer)
+        params = init_lm_params(jax.random.PRNGKey(0), cfg)
+        # 11 is off-bucket: pads up to 16
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 11), 0, 64)
+        lg, st = lm_prefill(params, cfg, prompt)
+        padded, mask = pad_to_bucket(prompt, next_pow2_bucket(11))
+        lg_b, st_b = lm_prefill(params, cfg, padded, token_mask=mask)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lg_b),
+                                   atol=1e-4, rtol=1e-4)
+        for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st_b)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+        a = generate(params, cfg, prompt, rng, max_new_tokens=6, top_k=1)
+        b = generate(params, cfg, prompt, rng, max_new_tokens=6, top_k=1,
+                     length_bucketing=False)
+        assert a.shape == b.shape == (2, 17)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_generate_deterministic_per_key(rng):
     cfg = cfg_for("mamba2")
     params = init_lm_params(jax.random.PRNGKey(0), cfg)

@@ -1,0 +1,369 @@
+"""Serving-engine tests: slot-pool mechanics, generate() parity, tracing.
+
+The parity tests are the subsystem's backbone: a request's tokens must be
+bit-identical to a solo ``generate()`` call with the same key no matter
+what admissions/evictions happen around it in the pool.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate, next_pow2_bucket, pad_to_bucket
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.serving import (
+    GenerationRequest,
+    ServingEngine,
+    init_pool,
+    insert,
+)
+from mamba_distributed_tpu.serving import state_cache
+
+pytestmark = [pytest.mark.serving, pytest.mark.fast]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(layer="mamba2"):
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def solo(params, cfg, prompt, key, **kw):
+    """Reference: batch-1 generate(), returning just the generated suffix."""
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None], key, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------- slot pool
+
+
+def test_insert_writes_one_slot(setup):
+    cfg, params = setup
+    pool = init_pool(cfg, capacity=3)
+    from mamba_distributed_tpu.models.lm import lm_prefill
+
+    prompt = jnp.ones((1, 8), jnp.int32)
+    logits, state = lm_prefill(params, cfg, prompt)
+    pool = insert(pool, 1, state, logits, jax.random.PRNGKey(3), 5, 7, 0.5, 42)
+    meta = pool["meta"]
+    assert np.asarray(meta["active"]).tolist() == [False, True, False]
+    assert int(meta["max_new"][1]) == 5 and int(meta["top_k"][1]) == 7
+    assert float(meta["temperature"][1]) == 0.5 and int(meta["eos_id"][1]) == 42
+    np.testing.assert_array_equal(
+        np.asarray(pool["logits"][1]), np.asarray(logits[0])
+    )
+    # the written slot's state rows match the prefill state; others untouched
+    for pl, nl in zip(jax.tree.leaves(pool["state"]), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(pl[:, 1]), np.asarray(nl[:, 0]))
+        assert not np.asarray(pl[:, 0]).any() and not np.asarray(pl[:, 2]).any()
+
+
+def test_evict_frees_slot_only(setup):
+    cfg, params = setup
+    pool = init_pool(cfg, capacity=2)
+    from mamba_distributed_tpu.models.lm import lm_prefill
+
+    logits, state = lm_prefill(params, cfg, jnp.ones((1, 8), jnp.int32))
+    pool = insert(pool, 0, state, logits, jax.random.PRNGKey(0), 4, 1, 1.0, -1)
+    pool = insert(pool, 1, state, logits, jax.random.PRNGKey(1), 4, 1, 1.0, -1)
+    pool = state_cache.evict(pool, 0)
+    assert np.asarray(pool["meta"]["active"]).tolist() == [False, True]
+
+
+def test_pool_rejects_hybrid():
+    cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
+                      headdim=8, chunk_size=16, d_state=16,
+                      compute_dtype="float32", attn_layer_idx=(1,),
+                      attn_num_heads=4, remat=False)
+    with pytest.raises(ValueError, match="pure-SSM"):
+        init_pool(cfg, capacity=2)
+
+
+# -------------------------------------------------------------- engine parity
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+def test_single_request_parity(layer):
+    """Token-for-token identical to a solo generate() with the same key."""
+    cfg = tiny_cfg(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(1), (9,), 0, 64), np.int32
+    )
+    key = jax.random.PRNGKey(7)
+    eng = ServingEngine(params, cfg, capacity=3, tokens_per_tick=2)
+    res = eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=7,
+                                     temperature=0.9, key=key)])[0]
+    assert res.finish_reason == "length"
+    assert res.new_tokens.tolist() == solo(
+        params, cfg, prompt, key, max_new_tokens=7, temperature=0.9
+    )
+    assert res.tokens.tolist() == prompt.tolist() + res.new_tokens.tolist()
+
+
+def test_single_request_parity_with_eos(setup):
+    """EOS finish: the engine stops where generate(eos_id=...) pins eos."""
+    cfg, params = setup
+    prompt = np.asarray([5, 9, 3, 1], np.int32)
+    key = jax.random.PRNGKey(11)
+    ref = solo(params, cfg, prompt, key, max_new_tokens=12)
+    eos = ref[2]  # force a mid-stream finish on a token we know gets sampled
+    ref_eos = solo(params, cfg, prompt, key, max_new_tokens=12, eos_id=eos)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=3)
+    res = eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=12,
+                                     eos_id=eos, key=key)])[0]
+    assert res.finish_reason == "eos"
+    assert res.new_tokens[-1] == eos
+    # the engine's stream is generate's, truncated at (and including) eos
+    n = len(res.new_tokens)
+    assert res.new_tokens.tolist() == ref_eos[:n]
+    assert all(t == eos for t in ref_eos[n - 1:])
+
+
+def test_interleaved_admit_evict_parity(setup):
+    """Admit B mid-flight of A, finish A, admit C into A's freed slot —
+    every request still matches its solo generate() run (satellite #3)."""
+    cfg, params = setup
+    keys = {n: jax.random.PRNGKey(20 + i) for i, n in enumerate("ABC")}
+    prompts = {
+        "A": np.asarray([1, 2, 3, 4, 5], np.int32),
+        "B": np.asarray([7, 8, 9], np.int32),
+        "C": np.asarray([4, 4, 4, 4, 4, 4, 4], np.int32),
+    }
+    budgets = {"A": 4, "B": 10, "C": 5}
+
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1)
+    ids = {}
+    ids["A"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["A"], max_new_tokens=budgets["A"], key=keys["A"]))
+    eng.step()  # A decoding alone
+    eng.step()
+    ids["B"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["B"], max_new_tokens=budgets["B"], key=keys["B"]))
+    eng.step()  # B admitted mid-flight of A
+    ids["C"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["C"], max_new_tokens=budgets["C"], key=keys["C"]))
+    # capacity 2: C must wait in queue until A finishes and frees its slot
+    assert eng.scheduler.depth == 1
+    while eng.pending:
+        eng.step()
+    assert len(eng.results) == 3
+    for name in "ABC":
+        got = eng.results[ids[name]].new_tokens.tolist()
+        want = solo(params, cfg, prompts[name], keys[name],
+                    max_new_tokens=budgets[name])
+        assert got == want, f"request {name} diverged: {got} vs {want}"
+
+
+def test_top_k_one_slot_is_greedy(setup):
+    """A top_k=1 slot decodes greedily whatever shares the pool."""
+    cfg, params = setup
+    prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    res = eng.run([
+        GenerationRequest(prompt_ids=prompt, max_new_tokens=6, top_k=1,
+                          key=jax.random.PRNGKey(0)),
+        GenerationRequest(prompt_ids=prompt[:3], max_new_tokens=6,
+                          key=jax.random.PRNGKey(1)),
+    ])
+    want = solo(params, cfg, prompt, jax.random.PRNGKey(99),
+                max_new_tokens=6, top_k=1)  # greedy: key-independent
+    assert res[0].new_tokens.tolist() == want
+
+
+def test_typed_prng_key_request_parity(setup):
+    """A new-style jax.random.key request draws the same stream as the
+    equivalent legacy PRNGKey (the pool stores raw key data)."""
+    cfg, params = setup
+    prompt = np.asarray([2, 4, 6], np.int32)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    res = eng.run([
+        GenerationRequest(prompt_ids=prompt, max_new_tokens=5,
+                          key=jax.random.key(13)),
+        GenerationRequest(prompt_ids=prompt, max_new_tokens=5,
+                          key=jax.random.PRNGKey(13)),
+    ])
+    assert res[0].new_tokens.tolist() == res[1].new_tokens.tolist()
+    assert res[0].new_tokens.tolist() == solo(
+        params, cfg, prompt, jax.random.PRNGKey(13), max_new_tokens=5
+    )
+
+
+def test_failed_prefill_requeues_and_keeps_slot(setup, monkeypatch):
+    """A prefill that raises must neither leak the slot nor drop the
+    request: it returns to the queue head and a later step() serves it."""
+    from mamba_distributed_tpu.serving import engine as engine_mod
+
+    cfg, params = setup
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+    rid = eng.submit(GenerationRequest(prompt_ids=np.asarray([1, 2], np.int32),
+                                       max_new_tokens=4, key=jax.random.PRNGKey(0)))
+    real_prefill = engine_mod._prefill
+    monkeypatch.setattr(engine_mod, "_prefill",
+                        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(RuntimeError, match="boom"):
+        eng.step()
+    assert eng.pending == 1 and eng.scheduler.depth == 1  # not dropped
+    assert eng._free == [0]  # slot not leaked
+    monkeypatch.setattr(engine_mod, "_prefill", real_prefill)
+    while eng.pending:
+        eng.step()
+    assert len(eng.results[rid].new_tokens) == 4  # served after recovery
+
+
+def test_engine_rejects_oversized_top_k(setup):
+    cfg, params = setup
+    eng = ServingEngine(params, cfg, capacity=1, max_top_k=10)
+    with pytest.raises(ValueError, match="max_top_k"):
+        eng.submit(GenerationRequest(prompt_ids=np.ones(3, np.int32), top_k=11))
+
+
+def test_streaming_serve_event_order(setup):
+    """serve() streams TokenEvents: per-request indices are contiguous and
+    the final event carries done + finish_reason."""
+    cfg, params = setup
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    reqs = [GenerationRequest(prompt_ids=np.asarray([2, 3], np.int32),
+                              max_new_tokens=5, key=jax.random.PRNGKey(i))
+            for i in range(2)]
+    seen: dict[int, list] = {}
+    for ev in eng.serve(reqs):
+        seen.setdefault(ev.request_id, []).append(ev)
+    for rid, evs in seen.items():
+        assert [e.index for e in evs] == list(range(5))
+        assert [e.done for e in evs] == [False] * 4 + [True]
+        assert evs[-1].finish_reason == "length"
+        assert [e.token for e in evs] == eng.results[rid].new_tokens.tolist()
+
+
+# ------------------------------------------------------------ trace bounding
+
+
+def test_generate_length_bucketing_traces():
+    """Distinct prompt lengths inside one bucket share one jit trace
+    (satellite #1: the retracing fix).  Uses its own model shape so the
+    jit cache can't already hold these signatures from other tests."""
+    from mamba_distributed_tpu.inference.generate import TRACE_COUNTS
+
+    cfg = ModelConfig(d_model=16, n_layer=2, vocab_size=32, ssm_layer="mamba2",
+                      headdim=4, chunk_size=8, d_state=8,
+                      compute_dtype="float32")
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    key = jax.random.PRNGKey(0)
+    before = TRACE_COUNTS["generate"]
+    for t in (5, 6, 8):  # all in the 8-bucket
+        generate(params, cfg, jnp.ones((1, t), jnp.int32), key,
+                 max_new_tokens=4, top_k=16)
+    assert TRACE_COUNTS["generate"] == before + 1
+    generate(params, cfg, jnp.ones((1, 9), jnp.int32), key,
+             max_new_tokens=4, top_k=16)
+    assert TRACE_COUNTS["generate"] == before + 2  # 16-bucket: one more
+    generate(params, cfg, jnp.ones((1, 13), jnp.int32), key,
+             max_new_tokens=4, top_k=16)
+    assert TRACE_COUNTS["generate"] == before + 2  # 13 reuses the 16-bucket
+
+
+def test_engine_admission_does_not_retrace():
+    """Prefill traces once per bucket; the decode tick traces once, no
+    matter how many requests rotate through the slots.  Own model shape
+    so the jit cache can't already hold these signatures."""
+    from mamba_distributed_tpu.serving.engine import TRACE_COUNTS
+
+    cfg = ModelConfig(d_model=16, n_layer=3, vocab_size=32, ssm_layer="mamba2",
+                      headdim=4, chunk_size=8, d_state=8,
+                      compute_dtype="float32")
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2, max_top_k=20)
+    p0, t0 = TRACE_COUNTS["prefill"], TRACE_COUNTS["tick"]
+    reqs = [GenerationRequest(prompt_ids=np.ones(n, np.int32), top_k=20,
+                              max_new_tokens=3, key=jax.random.PRNGKey(n))
+            for n in (5, 6, 7, 8, 3)]  # buckets: 8, 8, 8, 8, 8
+    eng.run(reqs)
+    assert TRACE_COUNTS["prefill"] == p0 + 1
+    assert TRACE_COUNTS["tick"] == t0 + 1
+
+
+def test_bucket_helper_contract():
+    assert [next_pow2_bucket(t) for t in (1, 8, 9, 16, 17, 100)] == [
+        8, 8, 16, 16, 32, 128
+    ]
+    with pytest.raises(ValueError):
+        next_pow2_bucket(0)
+    padded, mask = pad_to_bucket(jnp.asarray([[3, 4, 5]], jnp.int32), 8)
+    assert padded.shape == (1, 8) and mask.shape == (1, 8)
+    assert padded[0].tolist() == [0] * 5 + [3, 4, 5]
+    assert mask[0].tolist() == [0.0] * 5 + [1.0, 1.0, 1.0]
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_serving_metrics_counters(tmp_path):
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    jsonl = tmp_path / "serving.jsonl"
+    m = ServingMetrics(capacity=4, jsonl_path=str(jsonl))
+    m.record_prefill(prompt_tokens=16, dt_s=0.5)
+    m.record_tick(occupied=2, queue_depth=3, tokens_emitted=2, dt_s=0.1)
+    m.record_tick(occupied=4, queue_depth=0, tokens_emitted=4, dt_s=0.1)
+    s = m.summary()
+    assert s["ticks"] == 2 and s["decode_tokens"] == 6
+    assert s["mean_slot_occupancy"] == 0.75  # (2+4)/(2*4)
+    assert s["peak_queue_depth"] == 3 and s["mean_queue_depth"] == 1.5
+    assert s["prefills"] == 1 and s["prefill_tokens"] == 16
+    assert s["decode_tokens_per_sec"] == pytest.approx(30.0, rel=0.01)
+    import json
+
+    lines = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    assert len(lines) == 2 and lines[0]["kind"] == "serving_tick"
+    assert lines[1]["occupied"] == 4
+
+
+def test_engine_metrics_report_occupancy(setup):
+    cfg, params = setup
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=4)
+    eng.run([GenerationRequest(prompt_ids=np.ones(4, np.int32),
+                               max_new_tokens=4, key=jax.random.PRNGKey(i))
+             for i in range(3)])
+    s = eng.metrics.summary()
+    assert s["decode_tokens"] == 12 and s["ticks"] >= 2
+    assert 0.0 < s["mean_slot_occupancy"] <= 1.0
+    assert s["prefills"] == 3
+
+
+# ------------------------------------------------------------------- bench
+
+
+def test_bench_serving_cli_smoke(tmp_path):
+    """The bench entrypoint must run end-to-end and emit one JSON line
+    (same contract as bench_decode; keeps the script from rotting)."""
+    import json
+
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="3", SERVE_CAPACITY="2",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="12",
+               SERVE_MAX_NEW="6", SERVE_TOKENS_PER_TICK="3")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0 and rec["requests"] == 3
+    assert 0.0 < rec["mean_slot_occupancy"] <= 1.0
+    assert rec["total_new_tokens"] >= 3
