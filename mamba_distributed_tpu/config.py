@@ -320,12 +320,55 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Host-side telemetry (mamba_distributed_tpu/obs/): spans, divergence
+    sentinels, flight recorder.  Everything defaulting to on is strictly
+    host-side and free of device syncs; ``overflow_threshold`` is the one
+    knob that changes the compiled train step (docs/OBSERVABILITY.md)."""
+
+    # span tracer -> {log_dir}/events.jsonl (trainer, eval, checkpointing)
+    spans: bool = False
+    # non-finite loss/grad-norm watchdog on already-fetched host scalars,
+    # feeding the flight-recorder ring that dumps on crash/divergence
+    sentinel: bool = True
+    # raise DivergenceError on a non-finite step (after dumping) — a NaN
+    # run only burns compute; opt out for loss-spike research
+    halt_on_divergence: bool = True
+    flight_recorder_len: int = 64
+    # > 0: the compiled train step also returns an int32 flag for
+    # grad_norm > threshold (or non-finite), accumulated host-side —
+    # the on-device global-norm overflow counter.  0 disables.
+    overflow_threshold: float = 0.0
+
+    def __post_init__(self):
+        if self.flight_recorder_len < 1:
+            raise ValueError(
+                f"flight_recorder_len must be >= 1, got "
+                f"{self.flight_recorder_len}"
+            )
+        if self.overflow_threshold < 0:
+            raise ValueError(
+                f"overflow_threshold must be >= 0 (0 disables), got "
+                f"{self.overflow_threshold}"
+            )
+        if self.overflow_threshold > 0 and not self.sentinel:
+            raise ValueError(
+                "overflow_threshold > 0 needs sentinel=True — the host-"
+                "side accumulator and flight record that consume the "
+                "on-device flag live on the sentinel"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainConfig:
     """Training loop config (reference: train.py:43-53,89-110,114,133)."""
 
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
     total_batch_size: int = 524288  # tokens/step (train.py:43)
     micro_batch_size: int = 32  # B (train.py:44)

@@ -1,0 +1,43 @@
+"""Unified host-side telemetry: spans, latency histograms, sentinels.
+
+One event vocabulary — a jsonl stream of one-object-per-line records
+tagged by ``"kind"`` — shared by the trainer, checkpointing, eval-in-loop
+and the serving engine, and consumed by ``scripts/obs_report.py``:
+
+  kind="span"          tracer.py     timed host-side phase (data_load,
+                                     train_step, serving_tick, ...)
+  kind="event"         tracer.py     point-in-time marker (divergence, ...)
+  kind="train"/"val"   utils/metrics MetricsLogger step records
+  kind="serving_tick"  utils/metrics ServingMetrics per-tick records
+  kind="request"       utils/metrics per-request latency record
+                                     (queue-wait, TTFT, ITL histogram)
+
+Everything here is strictly host-side: no device syncs, nothing traced
+by jit — enabling telemetry cannot change what XLA compiles (pinned by
+tests/test_obs.py trace-count tests).  docs/OBSERVABILITY.md has the
+schema and span taxonomy.
+"""
+
+from mamba_distributed_tpu.obs.histogram import StreamingHistogram
+from mamba_distributed_tpu.obs.sentinel import (
+    DivergenceError,
+    DivergenceSentinel,
+    FlightRecorder,
+)
+from mamba_distributed_tpu.obs.tracer import (
+    NULL_TRACER,
+    SpanTracer,
+    append_jsonl,
+    jsonable,
+)
+
+__all__ = [
+    "DivergenceError",
+    "DivergenceSentinel",
+    "FlightRecorder",
+    "NULL_TRACER",
+    "SpanTracer",
+    "StreamingHistogram",
+    "append_jsonl",
+    "jsonable",
+]

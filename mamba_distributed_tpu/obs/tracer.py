@@ -1,0 +1,151 @@
+"""Host-side span tracer: one jsonl event stream, zero device impact.
+
+``SpanTracer.span("train_step")`` times a host-side phase and appends one
+``{"kind": "span", ...}`` record on exit.  The tracer never touches a
+jax.Array and is never called from inside a jitted function, so enabling
+it adds zero device syncs and zero extra jit traces — the design point
+that makes it safe to leave on in production serving loops (the pjit-at-
+scale practice of structured *host* telemetry, PAPERS.md "Scalable
+Training of Language Models using JAX pjit and TPUv4").
+
+``NULL_TRACER`` is the disabled implementation: ``span()`` returns a
+shared ``nullcontext``, so instrumented code pays one attribute lookup
+and one function call when telemetry is off.  Code under instrumentation
+takes a tracer instance (trainer, serving engine) rather than consulting
+a global, so two engines in one process can write disjoint streams.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+
+
+def jsonable(record: dict) -> dict:
+    """NaN/Inf are not valid JSON (json.dumps emits bare NaN tokens strict
+    parsers reject — exactly in the diverged-run case where telemetry
+    matters most); serialize them as null."""
+    return {
+        k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+        for k, v in record.items()
+    }
+
+
+def append_jsonl(path: str, record: dict, truncate: bool = False) -> None:
+    """The one way every telemetry writer puts a record on disk: one
+    jsonable object, one line, open-write-close per record — crash-safe
+    (every line lands flushed+closed), and all writers are O(ms+) host
+    phases so the syscall pair is noise.  ``truncate`` starts a fresh
+    stream (writers defer it to their first write so a checkpoint resume
+    can preserve history)."""
+    with open(path, "w" if truncate else "a") as f:
+        f.write(json.dumps(jsonable(record)) + "\n")
+
+
+class SpanTracer:
+    """Appends span/event records to one jsonl file.
+
+    Span records carry the name, start offset from tracer creation
+    (``t_ms``), duration (``dur_ms``), nesting ``depth`` and enclosing
+    ``parent`` span name (per-thread stacks, so the async checkpoint
+    thread can't corrupt the trainer's nesting), plus any keyword
+    attributes given at the call site.  Writes are lock-serialized,
+    open-append-close per record — crash-safe, and these are O(ms+)
+    host phases so the syscall pair is noise.
+    """
+
+    enabled = True
+
+    def __init__(self, jsonl_path: str, _clock=time.perf_counter):
+        parent = os.path.dirname(jsonl_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self.jsonl_path = jsonl_path
+        self._clock = _clock
+        self._t0 = _clock()
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        # truncation is deferred to the first write (same contract as
+        # MetricsLogger) so a checkpoint resume / --auto-restart rebuild
+        # can preserve the pre-crash span history — which is exactly the
+        # stream a post-mortem needs.  NB ``t_ms`` offsets restart from 0
+        # for the new tracer's records.
+        self._truncate_pending = True
+
+    def _stack(self) -> list[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Time the enclosed host-side block as one span record."""
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        t_start = self._clock()
+        try:
+            yield
+        finally:
+            dur = self._clock() - t_start
+            stack.pop()
+            record = {
+                "kind": "span",
+                "name": name,
+                "t_ms": round((t_start - self._t0) * 1000, 3),
+                "dur_ms": round(dur * 1000, 3),
+                "depth": len(stack),
+            }
+            if parent is not None:
+                record["parent"] = parent
+            if attrs:
+                record.update(attrs)
+            self.write(record)
+
+    def event(self, name: str, **attrs) -> None:
+        """Record a point-in-time marker (no duration)."""
+        record = {
+            "kind": "event",
+            "name": name,
+            "t_ms": round((self._clock() - self._t0) * 1000, 3),
+        }
+        if attrs:
+            record.update(attrs)
+        self.write(record)
+
+    def preserve_history(self) -> None:
+        """Keep the existing stream (called on checkpoint resume)."""
+        self._truncate_pending = False
+
+    def write(self, record: dict) -> None:
+        with self._lock:
+            append_jsonl(self.jsonl_path, record,
+                         truncate=self._truncate_pending)
+            self._truncate_pending = False
+
+
+class _NullTracer:
+    """Telemetry off: every operation is a no-op."""
+
+    enabled = False
+    _ctx = contextlib.nullcontext()  # reusable + reentrant
+
+    def span(self, name: str, **attrs):
+        return self._ctx
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def preserve_history(self) -> None:
+        pass
+
+    def write(self, record: dict) -> None:
+        pass
+
+
+NULL_TRACER = _NullTracer()

@@ -40,6 +40,7 @@ import numpy as np
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
+from mamba_distributed_tpu.obs import NULL_TRACER, StreamingHistogram
 from mamba_distributed_tpu.inference.generate import _decode_params, vocab_pad_mask
 from mamba_distributed_tpu.models.lm import lm_prefill, lm_step
 from mamba_distributed_tpu.serving import state_cache
@@ -142,7 +143,12 @@ class ServingEngine:
         server consuming TokenEvents should pass False — retention
         grows host memory without bound — and the final event's
         ``done``/``finish_reason`` carries the completion signal.
-      metrics: a ServingMetrics, or None to create one.
+      metrics: a ServingMetrics, or None to create one.  Give it a
+        ``jsonl_path`` to stream per-tick and per-request records.
+      tracer: an obs.SpanTracer for host-side phase spans
+        (``serving_admit`` / ``serving_tick``); default NULL_TRACER
+        (off).  Strictly host-side: enabling it adds zero device syncs
+        and zero jit traces (pinned by tests/test_obs.py).
 
     Prefill buckets are the module defaults of inference/bucketing.py —
     deliberately not a knob, so the engine and a solo ``generate()``
@@ -159,6 +165,7 @@ class ServingEngine:
         tokens_per_tick: int = 8,
         retain_results: bool = True,
         metrics: ServingMetrics | None = None,
+        tracer=NULL_TRACER,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -175,6 +182,7 @@ class ServingEngine:
         self._params = _cast_params(params, cfg=cfg)
         self.scheduler = FCFSScheduler()
         self.metrics = metrics or ServingMetrics(capacity)
+        self.tracer = tracer
         self._free: list[int] = list(range(capacity))
         self._slots: dict[int, _Tracked] = {}
         self.results: dict[int, GenerationResult] = {}
@@ -220,9 +228,14 @@ class ServingEngine:
             raise
         # dt is host dispatch time (prefill runs async; the next tick's
         # fetch absorbs device completion)
-        self.metrics.record_prefill(
-            int(prompt.shape[1]), time.perf_counter() - t0
-        )
+        t_admit = time.perf_counter()
+        self.metrics.record_prefill(int(prompt.shape[1]), t_admit - t0)
+        # lifecycle stamps: queue-wait is submit -> slot granted; the
+        # per-request ITL histogram rides in the finish record so
+        # obs_report.py can merge per-token percentiles across requests
+        tracked.t_admit = t_admit
+        tracked.itl_hist = StreamingHistogram()
+        self.metrics.record_queue_wait(t_admit - tracked.t_submit)
         tracked.slot = slot
         tracked.status = RequestStatus.DECODE
         self._slots[slot] = tracked
@@ -241,20 +254,25 @@ class ServingEngine:
         requests are evicted and their GenerationResults recorded in
         ``self.results``.
         """
-        while self._free and self.scheduler.depth:
-            self._admit(self.scheduler.pop())
+        if self._free and self.scheduler.depth:
+            with self.tracer.span("serving_admit",
+                                  queued=self.scheduler.depth):
+                while self._free and self.scheduler.depth:
+                    self._admit(self.scheduler.pop())
         if not self._slots:
             return []
         occupied = len(self._slots)
         t0 = time.perf_counter()
-        self.pool, tokens, emitted, done = _tick(
-            self._params, self.pool, cfg=self.cfg, k_max=self.max_top_k,
-            steps=self.tokens_per_tick,
-        )
-        tokens = np.asarray(tokens)  # (steps, S) — the host sync point
-        emitted = np.asarray(emitted)
-        done = np.asarray(done)
-        dt = time.perf_counter() - t0
+        with self.tracer.span("serving_tick", occupied=occupied):
+            self.pool, tokens, emitted, done = _tick(
+                self._params, self.pool, cfg=self.cfg, k_max=self.max_top_k,
+                steps=self.tokens_per_tick,
+            )
+            tokens = np.asarray(tokens)  # (steps, S) — the host sync point
+            emitted = np.asarray(emitted)
+            done = np.asarray(done)
+        t_now = time.perf_counter()
+        dt = t_now - t0
 
         events: list[TokenEvent] = []
         for j in range(self.tokens_per_tick):
@@ -276,13 +294,45 @@ class ServingEngine:
                     tracked.request_id, tok, len(tracked.new_tokens) - 1,
                     bool(done[j, slot]), tracked.finish_reason,
                 ))
+        # --- per-request latency stamps (must precede eviction).  Tokens
+        # land on the host at the tick fetch, so a tick's m tokens share
+        # one timestamp; the per-token ITL observation is the span since
+        # the request's previous arrival (tick start for its first tick)
+        # divided by m — the finest granularity the host can see.
+        for slot, tracked in self._slots.items():
+            m = int(emitted[:, slot].sum())
+            if not m:
+                continue
+            if tracked.t_first_token is None:
+                tracked.t_first_token = t_now
+                self.metrics.record_ttft(t_now - tracked.t_submit)
+                gaps, t_prev = m - 1, t0
+            else:
+                gaps, t_prev = m, tracked.t_last_token
+            if gaps:
+                per_token_s = (t_now - t_prev) / m
+                self.metrics.record_itl(per_token_s, gaps)
+                tracked.itl_hist.record(per_token_s * 1000, gaps)
+            tracked.t_last_token = t_now
         for slot in [s for s, t in self._slots.items()
                      if t.status is RequestStatus.FINISHED]:
             tracked = self._slots.pop(slot)
             self.pool = state_cache.evict(self.pool, slot)
             self._free.append(slot)
+            r = tracked.request
+            self.metrics.record_request({
+                "request_id": tracked.request_id,
+                "prompt_tokens": int(len(r.prompt_ids)),
+                "new_tokens": len(tracked.new_tokens),
+                "finish_reason": tracked.finish_reason,
+                "queue_wait_ms": round(
+                    (tracked.t_admit - tracked.t_submit) * 1000, 3),
+                "ttft_ms": round(
+                    (tracked.t_first_token - tracked.t_submit) * 1000, 3),
+                "e2e_ms": round((t_now - tracked.t_submit) * 1000, 3),
+                "itl_hist": tracked.itl_hist.to_dict(),
+            })
             if self.retain_results:
-                r = tracked.request
                 self.results[tracked.request_id] = GenerationResult(
                     request_id=tracked.request_id,
                     prompt_ids=r.prompt_ids,

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import time
 from collections import deque
 from typing import Iterator
 
@@ -94,6 +95,17 @@ class _Tracked:
     slot: int | None = None
     new_tokens: list[int] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
+    # --- host-side lifecycle stamps (time.perf_counter seconds) from
+    # which the engine derives queue-wait, TTFT and inter-token latency
+    # (obs/: per-request serving telemetry; docs/OBSERVABILITY.md) ---
+    t_submit: float = 0.0  # stamped by FCFSScheduler.submit
+    t_admit: float | None = None  # slot granted, prefill dispatched
+    t_first_token: float | None = None  # first decode token on host
+    t_last_token: float | None = None  # most recent token on host
+    # per-request ITL histogram (StreamingHistogram), created at admit;
+    # rides in the request's jsonl record so obs_report.py can merge
+    # per-token percentiles across requests without storing samples
+    itl_hist: object | None = None
 
 
 class FCFSScheduler:
@@ -116,7 +128,8 @@ class FCFSScheduler:
         request.prompt_ids = prompt
         # the scheduler's counter is authoritative: every submit gets a
         # fresh id, so resubmitting an object can't collide two streams
-        tracked = _Tracked(request=request, request_id=self._next_id)
+        tracked = _Tracked(request=request, request_id=self._next_id,
+                           t_submit=time.perf_counter())
         self._next_id += 1
         request.request_id = tracked.request_id  # convenience echo
         self._queue.append(tracked)

@@ -20,6 +20,11 @@ from mamba_distributed_tpu.models import lm_loss
 from mamba_distributed_tpu.models.lm import lm_loss_pipelined
 from mamba_distributed_tpu.parallel.sharding import batch_sharding
 
+# Python-side-effect trace counters (one bump per jit trace), same idiom
+# as serving/engine.py — tests/test_obs.py pins that enabling host-side
+# telemetry (spans + sentinels) leaves these unchanged.
+TRACE_COUNTS = {"train_step": 0, "eval_step": 0}
+
 
 def make_train_step(
     cfg: TrainConfig,
@@ -28,6 +33,7 @@ def make_train_step(
     params,
     opt_state,
     seq_ctx=None,
+    overflow_threshold: float | None = None,
 ):
     """Build the compiled train step.
 
@@ -36,6 +42,13 @@ def make_train_step(
 
     Returns ``step(params, opt_state, x, y) ->
     (params, opt_state, loss, grad_norm)`` with x/y (accum, B_global, T).
+
+    ``overflow_threshold`` (TelemetryConfig) appends an int32 overflow
+    flag to the outputs: 1 when the pre-clip global grad norm exceeds the
+    threshold or is non-finite.  It is fused into the one existing jit —
+    the sentinel's on-device half costs no extra trace and no extra
+    launch; the host accumulates the flags into a counter
+    (obs/sentinel.py).
     """
     model_cfg = cfg.model
 
@@ -52,6 +65,7 @@ def make_train_step(
         )
 
     def step_fn(params, opt_state, x, y):
+        TRACE_COUNTS["train_step"] += 1
         accum = x.shape[0]
         if pipe > 1:
             # GPipe: the accum microbatches stream through the pipeline
@@ -80,6 +94,11 @@ def make_train_step(
         grad_norm = optax.global_norm(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if overflow_threshold is not None:
+            overflow = jnp.int32(
+                ~jnp.isfinite(grad_norm) | (grad_norm > overflow_threshold)
+            )
+            return params, opt_state, loss, grad_norm, overflow
         return params, opt_state, loss, grad_norm
 
     pshard = jax.tree.map(lambda a: a.sharding, params)
@@ -87,10 +106,11 @@ def make_train_step(
     bshard = batch_sharding(mesh, seq_sharded=seq_ctx is not None)
     # batches carry a leading (replicated) grad-accum axis
     ashard = NamedSharding(mesh, P(None, *bshard.spec))
+    scalars = (None, None, None) if overflow_threshold is not None else (None, None)
     return jax.jit(
         step_fn,
         in_shardings=(pshard, oshard, ashard, ashard),
-        out_shardings=(pshard, oshard, None, None),
+        out_shardings=(pshard, oshard, *scalars),
         donate_argnums=(0, 1),
     )
 
@@ -100,6 +120,7 @@ def make_eval_step(cfg: TrainConfig, mesh, params, seq_ctx=None):
     model_cfg = cfg.model
 
     def eval_fn(params, x, y):
+        TRACE_COUNTS["eval_step"] += 1
         return lm_loss(params, model_cfg, x, y, seq_ctx=seq_ctx)
 
     pshard = jax.tree.map(lambda a: a.sharding, params)

@@ -14,6 +14,7 @@ assembles the global batch; single-host this degenerates to a device_put.
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -23,6 +24,12 @@ import numpy as np
 from mamba_distributed_tpu.config import TrainConfig
 from mamba_distributed_tpu.data import ShardedTokenLoader, ensure_synthetic_shards
 from mamba_distributed_tpu.models import count_params, init_lm_params
+from mamba_distributed_tpu.obs import (
+    NULL_TRACER,
+    DivergenceError,
+    DivergenceSentinel,
+    SpanTracer,
+)
 from mamba_distributed_tpu.parallel.mesh import build_mesh
 from mamba_distributed_tpu.parallel.sharding import (
     batch_sharding,
@@ -108,9 +115,33 @@ class Trainer:
         )
         self.schedule = lr_schedule(cfg)
 
+        # --- telemetry (obs/): spans + divergence sentinel, host-side only.
+        # The tracer/sentinel never see a jax.Array that is not already
+        # fetched, so enabling them cannot add device syncs or jit traces
+        # (pinned by tests/test_obs.py).
+        tcfg = cfg.telemetry
+        self.tracer = (
+            SpanTracer(os.path.join(cfg.log_dir, "events.jsonl"))
+            if tcfg.spans and self.master else NULL_TRACER
+        )
+        self.sentinel = (
+            DivergenceSentinel(
+                # every process watches (all must halt together on a
+                # divergence); only the master writes the shared dump
+                os.path.join(cfg.log_dir, "flight_record.json")
+                if self.master else None,
+                capacity=tcfg.flight_recorder_len, tracer=self.tracer,
+            )
+            if tcfg.sentinel else None
+        )
+        self._overflow_on = tcfg.overflow_threshold > 0
+
         self.train_step = make_train_step(
             cfg, self.optimizer, self.mesh, self.params, self.opt_state,
             seq_ctx=self.seq_ctx,
+            overflow_threshold=(
+                tcfg.overflow_threshold if self._overflow_on else None
+            ),
         )
         self.eval_step = make_eval_step(
             cfg, self.mesh, self.params, seq_ctx=self.seq_ctx
@@ -156,11 +187,12 @@ class Trainer:
         return make(x), make(y)
 
     def validate(self) -> float:
-        self.val_loader.reset()
-        total = 0.0
-        for _ in range(self.cfg.val_steps):
-            x, y = self._val_batch()
-            total += float(self.eval_step(self.params, x, y))
+        with self.tracer.span("eval", steps=self.cfg.val_steps):
+            self.val_loader.reset()
+            total = 0.0
+            for _ in range(self.cfg.val_steps):
+                x, y = self._val_batch()
+                total += float(self.eval_step(self.params, x, y))
         return total / self.cfg.val_steps
 
     def run(self, max_steps: int | None = None, checkpoint_dir: str | None = None):
@@ -171,6 +203,13 @@ class Trainer:
 
         try:
             self._run_loop(last, accum, tokens_per_step, checkpoint_dir)
+        except BaseException as e:
+            # crash-time flight dump: the last N steps before death are
+            # the artifact that matters (a DivergenceError path already
+            # dumped with the non-finite reason; dump() is once-only)
+            if self.sentinel is not None:
+                self.sentinel.on_crash(e)
+            raise
         finally:
             # join any in-flight async checkpoint write even when the loop
             # raises (a checkpoint must never outlive the process
@@ -186,29 +225,49 @@ class Trainer:
             if step % cfg.val_every == 0 or step == last - 1:
                 val_loss = self.validate()
                 self.logger.val(step, val_loss)
+                if self.sentinel is not None:
+                    self.sentinel.record_event("val", step=step, loss=val_loss)
             if (
                 self._sample_prompt_ids is not None
                 and step % cfg.sample_every == 0
                 and step > 0
             ):
-                self.sample()
+                with self.tracer.span("sample", step=step):
+                    self.sample()
             if checkpoint_dir and step > 0 and step % cfg.checkpoint_every == 0:
                 self.save_checkpoint(checkpoint_dir)
 
             t0 = time.time()
-            x, y = self._global_batch(accum, self.train_loader)
-            self.params, self.opt_state, loss, grad_norm = self.train_step(
-                self.params, self.opt_state, x, y
-            )
-            jax.block_until_ready(loss)
+            with self.tracer.span("data_load", step=step):
+                x, y = self._global_batch(accum, self.train_loader)
+            with self.tracer.span("train_step", step=step):
+                out = self.train_step(self.params, self.opt_state, x, y)
+                self.params, self.opt_state, loss, grad_norm = out[:4]
+                jax.block_until_ready(loss)
             dt = time.time() - t0
+            # host scalars, fetched once: the logger and the sentinel both
+            # consume these — the sentinel adds zero extra device syncs
+            loss_f, grad_norm_f = float(loss), float(grad_norm)
+            overflow = int(out[4]) if self._overflow_on else None
             tok_per_sec = tokens_per_step / dt
             mfu = self._flops_per_token_model * tok_per_sec / self._peak
             mfu_hw = self._flops_per_token * tok_per_sec / self._peak
             self.logger.train_step(
-                step, float(loss), float(self.schedule(step)), float(grad_norm),
+                step, loss_f, float(self.schedule(step)), grad_norm_f,
                 dt, tok_per_sec, mfu, mfu_hw,
             )
+            if self.sentinel is not None and self.sentinel.observe_step(
+                step, loss_f, grad_norm_f, overflow=overflow,
+                step_ms=round(dt * 1000, 2),
+            ):
+                if cfg.telemetry.halt_on_divergence:
+                    where = (self.sentinel.dumped_to
+                             or "written by process 0")  # non-master has
+                    raise DivergenceError(  # no dump path of its own
+                        f"non-finite loss/grad_norm at step {step} "
+                        f"(loss={loss_f}, grad_norm={grad_norm_f}); flight "
+                        f"record: {where}"
+                    )
             self.step += 1
 
     def sample(self, num_return: int = 4, max_new_tokens: int = 32,
@@ -247,10 +306,15 @@ class Trainer:
                 self._ckpt.close()
             self._ckpt = Checkpointer(directory)
             self._ckpt_dir = directory
-        self._ckpt.save(
-            self.step, self.params, self.opt_state,
-            self.train_loader.state(), self.rng,
-        )
+        # the span covers the async dispatch (on-device snapshot), not the
+        # background write — that's the cost the training loop actually pays
+        with self.tracer.span("checkpoint_save", step=self.step):
+            self._ckpt.save(
+                self.step, self.params, self.opt_state,
+                self.train_loader.state(), self.rng,
+            )
+        if self.sentinel is not None:
+            self.sentinel.record_event("checkpoint_save", step=self.step)
 
     def finish(self) -> None:
         """Join any in-flight async checkpoint write (call before exit)."""
@@ -269,3 +333,4 @@ class Trainer:
         )
         self.train_loader.restore(loader_state)
         self.logger.preserve_history()
+        self.tracer.preserve_history()

@@ -12,19 +12,10 @@ norm/dt/tok-sec, train.py:237-239; MFU is new).
 
 from __future__ import annotations
 
-import json
-import math
 import os
 
-
-def _jsonable(record: dict) -> dict:
-    """NaN/Inf are not valid JSON (json.dumps emits bare NaN tokens strict
-    parsers reject — exactly in the diverged-run case where the structured
-    log matters most); serialize them as null."""
-    return {
-        k: (None if isinstance(v, float) and not math.isfinite(v) else v)
-        for k, v in record.items()
-    }
+from mamba_distributed_tpu.obs.histogram import StreamingHistogram
+from mamba_distributed_tpu.obs.tracer import append_jsonl
 
 
 class MetricsLogger:
@@ -57,8 +48,7 @@ class MetricsLogger:
             with open(self.log_file, mode) as f:
                 f.write(line + "\n")
             if record is not None:
-                with open(self.jsonl_file, "a") as f:
-                    f.write(json.dumps(_jsonable(record)) + "\n")
+                append_jsonl(self.jsonl_file, record)
 
     def train_step(self, step: int, loss: float, lr: float, grad_norm: float,
                    dt_s: float, tokens_per_sec: float, mfu: float,
@@ -108,6 +98,15 @@ class ServingMetrics:
     throughput model: each tick reads the full weights once regardless of
     how many slots are live, and every occupied slot rides that same read
     — batch-fill is (nearly) free aggregate tokens/sec (docs/SERVING.md).
+
+    Per-request latency (the metrics that matter under real traffic:
+    queue-wait, time-to-first-token, inter-token latency) aggregates in
+    three streaming bounded-bucket histograms (obs/histogram.py) — p50/
+    p95/p99 with fixed memory, no samples stored — rolled up under
+    ``summary()["latency"]``.  The engine stamps the request lifecycle
+    and calls ``record_queue_wait``/``record_ttft``/``record_itl``;
+    ``record_request`` additionally appends one ``"kind": "request"``
+    jsonl record per finished request when ``jsonl_path`` is set.
     """
 
     def __init__(self, capacity: int, jsonl_path: str | None = None):
@@ -122,11 +121,55 @@ class ServingMetrics:
         self._occupied_sum = 0
         self._queue_depth_sum = 0
         self.peak_queue_depth = 0
+        self.finished_requests = 0
+        self.queue_wait_ms = StreamingHistogram()
+        self.ttft_ms = StreamingHistogram()
+        self.itl_ms = StreamingHistogram()
+        # same deferred-truncation contract as MetricsLogger/SpanTracer:
+        # a reused path starts fresh on the first write unless
+        # preserve_history() ran, so two runs can never interleave
+        self._truncate_pending = True
+
+    def preserve_history(self) -> None:
+        """Keep an existing jsonl stream (append instead of truncating)."""
+        self._truncate_pending = False
+
+    def _write_jsonl(self, record: dict) -> None:
+        append_jsonl(self.jsonl_path, record, truncate=self._truncate_pending)
+        self._truncate_pending = False
 
     def record_prefill(self, prompt_tokens: int, dt_s: float) -> None:
+        """``dt_s`` is host dispatch time: prefill runs async and the next
+        tick's token fetch absorbs device completion (serving/engine.py),
+        so on an async backend the derived ``prefill_tokens_per_sec`` is
+        a dispatch rate — an upper bound on device prefill throughput,
+        not a measurement of it."""
         self.prefills += 1
         self.prefill_tokens += prompt_tokens
         self.prefill_time_s += dt_s
+
+    # ------------------------------------------------- per-request latency
+
+    def record_queue_wait(self, dt_s: float) -> None:
+        """Submit -> slot granted (admission)."""
+        self.queue_wait_ms.record(dt_s * 1000)
+
+    def record_ttft(self, dt_s: float) -> None:
+        """Submit -> first generated token on the host."""
+        self.ttft_ms.record(dt_s * 1000)
+
+    def record_itl(self, dt_s: float, n: int = 1) -> None:
+        """``n`` inter-token gaps of ``dt_s`` each (tokens that arrive in
+        one tick share the tick's per-token average — the host can't see
+        finer than its own sync points)."""
+        self.itl_ms.record(dt_s * 1000, n)
+
+    def record_request(self, record: dict) -> None:
+        """One finished request: count it and append its jsonl record
+        (``"kind": "request"``) when a stream is configured."""
+        self.finished_requests += 1
+        if self.jsonl_path:
+            self._write_jsonl({"kind": "request", **record})
 
     def record_tick(
         self, occupied: int, queue_depth: int, tokens_emitted: int, dt_s: float
@@ -138,18 +181,13 @@ class ServingMetrics:
         self._queue_depth_sum += queue_depth
         self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
         if self.jsonl_path:
-            # per-write open, same idiom as MetricsLogger._append above:
-            # crash-safe (every line is flushed+closed) and ticks are
-            # O(10ms+) model steps, so the syscall pair is noise
-            record = {
+            self._write_jsonl({
                 "kind": "serving_tick", "tick": self.ticks,
                 "occupied": occupied, "capacity": self.capacity,
                 "queue_depth": queue_depth,
                 "tokens_emitted": tokens_emitted,
                 "tick_ms": round(dt_s * 1000, 3),
-            }
-            with open(self.jsonl_path, "a") as f:
-                f.write(json.dumps(_jsonable(record)) + "\n")
+            })
 
     def summary(self) -> dict:
         return {
@@ -158,6 +196,10 @@ class ServingMetrics:
             "decode_tokens_per_sec": (
                 round(self.decode_tokens / self.decode_time_s, 1)
                 if self.decode_time_s else None
+            ),
+            "mean_tick_ms": (
+                round(self.decode_time_s / self.ticks * 1000, 3)
+                if self.ticks else None
             ),
             "mean_slot_occupancy": (
                 round(self._occupied_sum / (self.ticks * self.capacity), 4)
@@ -170,4 +212,14 @@ class ServingMetrics:
             "prefills": self.prefills,
             "prefill_tokens": self.prefill_tokens,
             "prefill_time_s": round(self.prefill_time_s, 4),
+            "prefill_tokens_per_sec": (
+                round(self.prefill_tokens / self.prefill_time_s, 1)
+                if self.prefill_time_s else None
+            ),
+            "finished_requests": self.finished_requests,
+            "latency": {
+                "queue_wait_ms": self.queue_wait_ms.summary(),
+                "ttft_ms": self.ttft_ms.summary(),
+                "itl_ms": self.itl_ms.summary(),
+            },
         }

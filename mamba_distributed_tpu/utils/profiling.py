@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 
 import jax
 
@@ -45,4 +46,11 @@ class StepTimer:
     def stop(self, sync_scalar=None) -> float:
         if sync_scalar is not None:
             float(jax.device_get(sync_scalar))
+        if self._t0 is None:
+            # a timing bug must not kill the run it is measuring
+            warnings.warn(
+                "StepTimer.stop() called without start(); returning 0.0",
+                RuntimeWarning, stacklevel=2,
+            )
+            return 0.0
         return time.time() - self._t0

@@ -15,10 +15,16 @@ Prints one JSON line.  Env knobs: BENCH_PRESET (default mamba2-tiny — a
 CPU-minutes model; set mamba2-280m on real chips), SERVE_REQUESTS (16),
 SERVE_CAPACITY (8), SERVE_PROMPT_MIN/MAX (8/96), SERVE_MAX_NEW (32),
 SERVE_TOKENS_PER_TICK (8), BENCH_PLATFORM, BENCH_SEED (0).
+
+``--jsonl PATH`` streams the timed engine run's per-tick and per-request
+telemetry records (kind serving_tick / request) to PATH — the stream
+``scripts/obs_report.py`` turns into queue-wait/TTFT/ITL percentile
+tables — and folds the latency summary into the JSON line.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -52,6 +58,12 @@ def _workload(rng, n, pmin, pmax, max_new, vocab):
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jsonl", default=None, metavar="PATH",
+                    help="write the timed run's serving_tick + request "
+                         "jsonl stream here (obs_report.py input)")
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -98,8 +110,9 @@ def main() -> None:
                  max_new_tokens=r.max_new_tokens)
     _progress("both paths warm (all signatures compiled)")
 
-    # --- continuous-batching engine, timed ---
-    metrics = ServingMetrics(capacity)
+    # --- continuous-batching engine, timed (a fresh ServingMetrics
+    # truncates a reused --jsonl path on its first write) ---
+    metrics = ServingMetrics(capacity, jsonl_path=args.jsonl)
     engine = ServingEngine(
         params, cfg, capacity=capacity, tokens_per_tick=tokens_per_tick,
         metrics=metrics,
@@ -124,28 +137,29 @@ def main() -> None:
     _progress(f"sequential: {seq_tokens} tokens in {dt_seq:.2f}s")
 
     summary = metrics.summary()
-    print(
-        json.dumps(
-            {
-                "metric": f"serving_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
-                "value": round(served_tokens / dt_serve, 1),
-                "unit": "sampled tokens/sec/chip (aggregate)",
-                "sequential_tokens_per_sec": round(seq_tokens / dt_seq, 1),
-                "speedup_vs_sequential": round(dt_seq / dt_serve, 2),
-                "requests": n_requests,
-                "capacity": capacity,
-                "tokens_per_tick": tokens_per_tick,
-                "prompt_len_range": [pmin, pmax],
-                "max_new_tokens": max_new,
-                "total_new_tokens": total_new,
-                "mean_slot_occupancy": summary["mean_slot_occupancy"],
-                "peak_queue_depth": summary["peak_queue_depth"],
-                "ticks": summary["ticks"],
-                "device": dev.device_kind,
-            }
-        ),
-        flush=True,
-    )
+    record = {
+        "metric": f"serving_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
+        "value": round(served_tokens / dt_serve, 1),
+        "unit": "sampled tokens/sec/chip (aggregate)",
+        "sequential_tokens_per_sec": round(seq_tokens / dt_seq, 1),
+        "speedup_vs_sequential": round(dt_seq / dt_serve, 2),
+        "requests": n_requests,
+        "capacity": capacity,
+        "tokens_per_tick": tokens_per_tick,
+        "prompt_len_range": [pmin, pmax],
+        "max_new_tokens": max_new,
+        "total_new_tokens": total_new,
+        "mean_slot_occupancy": summary["mean_slot_occupancy"],
+        "peak_queue_depth": summary["peak_queue_depth"],
+        "ticks": summary["ticks"],
+        "mean_tick_ms": summary["mean_tick_ms"],
+        "prefill_tokens_per_sec": summary["prefill_tokens_per_sec"],
+        "latency": summary["latency"],
+        "device": dev.device_kind,
+    }
+    if args.jsonl:
+        record["jsonl"] = args.jsonl
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

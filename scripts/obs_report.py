@@ -1,0 +1,290 @@
+"""Telemetry report: phase-time breakdown + latency percentiles from jsonl.
+
+Ingests any mix of the repo's jsonl event streams — MetricsLogger's
+``metrics.jsonl`` (kind train/val), the span tracer's ``events.jsonl``
+(kind span/event), and ServingMetrics' serving stream (kind
+serving_tick/request) — and prints:
+
+  * a span phase-time breakdown (where the host loop actually spends
+    its time: data_load vs train_step vs eval vs checkpoint_save, or
+    serving_admit vs serving_tick);
+  * train-step statistics (steps, loss movement, step time, tokens/sec);
+  * serving tick statistics (occupancy, tick time, decode tokens/sec);
+  * per-request latency percentiles: queue-wait / TTFT / end-to-end
+    exactly (the scalars are in the records), inter-token latency by
+    merging the per-request streaming histograms each record carries
+    (obs/histogram.py — p50/p95/p99 without any stored samples).
+
+Usage:
+  python scripts/obs_report.py log/events.jsonl log/metrics.jsonl
+  python scripts/obs_report.py serving.jsonl --json
+
+docs/OBSERVABILITY.md documents the event schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mamba_distributed_tpu.obs.histogram import StreamingHistogram  # noqa: E402
+
+
+def load_events(paths: list[str]) -> list[dict]:
+    """All parseable records from all files, in file order.  Unparseable
+    lines are counted, not fatal — a crashed writer can leave a torn
+    final line, and the report must still come out."""
+    events, bad = [], 0
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(rec, dict):
+                    events.append(rec)
+    if bad:
+        print(f"warning: skipped {bad} unparseable line(s)", file=sys.stderr)
+    return events
+
+
+def _pcts(values: list[float]) -> dict:
+    """Exact nearest-rank percentiles of scalar samples."""
+    if not values:
+        return {"count": 0, "mean": None, "p50": None, "p95": None,
+                "p99": None, "max": None}
+    xs = sorted(values)
+    pick = lambda q: xs[min(len(xs) - 1, max(0, -(-q * len(xs) // 100) - 1))]
+    return {
+        "count": len(xs),
+        "mean": round(sum(xs) / len(xs), 3),
+        "p50": round(pick(50), 3),
+        "p95": round(pick(95), 3),
+        "p99": round(pick(99), 3),
+        "max": round(xs[-1], 3),
+    }
+
+
+def build_report(events: list[dict]) -> dict:
+    """Aggregate the event stream into one report dict (the ``--json``
+    output; ``format_report`` renders it as tables)."""
+    report: dict = {}
+
+    # --- spans: per-name totals; share-% over top-level (depth-0) time
+    spans = [e for e in events if e.get("kind") == "span"]
+    if spans:
+        by_name: dict[str, dict] = {}
+        for s in spans:
+            d = by_name.setdefault(s["name"], {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+                "depth": s.get("depth", 0),
+            })
+            d["count"] += 1
+            d["total_ms"] += s.get("dur_ms", 0.0)
+            d["max_ms"] = max(d["max_ms"], s.get("dur_ms", 0.0))
+        top_total = sum(
+            s.get("dur_ms", 0.0) for s in spans if s.get("depth", 0) == 0
+        )
+        for d in by_name.values():
+            d["total_ms"] = round(d["total_ms"], 3)
+            d["mean_ms"] = round(d["total_ms"] / d["count"], 3)
+            d["share"] = (
+                round(d["total_ms"] / top_total, 4)
+                if top_total and d["depth"] == 0 else None
+            )
+        report["spans"] = dict(sorted(
+            by_name.items(), key=lambda kv: -kv[1]["total_ms"]
+        ))
+
+    # --- train/val records (MetricsLogger metrics.jsonl)
+    train = [e for e in events if e.get("kind") == "train"]
+    if train:
+        losses = [e["loss"] for e in train if e.get("loss") is not None]
+        report["train"] = {
+            "steps": len(train),
+            "first_loss": losses[0] if losses else None,
+            "last_loss": losses[-1] if losses else None,
+            "non_finite_losses": sum(1 for e in train if e.get("loss") is None),
+            "step_ms": _pcts([e["step_ms"] for e in train
+                              if e.get("step_ms") is not None]),
+            "mean_tokens_per_sec": (
+                round(sum(e["tokens_per_sec"] for e in train) / len(train), 1)
+                if all(e.get("tokens_per_sec") is not None for e in train)
+                else None
+            ),
+        }
+    vals = [e for e in events if e.get("kind") == "val"]
+    if vals:
+        report["val"] = {"count": len(vals), "last_loss": vals[-1].get("loss")}
+
+    # --- serving ticks (ServingMetrics jsonl stream)
+    ticks = [e for e in events if e.get("kind") == "serving_tick"]
+    if ticks:
+        tokens = sum(e.get("tokens_emitted", 0) for e in ticks)
+        total_ms = sum(e.get("tick_ms", 0.0) for e in ticks)
+        # per-tick ratios, so streams from runs with different capacities
+        # mix correctly ("any mix" is the advertised contract)
+        ratios = [e["occupied"] / e["capacity"] for e in ticks
+                  if e.get("capacity") and e.get("occupied") is not None]
+        report["serving"] = {
+            "ticks": len(ticks),
+            "decode_tokens": tokens,
+            "tick_ms": _pcts([e["tick_ms"] for e in ticks
+                              if e.get("tick_ms") is not None]),
+            "decode_tokens_per_sec": (
+                round(tokens / (total_ms / 1000), 1) if total_ms else None
+            ),
+            "mean_slot_occupancy": (
+                round(sum(ratios) / len(ratios), 4) if ratios else None
+            ),
+            "peak_queue_depth": max(e.get("queue_depth", 0) for e in ticks),
+        }
+
+    # --- per-request latency (the serving stream's "request" records)
+    reqs = [e for e in events if e.get("kind") == "request"]
+    if reqs:
+        def col(key):
+            return [e[key] for e in reqs if e.get(key) is not None]
+
+        itl = None
+        for e in reqs:
+            h = e.get("itl_hist")
+            if not h:
+                continue
+            h = StreamingHistogram.from_dict(h)
+            itl = h if itl is None else itl.merge(h)
+        finish: dict[str, int] = {}
+        for e in reqs:
+            reason = e.get("finish_reason") or "?"
+            finish[reason] = finish.get(reason, 0) + 1
+        report["requests"] = {
+            "count": len(reqs),
+            "finish_reasons": finish,
+            "prompt_tokens": sum(col("prompt_tokens")),
+            "new_tokens": sum(col("new_tokens")),
+            "queue_wait_ms": _pcts(col("queue_wait_ms")),
+            "ttft_ms": _pcts(col("ttft_ms")),
+            "e2e_ms": _pcts(col("e2e_ms")),
+            "itl_ms": itl.summary() if itl is not None else None,
+        }
+
+    # --- point events (divergence markers etc.)
+    marks = [e for e in events if e.get("kind") == "event"]
+    if marks:
+        report["events"] = [
+            {k: v for k, v in e.items() if k != "kind"} for e in marks
+        ]
+    return report
+
+
+# ------------------------------------------------------------------ render
+
+
+def _table(rows: list[list], header: list[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def _fmt(v) -> str:
+    return "-" if v is None else str(v)
+
+
+def _pct_row(name: str, p: dict) -> list:
+    return [name, p["count"], _fmt(p["mean"]), _fmt(p["p50"]),
+            _fmt(p["p95"]), _fmt(p["p99"]), _fmt(p["max"])]
+
+
+def format_report(report: dict) -> str:
+    out = []
+    if "spans" in report:
+        rows = [
+            [name, d["count"], d["total_ms"], d["mean_ms"], d["max_ms"],
+             "-" if d["share"] is None else f"{d['share'] * 100:.1f}%"]
+            for name, d in report["spans"].items()
+        ]
+        out.append("== phase breakdown (spans) ==\n" + _table(
+            rows, ["phase", "count", "total_ms", "mean_ms", "max_ms", "share"]
+        ))
+    if "train" in report:
+        t = report["train"]
+        head = (f"== train ==\nsteps: {t['steps']}   "
+                f"loss: {_fmt(t['first_loss'])} -> {_fmt(t['last_loss'])}   "
+                f"mean tok/s: {_fmt(t['mean_tokens_per_sec'])}")
+        if t["non_finite_losses"]:
+            head += f"   NON-FINITE LOSSES: {t['non_finite_losses']}"
+        out.append(head + "\n" + _table(
+            [_pct_row("step_ms", t["step_ms"])],
+            ["metric", "count", "mean", "p50", "p95", "p99", "max"],
+        ))
+    if "val" in report:
+        v = report["val"]
+        out.append(f"== val ==\nevals: {v['count']}   "
+                   f"last loss: {_fmt(v['last_loss'])}")
+    if "serving" in report:
+        s = report["serving"]
+        out.append(
+            f"== serving ticks ==\nticks: {s['ticks']}   decode tokens: "
+            f"{s['decode_tokens']}   decode tok/s: "
+            f"{_fmt(s['decode_tokens_per_sec'])}   mean occupancy: "
+            f"{_fmt(s['mean_slot_occupancy'])}   peak queue: "
+            f"{s['peak_queue_depth']}\n" + _table(
+                [_pct_row("tick_ms", s["tick_ms"])],
+                ["metric", "count", "mean", "p50", "p95", "p99", "max"],
+            )
+        )
+    if "requests" in report:
+        r = report["requests"]
+        rows = [_pct_row("queue_wait_ms", r["queue_wait_ms"]),
+                _pct_row("ttft_ms", r["ttft_ms"]),
+                _pct_row("e2e_ms", r["e2e_ms"])]
+        if r["itl_ms"] is not None:
+            rows.append(_pct_row("itl_ms", r["itl_ms"]))
+        out.append(
+            f"== request latency ==\nrequests: {r['count']}   "
+            f"finish: {r['finish_reasons']}   prompt tokens: "
+            f"{r['prompt_tokens']}   new tokens: {r['new_tokens']}\n"
+            + _table(rows,
+                     ["metric", "count", "mean", "p50", "p95", "p99", "max"])
+        )
+    if "events" in report:
+        out.append("== events ==\n" + "\n".join(
+            json.dumps(e) for e in report["events"]
+        ))
+    if not out:
+        return "no recognizable telemetry records found"
+    return "\n\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="phase-time breakdown + latency percentiles from the "
+                    "repo's jsonl telemetry streams (docs/OBSERVABILITY.md)"
+    )
+    p.add_argument("files", nargs="+", help="jsonl stream(s): events.jsonl, "
+                   "metrics.jsonl, serving jsonl — any mix")
+    p.add_argument("--json", action="store_true",
+                   help="emit the aggregated report as JSON instead of tables")
+    args = p.parse_args(argv)
+    report = build_report(load_events(args.files))
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

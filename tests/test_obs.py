@@ -1,0 +1,547 @@
+"""Observability tests: histogram math, span tracer, sentinels, and the
+no-new-traces contract.
+
+The load-bearing assertions are the trace-count pins: enabling spans +
+sentinels must add ZERO jit compilations to the train step and the
+serving decode tick — the whole obs/ layer is host-side by construction,
+and these tests keep it that way.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig, TelemetryConfig
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.obs import (
+    NULL_TRACER,
+    DivergenceError,
+    DivergenceSentinel,
+    FlightRecorder,
+    SpanTracer,
+    StreamingHistogram,
+)
+from mamba_distributed_tpu.serving import GenerationRequest, ServingEngine
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+# the obs marker covers the whole file; fast (the sub-2-minute inner-loop
+# tier) goes per-test on the host-only unit tests — the Trainer/engine
+# integration tests below each compile real jit steps and belong to the
+# unmarked middle tier
+pytestmark = [pytest.mark.obs]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from obs_report import build_report, format_report, load_events  # noqa: E402
+
+
+# -------------------------------------------------------------- histogram
+
+
+@pytest.mark.fast
+def test_histogram_single_sample_is_exact():
+    h = StreamingHistogram()
+    h.record(5.0)
+    for q in (0, 50, 95, 99, 100):
+        assert h.percentile(q) == 5.0  # clamped to [min, max]
+    assert h.mean == 5.0 and h.count == 1
+
+
+@pytest.mark.fast
+def test_histogram_empty():
+    h = StreamingHistogram()
+    assert h.percentile(50) is None and h.mean is None
+    assert h.summary()["count"] == 0 and h.summary()["p99"] is None
+
+
+@pytest.mark.fast
+def test_histogram_percentiles_within_relative_error():
+    h = StreamingHistogram()
+    values = [float(v) for v in range(1, 101)]  # 1..100
+    for v in values:
+        h.record(v)
+    g = h.growth
+    for q, true in [(50, 50.0), (95, 95.0), (99, 99.0)]:
+        got = h.percentile(q)
+        assert true / g <= got <= true * g, (q, got)
+    # extremes are exact (min/max clamp)
+    assert h.percentile(0) >= 1.0 and h.percentile(100) == 100.0
+
+
+@pytest.mark.fast
+def test_histogram_percentiles_monotonic_in_q():
+    h = StreamingHistogram()
+    rng = np.random.default_rng(0)
+    for v in rng.lognormal(mean=2.0, sigma=1.5, size=500):
+        h.record(float(v))
+    qs = [0, 10, 25, 50, 75, 90, 95, 99, 100]
+    ps = [h.percentile(q) for q in qs]
+    assert ps == sorted(ps)
+
+
+@pytest.mark.fast
+def test_histogram_merge_counts_and_monotonicity():
+    """Merging equals recording the combined stream: counts/totals add,
+    and every percentile of the merged histogram matches a histogram fed
+    both streams directly (satellite: monotonicity under merges)."""
+    a, b, both = (StreamingHistogram() for _ in range(3))
+    rng = np.random.default_rng(1)
+    xs = [float(v) for v in rng.lognormal(1.0, 1.0, size=200)]
+    ys = [float(v) for v in rng.lognormal(3.0, 0.5, size=300)]
+    for v in xs:
+        a.record(v)
+        both.record(v)
+    for v in ys:
+        b.record(v)
+        both.record(v)
+    a.merge(b)
+    assert a.count == both.count == 500
+    assert a.total == pytest.approx(both.total)
+    assert a.vmin == both.vmin and a.vmax == both.vmax
+    for q in (5, 50, 95, 99):
+        assert a.percentile(q) == pytest.approx(both.percentile(q))
+    ps = [a.percentile(q) for q in (50, 95, 99)]
+    assert ps == sorted(ps)
+
+
+@pytest.mark.fast
+def test_histogram_merge_rejects_mismatched_geometry():
+    with pytest.raises(ValueError, match="geometry"):
+        StreamingHistogram().merge(StreamingHistogram(lo=1.0))
+
+
+@pytest.mark.fast
+def test_histogram_json_round_trip():
+    h = StreamingHistogram()
+    for v in (0.5, 2.0, 2.0, 70.0, 1e9):  # incl. an overflow-bucket value
+        h.record(v)
+    h2 = StreamingHistogram.from_dict(json.loads(json.dumps(h.to_dict())))
+    assert h2.count == h.count and h2.total == pytest.approx(h.total)
+    for q in (0, 50, 99, 100):
+        assert h2.percentile(q) == h.percentile(q)
+
+
+@pytest.mark.fast
+def test_histogram_weighted_and_nonfinite():
+    h = StreamingHistogram()
+    h.record(10.0, n=7)
+    h.record(float("nan"))
+    h.record(float("inf"))
+    h.record(3.0, n=0)
+    assert h.count == 7 and h.percentile(99) == 10.0
+
+
+@pytest.mark.fast
+def test_histogram_out_of_range_clamps_to_observed():
+    h = StreamingHistogram(lo=1.0, hi=100.0)
+    h.record(0.25)  # underflow bucket
+    h.record(4000.0)  # overflow bucket
+    assert h.percentile(0) == 0.25
+    assert h.percentile(100) == 4000.0
+
+
+# ----------------------------------------------------------------- tracer
+
+
+@pytest.mark.fast
+def test_span_tracer_nesting_and_attrs(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    t = SpanTracer(path)
+    with t.span("outer", step=3):
+        with t.span("inner"):
+            pass
+    t.event("mark", loss=float("nan"))
+    ev = load_events([path])
+    inner, outer, mark = ev
+    assert inner["name"] == "inner" and inner["depth"] == 1
+    assert inner["parent"] == "outer"
+    assert outer["name"] == "outer" and outer["depth"] == 0
+    assert outer["step"] == 3
+    assert outer["dur_ms"] >= inner["dur_ms"] >= 0
+    assert mark["kind"] == "event" and mark["loss"] is None  # NaN -> null
+
+
+@pytest.mark.fast
+def test_span_tracer_records_on_exception(tmp_path):
+    t = SpanTracer(str(tmp_path / "e.jsonl"))
+    with pytest.raises(RuntimeError):
+        with t.span("dies"):
+            raise RuntimeError("boom")
+    (rec,) = load_events([str(tmp_path / "e.jsonl")])
+    assert rec["name"] == "dies"
+
+
+@pytest.mark.fast
+def test_span_tracer_resume_preserves_history(tmp_path):
+    """A rebuilt tracer truncates on first write UNLESS preserve_history()
+    ran (the checkpoint-resume / --auto-restart path, same contract as
+    MetricsLogger) — the pre-crash spans are the post-mortem artifact."""
+    path = str(tmp_path / "events.jsonl")
+    t = SpanTracer(path)
+    with t.span("before_crash"):
+        pass
+    t2 = SpanTracer(path)  # fresh run: truncates on first write
+    with t2.span("fresh"):
+        pass
+    assert [e["name"] for e in load_events([path])] == ["fresh"]
+    t3 = SpanTracer(path)  # resumed run: appends
+    t3.preserve_history()
+    with t3.span("after_resume"):
+        pass
+    assert [e["name"] for e in load_events([path])] == ["fresh", "after_resume"]
+    NULL_TRACER.preserve_history()  # must exist on the disabled tracer too
+
+
+@pytest.mark.fast
+def test_telemetry_config_rejects_overflow_without_sentinel():
+    with pytest.raises(ValueError, match="sentinel"):
+        TelemetryConfig(sentinel=False, overflow_threshold=1.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        TelemetryConfig(overflow_threshold=-1.0)
+    with pytest.raises(ValueError, match="flight_recorder_len"):
+        TelemetryConfig(flight_recorder_len=0)
+
+
+@pytest.mark.fast
+def test_null_tracer_is_noop(tmp_path):
+    with NULL_TRACER.span("anything", x=1):
+        pass
+    NULL_TRACER.event("mark")
+    assert not NULL_TRACER.enabled
+    assert list(tmp_path.iterdir()) == []
+
+
+# ------------------------------------------------- StepTimer (satellite)
+
+
+@pytest.mark.fast
+def test_step_timer_stop_without_start_warns():
+    from mamba_distributed_tpu.utils.profiling import StepTimer
+
+    timer = StepTimer()
+    with pytest.warns(RuntimeWarning, match="without start"):
+        assert timer.stop() == 0.0
+    timer.start()
+    assert timer.stop() >= 0.0  # normal path unaffected
+
+
+# ------------------------------------------- flight recorder + sentinel
+
+
+@pytest.mark.fast
+def test_flight_recorder_ring_and_dump(tmp_path):
+    fr = FlightRecorder(capacity=3)
+    for i in range(5):
+        fr.record("train_step", step=i, loss=float(i))
+    assert len(fr) == 3
+    assert [e["step"] for e in fr.events()] == [2, 3, 4]
+    path = fr.dump(str(tmp_path / "fr.json"), reason="test")
+    doc = json.load(open(path))
+    assert doc["reason"] == "test" and doc["capacity"] == 3
+    assert [e["step"] for e in doc["events"]] == [2, 3, 4]
+
+
+@pytest.mark.fast
+def test_sentinel_divergence_dumps_once(tmp_path):
+    path = str(tmp_path / "flight_record.json")
+    s = DivergenceSentinel(path, capacity=4)
+    for i in range(6):
+        assert not s.observe_step(i, loss=4.0 - 0.1 * i, grad_norm=1.0)
+    assert s.observe_step(6, loss=float("nan"), grad_norm=1.0)
+    doc = json.load(open(path))
+    assert "non-finite" in doc["reason"] and "step 6" in doc["reason"]
+    assert len(doc["events"]) == 4  # bounded ring, not the whole run
+    assert doc["events"][-1]["loss"] is None  # NaN serialized as null
+    # a later crash must not overwrite the divergence dump
+    s.on_crash(RuntimeError("later"))
+    assert "non-finite" in json.load(open(path))["reason"]
+
+
+@pytest.mark.fast
+def test_sentinel_without_dump_path_still_detects():
+    s = DivergenceSentinel(None)
+    assert s.observe_step(0, loss=float("inf"), grad_norm=1.0)
+    assert s.dumped_to is None
+
+
+@pytest.mark.fast
+def test_sentinel_overflow_accumulates():
+    s = DivergenceSentinel(None)
+    s.observe_step(0, 1.0, 0.5, overflow=0)
+    s.observe_step(1, 1.0, 9.0, overflow=1)
+    s.observe_step(2, 1.0, 9.5, overflow=1)
+    assert s.overflow_count == 2
+    assert s.flight.events()[-1]["overflow_total"] == 2
+
+
+# -------------------------------------------------- trainer integration
+
+
+def _trainer_cfg(tmp, **telemetry):
+    from tests.test_parallel import make_cfg
+
+    cfg = make_cfg(tmp, micro=4, accum=1, T=32)
+    return dataclasses.replace(cfg, telemetry=TelemetryConfig(**telemetry))
+
+
+def test_trainer_telemetry_zero_extra_traces(tmp_path):
+    """Acceptance pin (train half): spans + sentinels add zero jit
+    compilations to the train step (and eval step)."""
+    from mamba_distributed_tpu.training import Trainer
+    from mamba_distributed_tpu.training.train_step import TRACE_COUNTS
+
+    t = Trainer(_trainer_cfg(tmp_path / "base", sentinel=False), verbose=False)
+    t.run(max_steps=2)
+    base = dict(TRACE_COUNTS)
+
+    t = Trainer(_trainer_cfg(tmp_path / "tele", spans=True, sentinel=True),
+                verbose=False)
+    t.run(max_steps=2)
+    delta = {k: TRACE_COUNTS[k] - base[k] for k in base}
+    # each Trainer builds (and traces) its own step exactly once; the
+    # telemetry-enabled trainer must not trace any more than the baseline
+    assert delta == {"train_step": 1, "eval_step": 1}, delta
+
+    ev = load_events([os.path.join(t.cfg.log_dir, "events.jsonl")])
+    names = {e["name"] for e in ev}
+    assert {"data_load", "train_step", "eval"} <= names
+    # sentinel saw every step, nothing diverged, no dump
+    assert len(t.sentinel.flight) >= 2
+    assert t.sentinel.dumped_to is None
+    assert not os.path.exists(
+        os.path.join(t.cfg.log_dir, "flight_record.json")
+    )
+
+
+def test_trainer_divergence_halts_and_dumps(tmp_path):
+    from mamba_distributed_tpu.training import Trainer
+
+    t = Trainer(_trainer_cfg(tmp_path, sentinel=True), verbose=False)
+    real_step = t.train_step
+    def nan_step(params, opt_state, x, y):
+        params, opt_state, _, grad_norm = real_step(params, opt_state, x, y)
+        return params, opt_state, jnp.float32(float("nan")), grad_norm
+    t.train_step = nan_step
+    with pytest.raises(DivergenceError, match="step 0"):
+        t.run(max_steps=2)
+    doc = json.load(open(os.path.join(t.cfg.log_dir, "flight_record.json")))
+    assert "non-finite" in doc["reason"]
+    kinds = {e["kind"] for e in doc["events"]}
+    assert "train_step" in kinds and "val" in kinds
+
+
+def test_trainer_overflow_counter(tmp_path):
+    """Opt-in on-device overflow flag: a microscopic threshold trips on
+    every step and the host counter accumulates (and the loop still
+    runs — overflow is a signal, not a failure)."""
+    from mamba_distributed_tpu.training import Trainer
+
+    t = Trainer(_trainer_cfg(tmp_path, overflow_threshold=1e-9),
+                verbose=False)
+    t.run(max_steps=2)
+    assert t.sentinel.overflow_count == 2
+    assert t.sentinel.flight.events()[-1]["overflow"] == 1
+
+
+def test_trainer_crash_dumps_flight_record(tmp_path):
+    from mamba_distributed_tpu.training import Trainer
+
+    t = Trainer(_trainer_cfg(tmp_path, sentinel=True), verbose=False)
+
+    def boom(*a, **k):
+        raise RuntimeError("loader died")
+
+    t.run(max_steps=1)  # one clean step feeds the ring
+    t._global_batch = boom
+    with pytest.raises(RuntimeError, match="loader died"):
+        t.run(max_steps=2)
+    doc = json.load(open(os.path.join(t.cfg.log_dir, "flight_record.json")))
+    assert doc["reason"].startswith("crash: RuntimeError")
+    assert any(e["kind"] == "train_step" for e in doc["events"])
+
+
+# -------------------------------------------------- serving integration
+
+
+def _tiny_serving(layer_count=2):
+    cfg = ModelConfig(d_model=32, n_layer=layer_count, vocab_size=64,
+                      ssm_layer="mamba2", headdim=8, chunk_size=16,
+                      d_state=16, compute_dtype="float32")
+    return cfg, init_lm_params(jax.random.PRNGKey(0), cfg)
+
+
+def test_engine_request_telemetry_and_stream(tmp_path):
+    cfg, params = _tiny_serving()
+    jsonl = str(tmp_path / "serving.jsonl")
+    tracer = SpanTracer(str(tmp_path / "events.jsonl"))
+    metrics = ServingMetrics(capacity=2, jsonl_path=jsonl)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        metrics=metrics, tracer=tracer)
+    budgets = [5, 3, 4, 6]
+    eng.run([GenerationRequest(prompt_ids=np.ones(4 + i, np.int32),
+                               max_new_tokens=budgets[i],
+                               key=jax.random.PRNGKey(i))
+             for i in range(4)])
+    s = metrics.summary()
+    lat = s["latency"]
+    assert s["finished_requests"] == 4
+    assert lat["queue_wait_ms"]["count"] == 4
+    assert lat["ttft_ms"]["count"] == 4
+    # one ITL observation per generated token after each request's first
+    assert lat["itl_ms"]["count"] == sum(b - 1 for b in budgets)
+    for m in lat.values():
+        assert m["p50"] is not None and m["p50"] <= m["p95"] <= m["p99"]
+    # TTFT includes queue wait by definition (stamps share t_submit)
+    assert lat["ttft_ms"]["p50"] >= lat["queue_wait_ms"]["p50"]
+    # satellite: throughput fields present in summary()
+    assert s["prefill_tokens_per_sec"] > 0 and s["mean_tick_ms"] > 0
+
+    recs = load_events([jsonl])
+    reqs = [r for r in recs if r["kind"] == "request"]
+    assert len(reqs) == 4 and len(
+        [r for r in recs if r["kind"] == "serving_tick"]) == s["ticks"]
+    for r in reqs:
+        assert r["queue_wait_ms"] <= r["ttft_ms"] <= r["e2e_ms"]
+        assert r["itl_hist"]["count"] == r["new_tokens"] - 1
+    spans = {e["name"] for e in load_events([str(tmp_path / "events.jsonl")])}
+    assert {"serving_admit", "serving_tick"} <= spans
+
+
+def test_engine_telemetry_zero_extra_traces(tmp_path):
+    """Acceptance pin (serving half): telemetry (tracer + jsonl metrics +
+    request stamps) adds zero jit compilations to prefill and the decode
+    tick.  Own model shape so the jit cache can't already hold it."""
+    from mamba_distributed_tpu.serving.engine import TRACE_COUNTS
+
+    cfg = ModelConfig(d_model=16, n_layer=2, vocab_size=32, ssm_layer="mamba2",
+                      headdim=4, chunk_size=8, d_state=8,
+                      compute_dtype="float32")
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = lambda: [GenerationRequest(prompt_ids=np.ones(4, np.int32),
+                                      max_new_tokens=3, top_k=16,
+                                      key=jax.random.PRNGKey(i))
+                    for i in range(3)]
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        max_top_k=16)
+    eng.run(reqs())
+    base = dict(TRACE_COUNTS)
+    metrics = ServingMetrics(capacity=2, jsonl_path=str(tmp_path / "s.jsonl"))
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        max_top_k=16, metrics=metrics,
+                        tracer=SpanTracer(str(tmp_path / "e.jsonl")))
+    eng.run(reqs())
+    assert TRACE_COUNTS == base  # zero additional compilations
+    assert metrics.summary()["latency"]["ttft_ms"]["count"] == 3
+
+
+# ------------------------------------------------------------ obs_report
+
+
+@pytest.mark.fast
+def test_obs_report_exact_request_percentiles():
+    """queue-wait/TTFT percentiles are exact (scalars in the records)."""
+    events = [
+        {"kind": "request", "request_id": i, "prompt_tokens": 4,
+         "new_tokens": 8, "finish_reason": "length",
+         "queue_wait_ms": float(i + 1), "ttft_ms": float(10 * (i + 1)),
+         "e2e_ms": float(100 * (i + 1))}
+        for i in range(100)  # queue waits 1..100
+    ]
+    r = build_report(events)["requests"]
+    assert r["count"] == 100 and r["finish_reasons"] == {"length": 100}
+    assert r["queue_wait_ms"]["p50"] == 50.0
+    assert r["queue_wait_ms"]["p95"] == 95.0
+    assert r["queue_wait_ms"]["p99"] == 99.0
+    assert r["ttft_ms"]["p99"] == 990.0
+    assert r["itl_ms"] is None  # no histograms in these records
+
+
+@pytest.mark.fast
+def test_obs_report_merges_itl_histograms():
+    def req(rid, itl_values):
+        h = StreamingHistogram()
+        for v in itl_values:
+            h.record(v)
+        return {"kind": "request", "request_id": rid, "new_tokens": 9,
+                "finish_reason": "length", "queue_wait_ms": 1.0,
+                "ttft_ms": 2.0, "e2e_ms": 3.0, "itl_hist": h.to_dict()}
+
+    events = [req(0, [10.0] * 8), req(1, [20.0] * 8)]
+    itl = build_report(events)["requests"]["itl_ms"]
+    assert itl["count"] == 16
+    g = StreamingHistogram().growth
+    assert 10.0 / g <= itl["p50"] <= 10.0 * g
+    assert 20.0 / g <= itl["p99"] <= 20.0 * g
+
+
+def test_obs_report_round_trip_through_files(tmp_path):
+    """jsonl round-trip (satellite): a real serve() stream + a span
+    stream land in files, obs_report ingests them and prints the
+    latency-percentile and phase tables (acceptance criterion)."""
+    cfg, params = _tiny_serving()
+    jsonl = str(tmp_path / "serving.jsonl")
+    events = str(tmp_path / "events.jsonl")
+    metrics = ServingMetrics(capacity=2, jsonl_path=jsonl)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        metrics=metrics, tracer=SpanTracer(events))
+    consumed = sum(1 for _ in eng.serve(
+        [GenerationRequest(prompt_ids=np.ones(3 + i, np.int32),
+                           max_new_tokens=4, key=jax.random.PRNGKey(i))
+         for i in range(3)]
+    ))
+    assert consumed == 12  # serve() streamed every token
+    report = build_report(load_events([jsonl, events]))
+    assert report["requests"]["count"] == 3
+    for metric in ("queue_wait_ms", "ttft_ms"):
+        for q in ("p50", "p95", "p99"):
+            assert report["requests"][metric][q] is not None
+    assert report["requests"]["itl_ms"]["count"] == 9
+    assert report["serving"]["decode_tokens"] == 12
+    assert "serving_tick" in report["spans"]
+    text = format_report(report)
+    assert "queue_wait_ms" in text and "p99" in text and "phase" in text
+    # in-process report == CLI report (the script is the product surface)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl, events, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout)["requests"] == json.loads(
+        json.dumps(report["requests"])
+    )
+
+
+@pytest.mark.fast
+def test_obs_report_survives_torn_lines(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text(
+        json.dumps({"kind": "train", "step": 0, "loss": 2.0,
+                    "step_ms": 10.0, "tokens_per_sec": 100.0}) + "\n"
+        + '{"kind": "train", "step": 1, "lo'  # torn mid-write
+    )
+    report = build_report(load_events([str(path)]))
+    assert report["train"]["steps"] == 1
+
+
+@pytest.mark.fast
+def test_obs_report_train_and_span_sections(tmp_path):
+    """MetricsLogger's metrics.jsonl is directly ingestible."""
+    from mamba_distributed_tpu.utils.metrics import MetricsLogger
+
+    logger = MetricsLogger(str(tmp_path))
+    logger.train_step(0, 2.5, 1e-4, 0.9, 0.1, 1000.0, 0.1)
+    logger.train_step(1, float("nan"), 1e-4, 0.9, 0.1, 1000.0, 0.1)
+    logger.val(1, 2.4)
+    report = build_report(load_events([str(tmp_path / "metrics.jsonl")]))
+    assert report["train"]["steps"] == 2
+    assert report["train"]["non_finite_losses"] == 1
+    assert report["val"]["last_loss"] == 2.4

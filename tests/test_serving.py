@@ -327,11 +327,24 @@ def test_serving_metrics_counters(tmp_path):
     assert s["peak_queue_depth"] == 3 and s["mean_queue_depth"] == 1.5
     assert s["prefills"] == 1 and s["prefill_tokens"] == 16
     assert s["decode_tokens_per_sec"] == pytest.approx(30.0, rel=0.01)
+    # both sides of the prefill rate were always tracked; summary now
+    # exposes the ratio (satellite), plus the mean tick wall time
+    assert s["prefill_tokens_per_sec"] == pytest.approx(32.0, rel=0.01)
+    assert s["mean_tick_ms"] == pytest.approx(100.0, rel=0.01)
     import json
 
     lines = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
     assert len(lines) == 2 and lines[0]["kind"] == "serving_tick"
     assert lines[1]["occupied"] == 4
+    # a fresh metrics object truncates a reused path on first write
+    # (two runs must never interleave); preserve_history() appends
+    m2 = ServingMetrics(capacity=4, jsonl_path=str(jsonl))
+    m2.record_tick(occupied=1, queue_depth=0, tokens_emitted=1, dt_s=0.1)
+    assert len(jsonl.read_text().splitlines()) == 1
+    m3 = ServingMetrics(capacity=4, jsonl_path=str(jsonl))
+    m3.preserve_history()
+    m3.record_tick(occupied=1, queue_depth=0, tokens_emitted=1, dt_s=0.1)
+    assert len(jsonl.read_text().splitlines()) == 2
 
 
 def test_engine_metrics_report_occupancy(setup):
@@ -351,15 +364,19 @@ def test_engine_metrics_report_occupancy(setup):
 
 def test_bench_serving_cli_smoke(tmp_path):
     """The bench entrypoint must run end-to-end and emit one JSON line
-    (same contract as bench_decode; keeps the script from rotting)."""
+    (same contract as bench_decode; keeps the script from rotting).
+    ``--jsonl`` must leave behind the tick+request stream obs_report.py
+    consumes (satellite: telemetry passthrough)."""
     import json
 
+    jsonl = str(tmp_path / "serve.jsonl")
     env = dict(os.environ)
     env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="3", SERVE_CAPACITY="2",
                SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="12",
                SERVE_MAX_NEW="6", SERVE_TOKENS_PER_TICK="3")
     p = subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py")],
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--jsonl", jsonl],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
     )
     assert p.returncode == 0, p.stderr[-2000:]
@@ -367,3 +384,19 @@ def test_bench_serving_cli_smoke(tmp_path):
     assert rec["value"] > 0 and rec["requests"] == 3
     assert 0.0 < rec["mean_slot_occupancy"] <= 1.0
     assert rec["total_new_tokens"] >= 3
+    assert rec["latency"]["ttft_ms"]["count"] == 3
+    assert rec["prefill_tokens_per_sec"] > 0
+    lines = [json.loads(ln) for ln in open(jsonl)]
+    kinds = {ln["kind"] for ln in lines}
+    assert kinds == {"serving_tick", "request"}
+    assert sum(ln["kind"] == "request" for ln in lines) == 3
+    # the stream renders as latency-percentile tables end-to-end
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl, "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(r.stdout)
+    assert report["requests"]["count"] == 3
+    assert report["requests"]["ttft_ms"]["p99"] is not None

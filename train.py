@@ -71,6 +71,19 @@ def parse_args():
     p.add_argument("--sample-prompt-ids", default=None, metavar="IDS",
                    help="same, but the prompt as comma-separated token ids "
                         "(no tokenizer needed)")
+    p.add_argument("--spans", action="store_true",
+                   help="host-side span tracing (obs/): data_load/"
+                        "train_step/eval/checkpoint phase timings to "
+                        "{log_dir}/events.jsonl, readable by "
+                        "scripts/obs_report.py; zero device overhead")
+    p.add_argument("--overflow-threshold", type=float, default=None,
+                   metavar="NORM",
+                   help="on-device divergence sentinel: the train step "
+                        "also reports pre-clip global grad norm > NORM "
+                        "(counted into the flight record); 0 disables")
+    p.add_argument("--no-halt-on-divergence", action="store_true",
+                   help="keep training through a non-finite loss instead "
+                        "of dumping the flight record and stopping")
     p.add_argument("--auto-restart", type=int, default=0, metavar="N",
                    help="on a crash, rebuild the trainer from the latest "
                         "checkpoint in --checkpoint-dir and continue, up to "
@@ -137,6 +150,15 @@ def build_config(args):
         overrides["model"] = dataclasses.replace(cfg.model, **model_over)
     if args.data_dir is not None:
         overrides["data"] = dataclasses.replace(cfg.data, data_dir=args.data_dir)
+    tele_over = {}
+    if args.spans:
+        tele_over["spans"] = True
+    if args.overflow_threshold is not None:
+        tele_over["overflow_threshold"] = args.overflow_threshold
+    if args.no_halt_on_divergence:
+        tele_over["halt_on_divergence"] = False
+    if tele_over:
+        overrides["telemetry"] = dataclasses.replace(cfg.telemetry, **tele_over)
     if args.log_dir is not None:
         overrides["log_dir"] = args.log_dir
     if overrides:
@@ -200,6 +222,14 @@ def main():
                             checkpoint_dir=args.checkpoint_dir)
                 break
             except Exception as e:
+                from mamba_distributed_tpu.obs import DivergenceError
+
+                # a divergence is deterministic from the restored state:
+                # a restart would replay the same data/RNG back to the
+                # same NaN, burning the whole budget for nothing — the
+                # flight record is the actionable artifact, stop here
+                if isinstance(e, DivergenceError):
+                    raise
                 if attempt == args.auto_restart:
                     raise
                 print(f"run crashed ({type(e).__name__}: {e}); "
