@@ -129,6 +129,27 @@ class ModelConfig:
     loss_impl: str = "dense"
     loss_vocab_blocks: int = 8
 
+    # --- chunked prompt prefill (serving/prefill.py; pure-SSM only) ---
+    # Prompts longer than this many tokens prefill as fixed-size chunks
+    # threaded through the mixers' initial_conv_state/initial_ssm_state
+    # carries: one compiled chunk shape regardless of prompt length
+    # (instead of one pow2 bucket trace per length class, and instead of
+    # up-to-2x pow2 padding waste), and the serving engine can interleave
+    # a long prompt's chunks with decode ticks.  Lives on ModelConfig —
+    # not an engine knob — so ``generate()`` and the engine always chunk
+    # the same prompt identically (the token-parity contract, same rule
+    # as the pow2 buckets).  Consumers read
+    # ``effective_prefill_chunk_tokens``, which rounds this up to a
+    # multiple of ``chunk_size`` for mamba2 (SSD chunk alignment).
+    # 0 disables (always one-shot pow2-bucketed prefill).
+    prefill_chunk_tokens: int = 256
+    # Serving-engine interleaving budget: max prefill-chunk tokens
+    # dispatched between two decode ticks (serving/engine.py).  Bounds
+    # the tick-to-tick stall a long prompt can inject (ITL of running
+    # slots) while it streams in.  0 => unbounded (a whole prompt
+    # prefills between two ticks, the pre-chunking behavior).
+    prefill_tokens_per_tick: int = 512
+
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
             raise ValueError(
@@ -167,6 +188,16 @@ class ModelConfig:
                 f"loss_vocab_blocks={self.loss_vocab_blocks} must be a "
                 f"positive divisor of padded vocab {self.vocab_size_padded}"
             )
+        if self.prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0 (0 disables chunked "
+                f"prefill), got {self.prefill_chunk_tokens}"
+            )
+        if self.prefill_tokens_per_tick < 0:
+            raise ValueError(
+                f"prefill_tokens_per_tick must be >= 0 (0 => unbounded), "
+                f"got {self.prefill_tokens_per_tick}"
+            )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"attn_impl must be 'auto', 'xla' or 'pallas', got "
@@ -204,6 +235,25 @@ class ModelConfig:
     @property
     def effective_dt_rank(self) -> int:
         return self.dt_rank or math.ceil(self.d_model / 16)
+
+    @property
+    def effective_prefill_chunk_tokens(self) -> int:
+        """Chunked-prefill chunk width actually used (0 => disabled).
+
+        For mamba2 the configured width rounds UP to the next multiple
+        of ``chunk_size`` so prefill-chunk boundaries always land on SSD
+        chunk boundaries (a misaligned split would degrade the chunked
+        scan via ``_divisor_chunk``), whatever a sweep sets
+        ``chunk_size`` to.  Every chunked-prefill consumer — the serving
+        engine, ``generate()``, the planner — reads THIS, never the raw
+        field, so the two sides can never disagree on the layout.
+        """
+        c = self.prefill_chunk_tokens
+        if c <= 0:
+            return 0
+        if self.ssm_layer == "mamba2" and c % self.chunk_size:
+            return ((c + self.chunk_size - 1) // self.chunk_size) * self.chunk_size
+        return c
 
     @property
     def nheads(self) -> int:

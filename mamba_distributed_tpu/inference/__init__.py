@@ -1,8 +1,10 @@
 """Inference: recurrent O(1)-per-token generation + sampling."""
 
 from mamba_distributed_tpu.inference.bucketing import (
+    chunk_aligned_bucket,
     next_pow2_bucket,
     pad_to_bucket,
+    use_chunked_prefill,
 )
 from mamba_distributed_tpu.inference.generate import (
     generate,
@@ -11,9 +13,11 @@ from mamba_distributed_tpu.inference.generate import (
 )
 
 __all__ = [
+    "chunk_aligned_bucket",
     "generate",
     "next_pow2_bucket",
     "pad_to_bucket",
     "top_k_sample",
+    "use_chunked_prefill",
     "vocab_pad_mask",
 ]

@@ -23,6 +23,14 @@ callers skip bucketing when ``cfg.attn_layer_idx`` is non-empty.)
 Shared by ``inference/generate.py`` and the serving prefill path
 (``serving/engine.py``); the trace-count test in tests/test_serving.py
 pins the one-trace-per-bucket contract.
+
+Long prompts (``t > cfg.prefill_chunk_tokens`` when chunking is on)
+leave the pow2 ladder: they pad to the next multiple of the chunk size
+(``chunk_aligned_bucket``) and prefill chunk-by-chunk through one
+compiled chunk shape (serving/prefill.py) — one trace total and at most
+``chunk-1`` pad tokens, instead of a new pow2 trace per length class
+and up-to-2x padding waste.  The pad stays on the LEFT (entirely inside
+the first chunk), so the mask contract above is unchanged.
 """
 
 from __future__ import annotations
@@ -43,6 +51,23 @@ def next_pow2_bucket(t: int, min_bucket: int = MIN_BUCKET) -> int:
     while b < t:
         b *= 2
     return b
+
+
+def chunk_aligned_bucket(t: int, chunk: int) -> int:
+    """Smallest multiple of ``chunk`` >= t (the chunked-prefill layout)."""
+    if t < 1:
+        raise ValueError(f"prompt length must be >= 1, got {t}")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    return ((t + chunk - 1) // chunk) * chunk
+
+
+def use_chunked_prefill(t: int, chunk_tokens: int) -> bool:
+    """One rule for both ``generate()`` and the serving engine: prompts
+    longer than the chunk size take the chunked path (token-parity
+    demands the two callers never disagree).  ``chunk_tokens <= 0``
+    disables chunking entirely."""
+    return chunk_tokens > 0 and t > chunk_tokens
 
 
 def pad_to_bucket(

@@ -18,7 +18,10 @@ Serving contracts (mamba_distributed_tpu/serving/ reuses all of this):
   the padded prefill is numerically equivalent to the unpadded one
   (~1e-7 summation-order noise for off-bucket lengths; pass
   ``length_bucketing=False`` to reproduce pre-bucketing streams
-  exactly).
+  exactly).  Prompts longer than ``cfg.effective_prefill_chunk_tokens``
+  instead run the serving chunk step chunk-by-chunk
+  (serving/prefill.py) — the identical computation the engine performs,
+  so long-prompt parity is exact by construction.
 * The per-step sampling key is ``fold_in(key, i)`` — reproducible from
   (request key, tokens-generated counter) alone, which is what lets the
   serving engine's slot-pooled decode emit the same token stream as a
@@ -36,14 +39,20 @@ import jax
 import jax.numpy as jnp
 
 from mamba_distributed_tpu.config import ModelConfig
-from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
+from mamba_distributed_tpu.inference.bucketing import (
+    next_pow2_bucket,
+    pad_to_bucket,
+    use_chunked_prefill,
+)
 from mamba_distributed_tpu.models.lm import lm_prefill, lm_step
 
-# Python-side-effect trace counter: _generate_impl bumps this exactly
-# once per jit trace (retraces are what the bucketing exists to bound —
-# pinned by tests/test_serving.py::test_generate_length_bucketing_traces;
-# the serving engine keeps its own counters in serving/engine.py).
-TRACE_COUNTS = {"generate": 0}
+# Python-side-effect trace counters: _generate_impl / _decode_impl bump
+# these exactly once per jit trace (retraces are what the bucketing
+# exists to bound — pinned by
+# tests/test_serving.py::test_generate_length_bucketing_traces and
+# tests/test_prefill.py; the serving engine keeps its own counters in
+# serving/engine.py, the chunk step's lives in serving/prefill.py).
+TRACE_COUNTS = {"generate": 0, "decode": 0}
 
 
 def top_k_sample(
@@ -101,6 +110,46 @@ def _decode_params(params: dict, cfg: ModelConfig) -> dict:
     return jax.tree_util.tree_map_with_path(cast, params)
 
 
+def _decode_scan(
+    params: dict,
+    cfg: ModelConfig,
+    state,
+    last_logits: jax.Array,
+    key: jax.Array,
+    max_new_tokens: int,
+    top_k: int,
+    temperature: float,
+    eos_id: jax.Array,
+) -> jax.Array:
+    """The decode loop: (prefill state, last logits) -> (b, n) sampled
+    tokens.  ONE definition shared by ``_generate_impl`` (one-shot
+    prefill) and ``_decode_impl`` (chunked prefill), so the two paths'
+    decode numerics cannot diverge."""
+    b = last_logits.shape[0]
+    pad_mask = vocab_pad_mask(cfg)
+    has_eos = eos_id >= 0
+
+    def decode(carry, i):
+        state, logits, done = carry
+        # fold_in (not split) so the serving engine can reproduce step i's
+        # key from (request key, per-slot counter) without a static budget
+        tok = top_k_sample(
+            jax.random.fold_in(key, i), logits + pad_mask, top_k, temperature
+        )
+        # `done` implies has_eos (it is only ever set below), so finished
+        # rows deterministically keep emitting the eos token
+        tok = jnp.where(done, eos_id, tok)
+        done = done | (has_eos & (tok == eos_id))
+        logits, state = lm_step(params, cfg, state, tok)
+        return (state, logits, done), tok
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _), new_tokens = jax.lax.scan(
+        decode, (state, last_logits, done0), jnp.arange(max_new_tokens)
+    )
+    return jnp.moveaxis(new_tokens, 0, 1)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "top_k", "temperature"),
@@ -130,29 +179,39 @@ def _generate_impl(
         params, cfg, prompt_ids, max_len=t + max_new_tokens,
         token_mask=token_mask,
     )
-
-    pad_mask = vocab_pad_mask(cfg)
-    has_eos = eos_id >= 0
-
-    def decode(carry, i):
-        state, logits, done = carry
-        # fold_in (not split) so the serving engine can reproduce step i's
-        # key from (request key, per-slot counter) without a static budget
-        tok = top_k_sample(
-            jax.random.fold_in(key, i), logits + pad_mask, top_k, temperature
-        )
-        # `done` implies has_eos (it is only ever set below), so finished
-        # rows deterministically keep emitting the eos token
-        tok = jnp.where(done, eos_id, tok)
-        done = done | (has_eos & (tok == eos_id))
-        logits, state = lm_step(params, cfg, state, tok)
-        return (state, logits, done), tok
-
-    done0 = jnp.zeros((b,), bool)
-    (_, _, _), new_tokens = jax.lax.scan(
-        decode, (state, last_logits, done0), jnp.arange(max_new_tokens)
+    new_tokens = _decode_scan(
+        params, cfg, state, last_logits, key, max_new_tokens, top_k,
+        temperature, eos_id,
     )
-    return jnp.concatenate([prompt_ids, jnp.moveaxis(new_tokens, 0, 1)], axis=1)
+    return jnp.concatenate([prompt_ids, new_tokens], axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "top_k", "temperature"),
+)
+def _decode_impl(
+    params: dict,
+    cfg: ModelConfig,
+    state,
+    last_logits: jax.Array,
+    key: jax.Array,
+    max_new_tokens: int,
+    top_k: int,
+    temperature: float,
+    eos_id: jax.Array,
+) -> jax.Array:
+    """Decode from an externally built prefill state (the chunked-prefill
+    path, serving/prefill.chunked_prefill) -> (b, max_new_tokens).
+
+    One trace per (cfg, budget, sampling statics) regardless of prompt
+    length — the prompt's shape never enters this function."""
+    TRACE_COUNTS["decode"] += 1  # python side effect: runs once per trace
+    params = _decode_params(params, cfg)
+    return _decode_scan(
+        params, cfg, state, last_logits, key, max_new_tokens, top_k,
+        temperature, eos_id,
+    )
 
 
 def generate(
@@ -178,8 +237,29 @@ def generate(
     ``length_bucketing`` pads the prompt to a power-of-two bucket (pure-
     SSM stacks only) so any workload of heterogeneous prompt lengths
     compiles O(log max_len) traces instead of one per distinct length.
+    Prompts longer than ``cfg.prefill_chunk_tokens`` (when > 0) instead
+    prefill chunk-by-chunk through the serving chunk step
+    (serving/prefill.py) — ONE compiled chunk shape + one decode trace
+    for any prompt length, and the exact computation the serving engine
+    runs, which is what keeps engine-vs-generate() token parity exact
+    for long prompts too.
     """
     b, t = prompt_ids.shape
+    if (
+        length_bucketing
+        and not cfg.attn_layer_idx
+        and use_chunked_prefill(t, cfg.effective_prefill_chunk_tokens)
+    ):
+        # deferred import: serving imports this module at package-load
+        # time, so the reverse edge must stay out of import time
+        from mamba_distributed_tpu.serving.prefill import chunked_prefill
+
+        last_logits, state = chunked_prefill(params, cfg, prompt_ids)
+        new_tokens = _decode_impl(
+            params, cfg, state, last_logits, key, max_new_tokens, top_k,
+            temperature, jnp.int32(-1 if eos_id is None else eos_id),
+        )
+        return jnp.concatenate([prompt_ids, new_tokens], axis=1)
     if length_bucketing and not cfg.attn_layer_idx:
         padded, mask = pad_to_bucket(prompt_ids, next_pow2_bucket(t))
     else:

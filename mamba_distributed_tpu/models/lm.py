@@ -172,15 +172,19 @@ def _moe_mlp(params: dict, cfg: ModelConfig, x: jax.Array, compute_dtype):
 
 
 def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
-               return_state: bool = False, token_mask=None):
+               return_state: bool = False, token_mask=None,
+               initial_state=None):
     """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP/MoE].
 
     ``return_state=True`` (prefill) additionally returns the mixer's decode
     state (conv+SSM caches, or attention KV caches).  ``token_mask``
     (prefill only) zeroes the mixer's scan inputs at left-pad positions
-    (inference/bucketing.py).  With a MoE model
-    (``cfg.moe_num_experts > 0``) the non-state form returns
-    ``(hidden, residual, aux)`` — the layer's load-balance loss term.
+    (inference/bucketing.py).  ``initial_state`` (chunked prefill,
+    SSM-only) is a ``(conv_state, ssm_state)`` carry from the previous
+    chunk, resuming the mixer's scan mid-prompt (lm_prefill_chunk).
+    With a MoE model (``cfg.moe_num_experts > 0``) the non-state form
+    returns ``(hidden, residual, aux)`` — the layer's load-balance loss
+    term.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
@@ -203,6 +207,12 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
                 "token_mask prefill is SSM-only: attention layers would "
                 "still attend to the pad keys (skip bucketing for hybrids)"
             )
+        if initial_state is not None:
+            raise ValueError(
+                "initial_state carry is SSM-only: attention layers resume "
+                "via their KV cache, not a scan carry (chunked prefill is "
+                "pure-SSM, serving/prefill.py)"
+            )
         if return_state:
             hidden, state = attention_mixer(
                 block_params["mixer"], cfg, normed, return_final_state=True
@@ -214,9 +224,11 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
     else:
         if return_state:
             mix = mamba2_mixer if cfg.ssm_layer == "mamba2" else mamba1_mixer
+            ics, iss = (None, None) if initial_state is None else initial_state
             hidden, state = mix(
                 block_params["mixer"], cfg, normed, return_final_state=True,
                 token_mask=token_mask,
+                initial_conv_state=ics, initial_ssm_state=iss,
             )
         else:
             hidden = _mixer_fwd(block_params["mixer"], cfg, normed, seq_ctx=seq_ctx)
@@ -732,6 +744,59 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
 
     logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
     return logits[:, 0].astype(jnp.float32), state
+
+
+def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
+                     state, token_mask: jax.Array | None = None):
+    """Resumable prefill: one chunk of a prompt, carries threaded through.
+
+    The chunked-prefill workhorse (serving/prefill.py): identical to the
+    pure-SSM branch of ``lm_prefill`` except every layer's mixer starts
+    from ``state`` — the ``{"blocks": (conv (L, b, ...), ssm (L, b, ...))}``
+    pytree a previous chunk (or ``init_lm_state``) produced — so a long
+    prompt runs as a sequence of fixed-shape chunk calls: one compiled
+    shape total, and the serving engine can interleave chunks with
+    decode ticks.
+
+    Chunk-split equivalence vs one ``lm_prefill`` over the concatenated
+    sequence: everything outside the mixers is per-position; the conv
+    carry is the literal trailing inputs (bit-exact across a split); the
+    SSM carry enters the next chunk's state passing as mathematically
+    the same recurrence with re-associated fp32 sums (~1e-6 — same
+    class of noise as the pow2 bucketing's pad-shifted chunk boundaries;
+    tests/test_prefill.py pins both the exact and the tolerance parts).
+    Exact token parity between the engine and ``generate()`` therefore
+    comes from both sides running THIS function over identical chunks,
+    not from chunked == one-shot.
+
+    Returns (last_logits (b, V) fp32, new state) — same contract as
+    ``lm_prefill``.
+    """
+    if cfg.attn_layer_idx:
+        raise ValueError(
+            "chunked prefill is pure-SSM only: attention layers have no "
+            "scan carry to resume (serving/prefill.py)"
+        )
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    hidden = params["embedding"][input_ids].astype(compute_dtype)
+    residual = jnp.zeros_like(
+        hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
+    )
+
+    def body(carry, xs):
+        hidden, residual = carry
+        bp, st = xs
+        hidden, residual, new_st = _block_fwd(
+            bp, cfg, hidden, residual, False, return_state=True,
+            token_mask=token_mask, initial_state=st,
+        )
+        return (hidden, residual), new_st
+
+    (hidden, residual), state_blocks = jax.lax.scan(
+        body, (hidden, residual), (params["blocks"], state["blocks"])
+    )
+    logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
+    return logits[:, 0].astype(jnp.float32), {"blocks": state_blocks}
 
 
 def init_lm_state(cfg: ModelConfig, batch: int, max_len: int = 0):

@@ -3,14 +3,23 @@
 docs/SERVING.md has the architecture; the short version:
 
   state_cache  fixed-capacity slot pool of per-layer conv+SSM states
-               (+ per-slot sampling params), jit insert/evict
+               (+ per-slot sampling params), jit insert/evict, plus
+               partial-prefill residency (stash/read/finish)
+  prefill      chunked prompt prefill: planner + one compiled chunk
+               step threading the mixers' conv/SSM carries
   engine       one compiled decode tick advances all occupied slots;
-               admission between ticks, no retracing
+               admission + budgeted prefill chunks between ticks,
+               no retracing
   scheduler    FCFS queue + request lifecycle (queued -> prefill ->
                decode -> finished)
 """
 
 from mamba_distributed_tpu.serving.engine import ServingEngine
+from mamba_distributed_tpu.serving.prefill import (
+    ChunkPlan,
+    chunked_prefill,
+    plan_chunks,
+)
 from mamba_distributed_tpu.serving.scheduler import (
     FCFSScheduler,
     GenerationRequest,
@@ -21,13 +30,16 @@ from mamba_distributed_tpu.serving.scheduler import (
 from mamba_distributed_tpu.serving.state_cache import evict, init_pool, insert
 
 __all__ = [
+    "ChunkPlan",
     "FCFSScheduler",
     "GenerationRequest",
     "GenerationResult",
     "RequestStatus",
     "ServingEngine",
     "TokenEvent",
+    "chunked_prefill",
     "evict",
     "init_pool",
     "insert",
+    "plan_chunks",
 ]

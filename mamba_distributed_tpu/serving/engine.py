@@ -9,15 +9,30 @@ slots costs (nearly) nothing per tick: aggregate tokens/sec scales with
 occupancy (docs/SERVING.md; scripts/bench_serving.py measures it against
 sequential ``generate()`` calls).
 
+Long prompts (``t > cfg.prefill_chunk_tokens``) prefill in CHUNKS
+(serving/prefill.py) interleaved with decode ticks: each ``step()``
+spends at most ``cfg.prefill_tokens_per_tick`` tokens of chunk work
+(oldest request first) before running the tick, and a half-prefilled
+request keeps its slot with its scan carry parked in the pool
+(``state_cache.stash_prefill``; the tick masks such slots from sampling
+and from state writes) until the next budget grant resumes it.  Short
+prompts keep the PR-1 behavior: a one-shot pow2-bucketed prefill at
+admission, not counted against the chunk budget (they are at most
+~chunk-sized by construction).  This bounds both the TTFT of short
+requests and the ITL of running slots while a long prompt streams in —
+the head-of-line blocking ``bench_serving --long-prompt`` measures.
+
 Parity contract: a request's token stream is bit-identical to a solo
 ``generate(params, cfg, prompt[None], key, ...)`` call with the same key
 whenever ``request.top_k == engine.max_top_k`` (the static top-k width),
-regardless of what else shares the batch.  The three pieces that make
-this hold, all pinned by tests/test_serving.py:
+regardless of what else shares the batch.  The pieces that make this
+hold, pinned by tests/test_serving.py and tests/test_prefill.py:
 
-* both pad the same prompt to the same bucket, so engine prefill and
-  the solo call's are the identical computation (models/lm.lm_prefill
-  token_mask; bucket size deliberately not a knob);
+* both pad the same prompt to the same bucket — pow2 one-shot for short
+  prompts, the chunk-aligned layout driven through the SAME jitted
+  chunk step for long ones (neither is an engine knob: both live on
+  ModelConfig / the bucketing module, so the two callers can never
+  disagree);
 * the step-i sampling key is ``fold_in(request_key, i)``, reproducible
   from the per-slot counter alone — and a vmapped per-row
   ``categorical`` draws the same bits as generate's batch-1 call;
@@ -41,9 +56,15 @@ import numpy as np
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
 from mamba_distributed_tpu.obs import NULL_TRACER, StreamingHistogram
-from mamba_distributed_tpu.inference.generate import _decode_params, vocab_pad_mask
-from mamba_distributed_tpu.models.lm import lm_prefill, lm_step
+from mamba_distributed_tpu.inference.generate import vocab_pad_mask
+from mamba_distributed_tpu.models.lm import init_lm_state, lm_prefill, lm_step
 from mamba_distributed_tpu.serving import state_cache
+from mamba_distributed_tpu.serving.prefill import (
+    cast_decode_params,
+    chunk_inputs,
+    plan_chunks,
+    prefill_chunk,
+)
 from mamba_distributed_tpu.serving.scheduler import (
     FCFSScheduler,
     GenerationRequest,
@@ -55,15 +76,10 @@ from mamba_distributed_tpu.serving.scheduler import (
 from mamba_distributed_tpu.utils.metrics import ServingMetrics
 
 # Python-side-effect trace counters (one bump per jit trace) — the
-# bucketing exists to bound these; tests/test_serving.py pins them.
+# bucketing exists to bound these; tests/test_serving.py pins them (the
+# chunk step's counter lives in serving/prefill.py, pinned by
+# tests/test_prefill.py).
 TRACE_COUNTS = {"prefill": 0, "tick": 0}
-
-
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _cast_params(params: dict, cfg: ModelConfig) -> dict:
-    """Module-level jitted decode cast so every engine instance over the
-    same params/cfg shares one compilation (the bench builds two)."""
-    return _decode_params(params, cfg)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -97,7 +113,9 @@ def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
 
     def one(pool, _):
         meta = pool["meta"]
-        live = meta["active"] & ~meta["done"]
+        # a slot mid-chunked-prefill is resident but NOT live: it emits
+        # nothing, and its parked scan carry must survive the tick
+        live = meta["active"] & ~meta["done"] & ~meta["prefilling"]
         has_eos = meta["eos_id"] >= 0
         keys = jax.vmap(jax.random.fold_in)(meta["key"], meta["step"])
         vals, idx = jax.lax.top_k(pool["logits"] + pad_mask, k_max)
@@ -109,6 +127,18 @@ def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
         tok = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
         tok = jnp.where(meta["done"] & has_eos, meta["eos_id"], tok)
         logits, state = lm_step(params, cfg, pool["state"], tok)
+        # empty/done slots may compute garbage freely (masked, overwritten
+        # by the next insert), but a prefilling slot's rows hold a REAL
+        # carry — keep them (select per (L, S, ...) leaf on the S axis)
+        hold = meta["prefilling"]
+        state = jax.tree.map(
+            lambda new, old: jnp.where(
+                hold.reshape((1, -1) + (1,) * (new.ndim - 2)), old, new
+            ),
+            state,
+            pool["state"],
+        )
+        logits = jnp.where(hold[:, None], pool["logits"], logits)
         step = meta["step"] + live.astype(jnp.int32)
         done = meta["done"] | (
             live & ((has_eos & (tok == meta["eos_id"])) | (step >= meta["max_new"]))
@@ -138,6 +168,12 @@ class ServingEngine:
       tokens_per_tick: decode sub-steps fused into one compiled tick.
         Larger amortizes dispatch; smaller admits waiting requests
         sooner (admission only happens between ticks).
+      prefill_tokens_per_tick: chunk-prefill token budget spent between
+        consecutive ticks (oldest in-flight prefill first; at least one
+        chunk per step so progress is guaranteed).  None (default) takes
+        ``cfg.prefill_tokens_per_tick``; 0 => unbounded.  Short-prompt
+        one-shot prefills are NOT budgeted — each is at most ~one chunk
+        of work, the PR-1 admission behavior.
       retain_results: keep every finished request's GenerationResult in
         ``self.results`` (what ``run()`` reads).  A long-lived streaming
         server consuming TokenEvents should pass False — retention
@@ -163,6 +199,7 @@ class ServingEngine:
         capacity: int = 8,
         max_top_k: int = 50,
         tokens_per_tick: int = 8,
+        prefill_tokens_per_tick: int | None = None,
         retain_results: bool = True,
         metrics: ServingMetrics | None = None,
         tracer=NULL_TRACER,
@@ -173,18 +210,34 @@ class ServingEngine:
             )
         if tokens_per_tick < 1:
             raise ValueError("tokens_per_tick must be >= 1")
+        if prefill_tokens_per_tick is None:
+            prefill_tokens_per_tick = cfg.prefill_tokens_per_tick
+        if prefill_tokens_per_tick < 0:
+            raise ValueError("prefill_tokens_per_tick must be >= 0 "
+                             "(0 => unbounded)")
         self.cfg = cfg
         self.capacity = capacity
         self.max_top_k = max_top_k
         self.tokens_per_tick = tokens_per_tick
+        self.prefill_tokens_per_tick = prefill_tokens_per_tick
         self.retain_results = retain_results
         self.pool = state_cache.init_pool(cfg, capacity)  # validates cfg
-        self._params = _cast_params(params, cfg=cfg)
+        self._params = cast_decode_params(params, cfg=cfg)
         self.scheduler = FCFSScheduler()
         self.metrics = metrics or ServingMetrics(capacity)
         self.tracer = tracer
         self._free: list[int] = list(range(capacity))
         self._slots: dict[int, _Tracked] = {}
+        # slots holding a partial chunked prefill, in admission order
+        # (the budget drains them FCFS)
+        self._prefill_queue: list[int] = []
+        # prefill accounting awaiting a tick record: tick-less steps
+        # (everything resident still mid-prefill) roll their stall /
+        # chunk counters into the NEXT tick's jsonl record so the
+        # serving_tick stream never drops work (obs_report.py totals)
+        self._pending_stall_ms = 0.0
+        self._pending_chunk_tokens = 0
+        self._pending_chunk_ms = 0.0
         self.results: dict[int, GenerationResult] = {}
 
     # ------------------------------------------------------------- admission
@@ -200,24 +253,42 @@ class ServingEngine:
         return tracked.request_id
 
     def _admit(self, tracked: _Tracked) -> None:
+        """Grant the next queued request a slot.  Short prompts prefill
+        one-shot right here (PR-1 path); long prompts register a chunk
+        plan and park a zero carry — their chunks run in the budget
+        phase (``_advance_prefill``)."""
         slot = self._free.pop(0)
         tracked.status = RequestStatus.PREFILL
         r = tracked.request
+        plan = plan_chunks(len(r.prompt_ids),
+                           self.cfg.effective_prefill_chunk_tokens)
         t0 = time.perf_counter()
         try:
-            prompt = jnp.asarray(r.prompt_ids, jnp.int32)[None, :]
-            padded, mask = pad_to_bucket(
-                prompt, next_pow2_bucket(prompt.shape[1])
-            )
-            # async dispatch: admitting k queued requests between ticks
-            # queues k prefills+inserts without a host sync each — the
-            # next tick's token fetch is the one synchronization point
-            logits, state = _prefill(self._params, padded, mask, cfg=self.cfg)
-            self.pool = state_cache.insert(
-                self.pool, slot, state, logits, r.resolve_key(),
-                r.max_new_tokens, r.top_k, r.temperature,
-                -1 if r.eos_id is None else r.eos_id,
-            )
+            if plan is None:
+                prompt = jnp.asarray(r.prompt_ids, jnp.int32)[None, :]
+                padded, mask = pad_to_bucket(
+                    prompt, next_pow2_bucket(prompt.shape[1])
+                )
+                # async dispatch: admitting k queued requests between ticks
+                # queues k prefills+inserts without a host sync each — the
+                # next tick's token fetch is the one synchronization point
+                logits, state = _prefill(
+                    self._params, padded, mask, cfg=self.cfg
+                )
+                self.pool = state_cache.insert(
+                    self.pool, slot, state, logits, r.resolve_key(),
+                    r.max_new_tokens, r.top_k, r.temperature,
+                    -1 if r.eos_id is None else r.eos_id,
+                )
+            else:
+                tracked.plan = plan
+                tracked.chunks_done = 0
+                tracked.prefill_dt = 0.0
+                self.pool = state_cache.stash_prefill(
+                    self.pool, slot, init_lm_state(self.cfg, batch=1),
+                    r.resolve_key(), r.max_new_tokens, r.top_k,
+                    r.temperature, -1 if r.eos_id is None else r.eos_id,
+                )
         except Exception:
             # a failed prefill must neither leak the slot (capacity would
             # shrink for the process lifetime) nor drop the request — it
@@ -229,7 +300,8 @@ class ServingEngine:
         # dt is host dispatch time (prefill runs async; the next tick's
         # fetch absorbs device completion)
         t_admit = time.perf_counter()
-        self.metrics.record_prefill(int(prompt.shape[1]), t_admit - t0)
+        if plan is None:
+            self.metrics.record_prefill(int(len(r.prompt_ids)), t_admit - t0)
         # lifecycle stamps: queue-wait is submit -> slot granted; the
         # per-request ITL histogram rides in the finish record so
         # obs_report.py can merge per-token percentiles across requests
@@ -237,8 +309,93 @@ class ServingEngine:
         tracked.itl_hist = StreamingHistogram()
         self.metrics.record_queue_wait(t_admit - tracked.t_submit)
         tracked.slot = slot
-        tracked.status = RequestStatus.DECODE
         self._slots[slot] = tracked
+        if plan is None:
+            tracked.status = RequestStatus.DECODE
+        else:
+            self._prefill_queue.append(slot)
+
+    def _advance_prefill(self, slot: int, budget_left: float) -> float:
+        """Run chunks for ``slot``'s partial prefill until its plan or the
+        budget runs out (>= 1 chunk per call: progress is guaranteed even
+        when ``budget_left < chunk``).  Completion flips the slot
+        decodable; otherwise the carry is re-stashed.  Returns the
+        remaining budget."""
+        tracked = self._slots[slot]
+        plan, r = tracked.plan, tracked.request
+        logits = None
+        try:
+            state = state_cache.read_state(self.pool, slot)
+            while tracked.chunks_done < plan.n_chunks and budget_left > 0:
+                i = tracked.chunks_done
+                ids, mask = chunk_inputs(r.prompt_ids, plan, i)
+                t0 = time.perf_counter()
+                with self.tracer.span("serving_prefill_chunk", slot=slot,
+                                      chunk=i, of=plan.n_chunks):
+                    logits, state = prefill_chunk(
+                        self._params, ids, mask, state, cfg=self.cfg
+                    )
+                dt = time.perf_counter() - t0  # host dispatch time
+                tracked.chunks_done += 1
+                tracked.prefill_dt += dt
+                budget_left -= plan.chunk
+                self.metrics.record_prefill_chunk(plan.chunk, dt)
+            if tracked.chunks_done == plan.n_chunks:
+                self.pool = state_cache.finish_prefill(
+                    self.pool, slot, state, logits
+                )
+                self._prefill_queue.remove(slot)
+                tracked.status = RequestStatus.DECODE
+                self.metrics.record_prefill(
+                    plan.prompt_len, tracked.prefill_dt
+                )
+            else:
+                self.pool = state_cache.stash_prefill(
+                    self.pool, slot, state, r.resolve_key(),
+                    r.max_new_tokens, r.top_k, r.temperature,
+                    -1 if r.eos_id is None else r.eos_id,
+                )
+        except Exception:
+            # mirror the one-shot contract: free the slot, requeue the
+            # request (restarting its prefill from chunk 0), re-raise
+            self.pool = state_cache.evict(self.pool, slot)
+            self._prefill_queue.remove(slot)
+            del self._slots[slot]
+            self._free.insert(0, slot)
+            self._free.sort()
+            tracked.plan = None
+            tracked.chunks_done = 0
+            tracked.slot = None
+            self.scheduler.requeue(tracked)
+            raise
+        return budget_left
+
+    def _prefill_phase(self) -> tuple[float, int]:
+        """Between-ticks prefill work: admit what fits, then spend the
+        chunk budget on in-flight partial prefills (oldest first).
+        Returns (host seconds spent — the tick's ``prefill_stall`` —
+        and chunk tokens dispatched)."""
+        if not ((self._free and self.scheduler.depth) or self._prefill_queue):
+            return 0.0, 0
+        t0 = time.perf_counter()
+        chunk_tokens0 = self.metrics.prefill_chunk_tokens
+        chunk_s0 = self.metrics.prefill_chunk_time_s
+        if self._free and self.scheduler.depth:
+            with self.tracer.span("serving_admit",
+                                  queued=self.scheduler.depth):
+                while self._free and self.scheduler.depth:
+                    self._admit(self.scheduler.pop())
+        budget = self.prefill_tokens_per_tick
+        left = float("inf") if budget == 0 else float(budget)
+        for slot in list(self._prefill_queue):
+            if left <= 0:
+                break
+            left = self._advance_prefill(slot, left)
+        self._pending_chunk_ms += (
+            self.metrics.prefill_chunk_time_s - chunk_s0
+        ) * 1000
+        return (time.perf_counter() - t0,
+                self.metrics.prefill_chunk_tokens - chunk_tokens0)
 
     # ------------------------------------------------------------- decoding
 
@@ -248,18 +405,23 @@ class ServingEngine:
         return self.scheduler.depth + len(self._slots)
 
     def step(self) -> list[TokenEvent]:
-        """Admit what fits, run one compiled tick, stream the tokens.
+        """One engine iteration: prefill phase (admissions + chunk
+        budget), then one compiled tick, streaming its tokens.
 
-        Returns the tick's TokenEvents in emission order; finished
-        requests are evicted and their GenerationResults recorded in
-        ``self.results``.
+        Returns the tick's TokenEvents in emission order (empty while
+        only partial prefills are resident); finished requests are
+        evicted and their GenerationResults recorded in ``self.results``.
         """
-        if self._free and self.scheduler.depth:
-            with self.tracer.span("serving_admit",
-                                  queued=self.scheduler.depth):
-                while self._free and self.scheduler.depth:
-                    self._admit(self.scheduler.pop())
-        if not self._slots:
+        stall_s, chunk_tokens = self._prefill_phase()
+        if stall_s:
+            self.metrics.record_prefill_stall(stall_s)
+        self._pending_stall_ms += stall_s * 1000
+        self._pending_chunk_tokens += chunk_tokens
+        if not any(t.status is RequestStatus.DECODE
+                   for t in self._slots.values()):
+            # nothing decodable yet (empty engine, or every resident slot
+            # still mid-prefill): no tick this step — the loop keeps
+            # granting chunk budget until a slot turns decodable
             return []
         occupied = len(self._slots)
         t0 = time.perf_counter()
@@ -343,7 +505,13 @@ class ServingEngine:
         self.metrics.record_tick(
             occupied=occupied, queue_depth=self.scheduler.depth,
             tokens_emitted=len(events), dt_s=dt,
+            prefill_stall_ms=self._pending_stall_ms,
+            prefill_chunk_tokens=self._pending_chunk_tokens,
+            prefill_chunk_ms=self._pending_chunk_ms,
         )
+        self._pending_stall_ms = 0.0
+        self._pending_chunk_tokens = 0
+        self._pending_chunk_ms = 0.0
         return events
 
     # ------------------------------------------------------------- frontends

@@ -1,0 +1,170 @@
+"""Chunked-prefill subsystem: plan, compiled chunk step, shared driver.
+
+A long prompt's prefill is just a resumable scan — Mamba's decode state
+is O(1), and the mixers accept ``initial_conv_state``/``initial_ssm_state``
+carries — so instead of one pow2-bucketed forward per prompt (a new jit
+trace per length class, up to 2x padding waste, and a tick-stalling
+monolith in the serving engine), prompts longer than
+``cfg.prefill_chunk_tokens`` run as a sequence of fixed-shape chunk
+calls:
+
+  * ``plan_chunks`` pads the prompt (LEFT, like the pow2 buckets) to the
+    next multiple of the chunk size and splits it into equal chunks —
+    the pad lives entirely inside chunk 0, under the usual ``token_mask``;
+  * ``prefill_chunk`` is the one compiled step: ids + mask + carried
+    state -> (last logits, new state), via ``models/lm.lm_prefill_chunk``.
+    ONE trace per (model config, chunk size, batch) no matter how long
+    prompts get — ``TRACE_COUNTS["chunk"]`` pins it
+    (tests/test_prefill.py);
+  * ``chunked_prefill`` drives a whole prompt through the chunk step —
+    the solo ``generate()`` path.  The serving engine drives the same
+    step itself, chunk by chunk between decode ticks, parking the carry
+    in the request's slot (state_cache.stash_prefill) when its per-tick
+    token budget runs out.
+
+Parity: the engine and ``generate()`` run the SAME jitted chunk step
+over the SAME padded chunk layout with params cast by the SAME jitted
+cast, so their prefill states — and therefore token streams — are
+bit-identical by construction (the pow2-bucket playbook, extended).
+Chunked vs ONE-SHOT prefill over the same layout is exact for the conv
+caches (the carry is the literal trailing inputs) and ~1e-6 for the SSM
+states (the inter-chunk fp32 state recurrence re-associates; see
+lm_prefill_chunk's docstring) — pinned at tolerance by
+tests/test_prefill.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference.bucketing import (
+    chunk_aligned_bucket,
+    use_chunked_prefill,
+)
+from mamba_distributed_tpu.inference.generate import _decode_params
+from mamba_distributed_tpu.models.lm import init_lm_state, lm_prefill_chunk
+
+# Python-side-effect trace counter: one bump per jit trace of the chunk
+# step.  The whole point of the fixed chunk shape is that this stays at
+# one per (cfg, chunk, batch) for any prompt-length mix — pinned by
+# tests/test_prefill.py::test_chunk_step_traces_once.
+TRACE_COUNTS = {"chunk": 0}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def cast_decode_params(params: dict, cfg: ModelConfig) -> dict:
+    """Decode-layout param cast (inference/generate._decode_params), jitted
+    once at module level so the serving engine and ``generate()``'s
+    chunked path share one compilation AND produce bit-identical cast
+    values — an input to the chunk-step parity argument above."""
+    return _decode_params(params, cfg)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkPlan:
+    """How one prompt splits into prefill chunks (host-side, static)."""
+
+    prompt_len: int
+    chunk: int  # tokens per chunk (cfg.effective_prefill_chunk_tokens)
+    bucket: int  # padded length = n_chunks * chunk
+    n_chunks: int
+
+    @property
+    def pad(self) -> int:
+        """Left-pad tokens (all inside chunk 0)."""
+        return self.bucket - self.prompt_len
+
+
+def plan_chunks(prompt_len: int, chunk_tokens: int) -> ChunkPlan | None:
+    """The chunk planner.  None => the prompt takes the one-shot pow2
+    path (too short to chunk, or chunking disabled)."""
+    if not use_chunked_prefill(prompt_len, chunk_tokens):
+        return None
+    bucket = chunk_aligned_bucket(prompt_len, chunk_tokens)
+    return ChunkPlan(
+        prompt_len=prompt_len,
+        chunk=chunk_tokens,
+        bucket=bucket,
+        n_chunks=bucket // chunk_tokens,
+    )
+
+
+def chunk_inputs(
+    prompt_ids: np.ndarray, plan: ChunkPlan, i: int
+) -> tuple[jax.Array, jax.Array]:
+    """ids + mask for chunk ``i`` of the left-padded layout.
+
+    prompt_ids (b, t) -> ids (b, chunk) int32, mask (b, chunk) f32 {0,1}.
+    Pad positions (chunk 0's first ``plan.pad`` columns) hold token id 0
+    and mask 0 — the same contract as ``pad_to_bucket``.
+    """
+    if not 0 <= i < plan.n_chunks:
+        raise ValueError(f"chunk {i} out of range [0, {plan.n_chunks})")
+    ids = np.asarray(prompt_ids, np.int32)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    b, t = ids.shape
+    if t != plan.prompt_len:
+        raise ValueError(f"prompt length {t} != plan.prompt_len {plan.prompt_len}")
+    lo, hi = i * plan.chunk, (i + 1) * plan.chunk  # in padded coordinates
+    pad = plan.pad
+    out = np.zeros((b, plan.chunk), np.int32)
+    mask = np.zeros((b, plan.chunk), np.float32)
+    # real tokens occupy padded positions [pad, bucket)
+    src_lo, src_hi = max(lo, pad) - pad, hi - pad
+    dst_lo = max(lo, pad) - lo
+    out[:, dst_lo:] = ids[:, src_lo:src_hi]
+    mask[:, dst_lo:] = 1.0
+    return jnp.asarray(out), jnp.asarray(mask)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_chunk(
+    params: dict, ids: jax.Array, mask: jax.Array, state, cfg: ModelConfig
+):
+    """The compiled chunk step: (ids, mask, carry) -> (last logits, carry').
+
+    ``params`` must already be decode-cast (``cast_decode_params``) —
+    both drivers pass the same cast output, which is what makes their
+    chunk computations bit-identical.
+    """
+    TRACE_COUNTS["chunk"] += 1
+    return lm_prefill_chunk(params, cfg, ids, state, token_mask=mask)
+
+
+def chunked_prefill(
+    params: dict, cfg: ModelConfig, prompt_ids, plan: ChunkPlan | None = None
+):
+    """Drive a whole prompt through the chunk step (the solo-`generate()`
+    driver; the serving engine paces the same loop itself, against its
+    per-tick budget).
+
+    ``params`` are the fp32 master params — cast here via the shared
+    jitted cast.  Returns (last_logits (b, V) fp32, state), the
+    ``lm_prefill`` contract, ready for the decode loop.
+    """
+    prompt = np.asarray(prompt_ids, np.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    b, t = prompt.shape
+    if plan is None:
+        plan = plan_chunks(t, cfg.effective_prefill_chunk_tokens)
+    if plan is None:
+        raise ValueError(
+            f"prompt length {t} does not take the chunked path "
+            f"(prefill_chunk_tokens={cfg.effective_prefill_chunk_tokens}); use "
+            f"lm_prefill via the pow2 bucket instead"
+        )
+    dparams = cast_decode_params(params, cfg=cfg)
+    state = init_lm_state(cfg, batch=b)
+    logits = None
+    for i in range(plan.n_chunks):
+        ids, mask = chunk_inputs(prompt, plan, i)
+        logits, state = prefill_chunk(dparams, ids, mask, state, cfg=cfg)
+    return logits, state

@@ -3,7 +3,9 @@
 A request moves QUEUED -> PREFILL -> DECODE -> FINISHED:
 
   QUEUED    in the scheduler's FCFS queue, waiting for a free slot
-  PREFILL   bucketed full-prompt forward building its recurrent state
+  PREFILL   building its recurrent state: one bucketed forward for short
+            prompts, or chunk-by-chunk across ticks for long ones
+            (serving/prefill.py) — the slot holds the partial carry
   DECODE    occupying a slot; one token per engine tick
   FINISHED  sampled its ``eos_id`` or exhausted ``max_new_tokens``
 
@@ -106,6 +108,12 @@ class _Tracked:
     # rides in the request's jsonl record so obs_report.py can merge
     # per-token percentiles across requests without storing samples
     itl_hist: object | None = None
+    # --- chunked-prefill progress (serving/prefill.py): the plan this
+    # request's prompt splits into (None => one-shot path), how many
+    # chunks have run, and the accumulated host dispatch time ---
+    plan: object | None = None
+    chunks_done: int = 0
+    prefill_dt: float = 0.0
 
 
 class FCFSScheduler:
@@ -141,8 +149,13 @@ class FCFSScheduler:
 
     def requeue(self, tracked: _Tracked) -> None:
         """Put a popped-but-not-admitted request back at the queue head
-        (a failed prefill must not drop it)."""
+        (a failed prefill must not drop it).  Chunked-prefill progress is
+        reset — the retry restarts from chunk 0 with a fresh carry."""
         tracked.status = RequestStatus.QUEUED
+        tracked.slot = None
+        tracked.plan = None
+        tracked.chunks_done = 0
+        tracked.prefill_dt = 0.0
         self._queue.appendleft(tracked)
 
     @property

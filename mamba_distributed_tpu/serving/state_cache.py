@@ -17,6 +17,7 @@ The pool is a plain pytree:
     "meta": {
       "active":      (S,) bool   # slot holds a live request
       "done":        (S,) bool   # request finished, awaiting eviction
+      "prefilling":  (S,) bool   # slot holds a PARTIAL prefill carry
       "key":         (S, 2) u32  # request base PRNG key
       "step":        (S,) i32    # tokens generated so far
       "max_new":     (S,) i32    # per-request budget
@@ -32,8 +33,18 @@ one trace, and the update lowers to ``dynamic_update_slice`` on the
 donated buffers — no reallocation, no retrace, which is what keeps the
 decode loop hot while requests come and go (serving/engine.py).
 
+Chunked prefill (serving/prefill.py) adds partial-prefill residency: a
+half-prefilled request occupies its slot with its scan carry —
+``stash_prefill`` parks the carry + request meta with
+``prefilling=True`` (the decode tick treats the slot as not-live and
+must NOT overwrite its state rows), ``read_state`` slices the carry
+back out to resume at the next budget grant, and ``finish_prefill``
+writes the final state + logits and flips ``prefilling`` off, making
+the slot decodable.
+
 Pure-SSM stacks only: per-slot attention KV caches need a per-row
-length (the stacked cache carries one scalar), a ROADMAP open item.
+length (the stacked cache carries one scalar), a ROADMAP open item
+(docs/SERVING.md "Limits / open items", hybrid-KV entry).
 """
 
 from __future__ import annotations
@@ -51,9 +62,15 @@ def init_pool(cfg: ModelConfig, capacity: int) -> dict:
     """Allocate an empty slot pool for ``capacity`` concurrent requests."""
     if cfg.attn_layer_idx:
         raise ValueError(
-            "the serving slot pool is pure-SSM only: stacked attention KV "
-            "caches share one length scalar, so per-slot lengths can't be "
-            "pooled yet (ROADMAP open item)"
+            f"hybrid models don't serve yet: cfg.attn_layer_idx="
+            f"{cfg.attn_layer_idx} puts attention layers in the stack, and "
+            f"the layer-stacked attention KV cache carries ONE sequence-"
+            f"length scalar for the whole batch, so slots at different "
+            f"positions can't share the pool.  Per-slot KV write indices "
+            f"(the ragged/paged-attention pattern) are the fix — see "
+            f"docs/SERVING.md, 'Limits / open items' hybrid-KV entry, and "
+            f"the ROADMAP 'Hybrid-model serving' item.  Serve a pure-SSM "
+            f"config (attn_layer_idx=()) instead."
         )
     if capacity < 1:
         raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -64,6 +81,7 @@ def init_pool(cfg: ModelConfig, capacity: int) -> dict:
         "meta": {
             "active": jnp.zeros((S,), bool),
             "done": jnp.zeros((S,), bool),
+            "prefilling": jnp.zeros((S,), bool),
             "key": jnp.zeros((S, 2), jnp.uint32),
             "step": jnp.zeros((S,), jnp.int32),
             "max_new": jnp.ones((S,), jnp.int32),
@@ -96,17 +114,12 @@ def insert(
     into ``slot``.  One trace serves every (slot, request) combination —
     all arguments are traced, the pool buffers are donated."""
     # state leaves are layer-stacked (L, 1, ...) -> write batch axis 1
-    new_state = jax.tree.map(
-        lambda p, n: jax.lax.dynamic_update_slice_in_dim(
-            p, n.astype(p.dtype), slot, axis=1
-        ),
-        pool["state"],
-        state,
-    )
+    new_state = _write_state(pool["state"], slot, state)
     meta = pool["meta"]
     new_meta = {
         "active": _set_row(meta["active"], slot, True),
         "done": _set_row(meta["done"], slot, False),
+        "prefilling": _set_row(meta["prefilling"], slot, False),
         "key": _set_row(meta["key"], slot, key),
         "step": _set_row(meta["step"], slot, 0),
         "max_new": _set_row(meta["max_new"], slot, max_new),
@@ -121,6 +134,18 @@ def insert(
     }
 
 
+def _write_state(pool_state, slot: jax.Array, state):
+    """Write a batch-1 state pytree into ``slot`` of the (L, S, ...) pool
+    leaves (shared by insert / stash_prefill / finish_prefill)."""
+    return jax.tree.map(
+        lambda p, n: jax.lax.dynamic_update_slice_in_dim(
+            p, n.astype(p.dtype), slot, axis=1
+        ),
+        pool_state,
+        state,
+    )
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def evict(pool: dict, slot: jax.Array) -> dict:
     """Free ``slot``: mark it empty.  The stale state/logits stay in
@@ -129,4 +154,71 @@ def evict(pool: dict, slot: jax.Array) -> dict:
     meta = dict(pool["meta"])
     meta["active"] = _set_row(meta["active"], slot, False)
     meta["done"] = _set_row(meta["done"], slot, False)
+    meta["prefilling"] = _set_row(meta["prefilling"], slot, False)
     return {"state": pool["state"], "logits": pool["logits"], "meta": meta}
+
+
+# ------------------------------------------------- partial-prefill residency
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def stash_prefill(
+    pool: dict,
+    slot: jax.Array,
+    state: dict,
+    key: jax.Array,
+    max_new: jax.Array,
+    top_k: jax.Array,
+    temperature: jax.Array,
+    eos_id: jax.Array,
+) -> dict:
+    """Park a PARTIAL prefill carry in ``slot``: the request occupies the
+    slot (``active=True``) with its chunk-scan carry and its sampling
+    meta, but ``prefilling=True`` keeps it out of the decode tick — the
+    tick masks it from sampling AND from state writes (a tick's
+    ``lm_step`` over the whole pool must not clobber the carry).  The
+    slot's stale logits are left in place (masked; ``finish_prefill``
+    writes the real ones).  Idempotent — re-stashing after more chunks
+    just overwrites the carry."""
+    meta = pool["meta"]
+    new_meta = {
+        "active": _set_row(meta["active"], slot, True),
+        "done": _set_row(meta["done"], slot, False),
+        "prefilling": _set_row(meta["prefilling"], slot, True),
+        "key": _set_row(meta["key"], slot, key),
+        "step": _set_row(meta["step"], slot, 0),
+        "max_new": _set_row(meta["max_new"], slot, max_new),
+        "top_k": _set_row(meta["top_k"], slot, top_k),
+        "temperature": _set_row(meta["temperature"], slot, temperature),
+        "eos_id": _set_row(meta["eos_id"], slot, eos_id),
+    }
+    return {
+        "state": _write_state(pool["state"], slot, state),
+        "logits": pool["logits"],
+        "meta": new_meta,
+    }
+
+
+@jax.jit
+def read_state(pool: dict, slot: jax.Array):
+    """Slice ``slot``'s batch-1 state pytree back out (resume a stashed
+    prefill at the next budget grant).  NOT donated — the pool lives on."""
+    return jax.tree.map(
+        lambda p: jax.lax.dynamic_slice_in_dim(p, slot, 1, axis=1),
+        pool["state"],
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def finish_prefill(pool: dict, slot: jax.Array, state: dict,
+                   logits: jax.Array) -> dict:
+    """Complete a chunked prefill: write the final carry + last logits and
+    flip ``prefilling`` off — the next tick samples this slot's first
+    token from ``fold_in(key, step=0)``, exactly like a fresh insert."""
+    meta = dict(pool["meta"])
+    meta["prefilling"] = _set_row(meta["prefilling"], slot, False)
+    return {
+        "state": _write_state(pool["state"], slot, state),
+        "logits": _set_row(pool["logits"], slot, logits),
+        "meta": meta,
+    }

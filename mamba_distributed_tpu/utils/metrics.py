@@ -118,6 +118,14 @@ class ServingMetrics:
         self.prefills = 0
         self.prefill_tokens = 0
         self.prefill_time_s = 0.0
+        # chunked prefill (serving/prefill.py): per-chunk dispatch counters
+        # + the per-step prefill stall (host time the engine spends on
+        # prefill work between two ticks — what chunking exists to bound)
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
+        self.prefill_chunk_time_s = 0.0
+        self.prefill_stall_s = 0.0
+        self.prefill_stall_ms = StreamingHistogram()
         self._occupied_sum = 0
         self._queue_depth_sum = 0
         self.peak_queue_depth = 0
@@ -148,6 +156,22 @@ class ServingMetrics:
         self.prefill_tokens += prompt_tokens
         self.prefill_time_s += dt_s
 
+    def record_prefill_chunk(self, chunk_tokens: int, dt_s: float) -> None:
+        """One chunked-prefill step (serving/prefill.py): ``chunk_tokens``
+        of prompt dispatched in ``dt_s`` host seconds.  The whole prompt
+        still gets one ``record_prefill`` at completion, so
+        ``prefill_tokens_per_sec`` keeps its meaning; the chunk counters
+        give the chunk-level dispatch throughput."""
+        self.prefill_chunks += 1
+        self.prefill_chunk_tokens += chunk_tokens
+        self.prefill_chunk_time_s += dt_s
+
+    def record_prefill_stall(self, dt_s: float) -> None:
+        """Host seconds one engine step spent on prefill work (admissions
+        + chunk budget) before its tick — the stall chunking bounds."""
+        self.prefill_stall_s += dt_s
+        self.prefill_stall_ms.record(dt_s * 1000)
+
     # ------------------------------------------------- per-request latency
 
     def record_queue_wait(self, dt_s: float) -> None:
@@ -172,8 +196,17 @@ class ServingMetrics:
             self._write_jsonl({"kind": "request", **record})
 
     def record_tick(
-        self, occupied: int, queue_depth: int, tokens_emitted: int, dt_s: float
+        self, occupied: int, queue_depth: int, tokens_emitted: int,
+        dt_s: float, prefill_stall_ms: float = 0.0,
+        prefill_chunk_tokens: int = 0, prefill_chunk_ms: float = 0.0,
     ) -> None:
+        """``prefill_stall_ms`` is the host time spent on prefill work
+        since the PREVIOUS tick record (an engine step whose slots are
+        all still mid-prefill runs no tick, so its work rolls into the
+        next tick's record — the jsonl stream never drops any);
+        ``prefill_chunk_tokens``/``prefill_chunk_ms`` are the chunked-
+        prefill tokens dispatched in that window and their dispatch
+        time."""
         self.ticks += 1
         self.decode_tokens += tokens_emitted
         self.decode_time_s += dt_s
@@ -187,6 +220,9 @@ class ServingMetrics:
                 "queue_depth": queue_depth,
                 "tokens_emitted": tokens_emitted,
                 "tick_ms": round(dt_s * 1000, 3),
+                "prefill_stall_ms": round(prefill_stall_ms, 3),
+                "prefill_chunk_tokens": prefill_chunk_tokens,
+                "prefill_chunk_ms": round(prefill_chunk_ms, 3),
             })
 
     def summary(self) -> dict:
@@ -216,6 +252,14 @@ class ServingMetrics:
                 round(self.prefill_tokens / self.prefill_time_s, 1)
                 if self.prefill_time_s else None
             ),
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "prefill_chunk_tokens_per_sec": (
+                round(self.prefill_chunk_tokens / self.prefill_chunk_time_s, 1)
+                if self.prefill_chunk_time_s else None
+            ),
+            "prefill_stall_s": round(self.prefill_stall_s, 4),
+            "prefill_stall_ms": self.prefill_stall_ms.summary(),
             "finished_requests": self.finished_requests,
             "latency": {
                 "queue_wait_ms": self.queue_wait_ms.summary(),

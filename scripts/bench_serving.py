@@ -20,6 +20,16 @@ SERVE_TOKENS_PER_TICK (8), BENCH_PLATFORM, BENCH_SEED (0).
 telemetry records (kind serving_tick / request) to PATH — the stream
 ``scripts/obs_report.py`` turns into queue-wait/TTFT/ITL percentile
 tables — and folds the latency summary into the JSON line.
+
+``--long-prompt`` switches to the head-of-line-blocking workload: a few
+LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
+submitted AHEAD of the usual short mix, and the same workload runs
+twice — chunked prefill on (SERVE_CHUNK_TOKENS, default the preset's
+``prefill_chunk_tokens``; SERVE_PREFILL_BUDGET per-tick token budget)
+vs one-shot prefill (chunking forced off).  The headline number is the
+short requests' TTFT p95 with and without chunking: one-shot prefills
+of the long prompts stall every short request's first token behind
+thousands of prompt tokens, while chunking interleaves them with ticks.
 """
 
 from __future__ import annotations
@@ -57,11 +67,79 @@ def _workload(rng, n, pmin, pmax, max_new, vocab):
     return reqs
 
 
+def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
+                       budget, short_max_len, jsonl):
+    """Run the mixed long+short workload once per prefill mode; return
+    (record fields, the chunked run's ServingMetrics summary)."""
+    import dataclasses as _dc
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.serving import GenerationRequest, ServingEngine
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    class _CaptureMetrics(ServingMetrics):
+        """ServingMetrics that also keeps request records on the host so
+        the bench can split TTFT by prompt length."""
+
+        def __init__(self, capacity, jsonl_path=None):
+            super().__init__(capacity, jsonl_path=jsonl_path)
+            self.request_records = []
+
+        def record_request(self, record):
+            super().record_request(record)
+            self.request_records.append(record)
+
+    def p95(xs):
+        return round(float(np.percentile(xs, 95)), 3) if xs else None
+
+    out = {}
+    summary = None
+    for mode in ("chunked", "oneshot"):
+        mode_cfg = (
+            cfg if mode == "chunked"
+            else _dc.replace(cfg, prefill_chunk_tokens=0)
+        )
+        # fresh request objects per run (ids/streams are per-submit)
+        reqs = [GenerationRequest(
+            prompt_ids=np.asarray(r.prompt_ids), max_new_tokens=r.max_new_tokens,
+            seed=r.seed) for r in requests]
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        if budget is not None:
+            kw["prefill_tokens_per_tick"] = budget
+        ServingEngine(params, mode_cfg, **kw).run(reqs)  # warm: compile
+        _progress(f"{mode}: warm")
+        metrics = _CaptureMetrics(
+            capacity, jsonl_path=jsonl if mode == "chunked" else None
+        )
+        engine = ServingEngine(params, mode_cfg, metrics=metrics, **kw)
+        t0 = _time.perf_counter()
+        engine.run(reqs)
+        dt = _time.perf_counter() - t0
+        shorts = [r["ttft_ms"] for r in metrics.request_records
+                  if r["prompt_tokens"] <= short_max_len]
+        longs = [r["ttft_ms"] for r in metrics.request_records
+                 if r["prompt_tokens"] > short_max_len]
+        out[f"ttft_short_p95_ms_{mode}"] = p95(shorts)
+        out[f"ttft_long_p95_ms_{mode}"] = p95(longs)
+        out[f"wall_s_{mode}"] = round(dt, 3)
+        if mode == "chunked":
+            summary = metrics.summary()
+        _progress(f"{mode}: short TTFT p95 {p95(shorts)} ms")
+    a, b = out["ttft_short_p95_ms_oneshot"], out["ttft_short_p95_ms_chunked"]
+    out["ttft_short_p95_speedup"] = round(a / b, 2) if a and b else None
+    return out, summary
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jsonl", default=None, metavar="PATH",
                     help="write the timed run's serving_tick + request "
                          "jsonl stream here (obs_report.py input)")
+    ap.add_argument("--long-prompt", action="store_true",
+                    help="mixed long+short workload; report short-request "
+                         "TTFT p95 with chunked vs one-shot prefill")
     args = ap.parse_args()
 
     import jax
@@ -91,11 +169,72 @@ def main() -> None:
     seed = int(os.environ.get("BENCH_SEED", "0"))
 
     cfg = get_preset(preset).model
+    chunk_tokens = int(os.environ.get("SERVE_CHUNK_TOKENS", "0"))
+    if chunk_tokens:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, prefill_chunk_tokens=chunk_tokens)
     params = jax.jit(lambda k: init_lm_params(k, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     _progress("params initialized")
 
     rng = np.random.default_rng(seed)
+
+    if args.long_prompt:
+        from mamba_distributed_tpu.serving import GenerationRequest
+
+        long_count = int(os.environ.get("SERVE_LONG_COUNT", "2"))
+        long_len = int(os.environ.get("SERVE_LONG_LEN", "8192"))
+        if "SERVE_REQUESTS" not in os.environ:
+            # default the short mix to the free slots: with shorts queuing
+            # for capacity, TTFT p95 measures queue wait, not the prefill
+            # stall this mode exists to expose
+            n_requests = max(1, capacity - long_count)
+        requests = _workload(rng, n_requests, pmin, pmax, max_new,
+                             cfg.vocab_size)
+        budget_env = os.environ.get("SERVE_PREFILL_BUDGET", "")
+        budget = int(budget_env) if budget_env else None
+        if long_len <= max(pmax, cfg.effective_prefill_chunk_tokens):
+            raise SystemExit(
+                f"SERVE_LONG_LEN={long_len} must exceed both "
+                f"SERVE_PROMPT_MAX={pmax} and prefill_chunk_tokens="
+                f"{cfg.effective_prefill_chunk_tokens} to exercise chunking"
+            )
+        longs = [GenerationRequest(
+            prompt_ids=rng.integers(0, cfg.vocab_size, size=long_len)
+            .astype(np.int32),
+            max_new_tokens=max_new, seed=5000 + i,
+        ) for i in range(long_count)]
+        # longs submitted FIRST: the head-of-line-blocking worst case
+        fields, summary = _long_prompt_bench(
+            cfg, params, longs + requests, capacity, tokens_per_tick,
+            budget, pmax, args.jsonl,
+        )
+        record = {
+            "metric": f"serving_short_ttft_p95_ms_{preset.replace('-', '_')}",
+            "value": fields["ttft_short_p95_ms_chunked"],
+            "unit": "ms (short-request TTFT p95, chunked prefill)",
+            **fields,
+            "requests": n_requests,
+            "long_requests": long_count,
+            "long_prompt_len": long_len,
+            "prefill_chunk_tokens": cfg.effective_prefill_chunk_tokens,
+            "prefill_tokens_per_tick": (
+                budget if budget is not None else cfg.prefill_tokens_per_tick
+            ),
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "prefill_chunks": summary["prefill_chunks"],
+            "prefill_stall_ms": summary["prefill_stall_ms"],
+            "latency": summary["latency"],
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        print(json.dumps(record), flush=True)
+        return
+
     requests = _workload(rng, n_requests, pmin, pmax, max_new, cfg.vocab_size)
     total_new = sum(r.max_new_tokens for r in requests)
 

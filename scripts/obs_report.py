@@ -135,6 +135,21 @@ def build_report(events: list[dict]) -> dict:
         # mix correctly ("any mix" is the advertised contract)
         ratios = [e["occupied"] / e["capacity"] for e in ticks
                   if e.get("capacity") and e.get("occupied") is not None]
+        # chunked-prefill accounting (absent in pre-chunking streams):
+        # per-tick-record prefill stall + chunk tokens dispatched in
+        # that window.  Zero-stall records (no prefill work) are
+        # excluded from the percentiles.  NB the granularity differs
+        # from ServingMetrics.summary()["prefill_stall_ms"]: that
+        # histogram samples per ENGINE STEP, while a tick record merges
+        # any preceding tick-less steps into one window, so the two
+        # views' counts/percentiles legitimately differ (totals agree).
+        stalls = [e["prefill_stall_ms"] for e in ticks
+                  if e.get("prefill_stall_ms")]
+        chunk_tokens = sum(e.get("prefill_chunk_tokens", 0) for e in ticks)
+        # chunk dispatch throughput over chunk DISPATCH time (same
+        # definition as summary()["prefill_chunk_tokens_per_sec"]) —
+        # stall time additionally contains one-shot admissions
+        chunk_total_ms = sum(e.get("prefill_chunk_ms", 0.0) for e in ticks)
         report["serving"] = {
             "ticks": len(ticks),
             "decode_tokens": tokens,
@@ -147,6 +162,12 @@ def build_report(events: list[dict]) -> dict:
                 round(sum(ratios) / len(ratios), 4) if ratios else None
             ),
             "peak_queue_depth": max(e.get("queue_depth", 0) for e in ticks),
+            "prefill_stall_ms": _pcts(stalls) if stalls else None,
+            "prefill_chunk_tokens": chunk_tokens,
+            "prefill_chunk_tokens_per_sec": (
+                round(chunk_tokens / (chunk_total_ms / 1000), 1)
+                if chunk_tokens and chunk_total_ms else None
+            ),
         }
 
     # --- per-request latency (the serving stream's "request" records)
@@ -235,16 +256,24 @@ def format_report(report: dict) -> str:
                    f"last loss: {_fmt(v['last_loss'])}")
     if "serving" in report:
         s = report["serving"]
-        out.append(
+        head = (
             f"== serving ticks ==\nticks: {s['ticks']}   decode tokens: "
             f"{s['decode_tokens']}   decode tok/s: "
             f"{_fmt(s['decode_tokens_per_sec'])}   mean occupancy: "
             f"{_fmt(s['mean_slot_occupancy'])}   peak queue: "
-            f"{s['peak_queue_depth']}\n" + _table(
-                [_pct_row("tick_ms", s["tick_ms"])],
-                ["metric", "count", "mean", "p50", "p95", "p99", "max"],
-            )
+            f"{s['peak_queue_depth']}"
         )
+        if s.get("prefill_chunk_tokens"):
+            head += (
+                f"   prefill chunk tokens: {s['prefill_chunk_tokens']}"
+                f" (dispatch tok/s: {_fmt(s['prefill_chunk_tokens_per_sec'])})"
+            )
+        rows = [_pct_row("tick_ms", s["tick_ms"])]
+        if s.get("prefill_stall_ms") is not None:
+            rows.append(_pct_row("prefill_stall_ms", s["prefill_stall_ms"]))
+        out.append(head + "\n" + _table(
+            rows, ["metric", "count", "mean", "p50", "p95", "p99", "max"],
+        ))
     if "requests" in report:
         r = report["requests"]
         rows = [_pct_row("queue_wait_ms", r["queue_wait_ms"]),

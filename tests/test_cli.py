@@ -87,3 +87,47 @@ def test_cli_train_generate_eval_roundtrip(tmp_path):
     # f"{total} {correct_norm}/{total} {acc_norm:.4f}", sample artifact
     # "2000 648/2000 0.3240")
     assert re.fullmatch(r"16 \d{1,2}/16 [01]\.\d{4}", line), repr(line)
+
+
+@pytest.mark.serving
+def test_bench_serving_long_prompt_smoke(tmp_path):
+    """CI smoke for the chunked-prefill headline bench: ``--long-prompt``
+    must drive BOTH prefill modes end-to-end, report the short/long TTFT
+    split, and leave a tick stream carrying the chunk accounting that
+    obs_report.py renders (ISSUE 3 satellites: bench + CI registration)."""
+    import json
+
+    jsonl = str(tmp_path / "lp.jsonl")
+    env = dict(os.environ)
+    # mamba2-tiny has chunk_size=64, so 64-token prefill chunks are legal;
+    # a 160-token long prompt -> 192-token bucket -> 3 chunks
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="2", SERVE_CAPACITY="3",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="8",
+               SERVE_MAX_NEW="4", SERVE_TOKENS_PER_TICK="2",
+               SERVE_LONG_COUNT="1", SERVE_LONG_LEN="160",
+               SERVE_CHUNK_TOKENS="64", SERVE_PREFILL_BUDGET="64")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--long-prompt", "--jsonl", jsonl],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["ttft_short_p95_ms_chunked"] is not None
+    assert rec["ttft_short_p95_ms_oneshot"] is not None
+    assert rec["prefill_chunks"] == 3
+    assert rec["prefill_chunk_tokens"] == 64
+    assert rec["prefill_tokens_per_tick"] == 64
+    assert rec["long_prompt_len"] == 160
+    ticks = [json.loads(ln) for ln in open(jsonl)
+             if json.loads(ln).get("kind") == "serving_tick"]
+    assert sum(t.get("prefill_chunk_tokens", 0) for t in ticks) == 192
+    # the stall/chunk columns render through the report tables
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "prefill_stall_ms" in r.stdout
+    assert "prefill chunk tokens" in r.stdout

@@ -1,0 +1,407 @@
+"""Chunked-prefill tests (serving/prefill.py): planner math, one-compile
+chunk-step pinning, chunked-vs-one-shot state equivalence, partial-prefill
+slot residency, and engine<->generate() token parity with chunking on.
+
+The parity tests are the contract's backbone: a LONG prompt's request
+must still be bit-identical to a solo ``generate()`` call — both sides
+drive the same jitted chunk step over the same chunk layout, so this is
+exact, even while the engine interleaves the chunks with other slots'
+decode ticks (ISSUE 3 acceptance criteria).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.inference.bucketing import pad_to_bucket
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.models.lm import lm_prefill
+from mamba_distributed_tpu.serving import (
+    GenerationRequest,
+    RequestStatus,
+    ServingEngine,
+    init_pool,
+)
+from mamba_distributed_tpu.serving import state_cache
+from mamba_distributed_tpu.serving.prefill import (
+    TRACE_COUNTS,
+    cast_decode_params,
+    chunk_inputs,
+    chunked_prefill,
+    plan_chunks,
+)
+
+pytestmark = [pytest.mark.serving, pytest.mark.fast]
+
+# chunk = 16 tokens so a 30-50-token prompt already spans 2-4 chunks
+CHUNK = 16
+
+
+def tiny_cfg(layer="mamba2", **kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32", **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def solo(params, cfg, prompt, key, **kw):
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None], key, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ----------------------------------------------------------------- planner
+
+
+def test_chunk_plan_math():
+    assert plan_chunks(16, 16) is None  # fits one chunk -> one-shot path
+    assert plan_chunks(10, 0) is None  # disabled
+    plan = plan_chunks(37, 16)
+    assert (plan.bucket, plan.n_chunks, plan.pad) == (48, 3, 11)
+    plan = plan_chunks(32, 16)  # exact multiple: no pad
+    assert (plan.bucket, plan.n_chunks, plan.pad) == (32, 2, 0)
+
+
+def test_chunk_inputs_layout():
+    """Pad lives entirely in chunk 0 (left, masked); later chunks are all
+    real tokens — together they reassemble pad_to_bucket's layout."""
+    prompt = rand_prompt(37)
+    plan = plan_chunks(37, 16)
+    ids = [chunk_inputs(prompt, plan, i)[0] for i in range(plan.n_chunks)]
+    masks = [chunk_inputs(prompt, plan, i)[1] for i in range(plan.n_chunks)]
+    joined = np.concatenate([np.asarray(x)[0] for x in ids])
+    joined_mask = np.concatenate([np.asarray(m)[0] for m in masks])
+    ref_ids, ref_mask = pad_to_bucket(jnp.asarray(prompt)[None], plan.bucket)
+    np.testing.assert_array_equal(joined, np.asarray(ref_ids)[0])
+    np.testing.assert_array_equal(joined_mask, np.asarray(ref_mask)[0])
+    with pytest.raises(ValueError, match="out of range"):
+        chunk_inputs(prompt, plan, 3)
+
+
+def test_effective_chunk_aligns_to_ssd_boundaries():
+    """mamba2 prefill chunks must land on SSD chunk boundaries: the
+    effective width rounds a misaligned knob up (chunk_size is a
+    sweepable perf knob, so this can't be a hard config error)."""
+    assert tiny_cfg(prefill_chunk_tokens=24).effective_prefill_chunk_tokens == 32
+    assert tiny_cfg(prefill_chunk_tokens=32).effective_prefill_chunk_tokens == 32
+    assert tiny_cfg(prefill_chunk_tokens=0).effective_prefill_chunk_tokens == 0
+    # mamba1 has no SSD chunk constraint: any width passes through
+    cfg1 = tiny_cfg("mamba1", prefill_chunk_tokens=24)
+    assert cfg1.effective_prefill_chunk_tokens == 24
+    with pytest.raises(ValueError, match="must be >= 0"):
+        tiny_cfg(prefill_chunk_tokens=-1)
+
+
+# -------------------------------------------------- state equivalence
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+def test_chunked_vs_oneshot_state_equivalence(layer):
+    """Chunk-split prefill == one lm_prefill over the same padded layout,
+    to fp tolerance: the carries re-associate fp32 sums at chunk
+    boundaries (and XLA may tile the projections differently per
+    sequence shape), but nothing drifts beyond noise.  Exactness of the
+    TOKEN parity comes from both engine and generate() running the same
+    chunked computation, pinned by the parity tests below."""
+    cfg = tiny_cfg(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = rand_prompt(37)
+    plan = plan_chunks(37, CHUNK)
+    padded, mask = pad_to_bucket(jnp.asarray(prompt)[None], plan.bucket)
+    dparams = cast_decode_params(params, cfg=cfg)
+    logits_1, state_1 = lm_prefill(dparams, cfg, padded, token_mask=mask)
+    logits_c, state_c = chunked_prefill(params, cfg, prompt)
+    conv_1, ssm_1 = state_1["blocks"]
+    conv_c, ssm_c = state_c["blocks"]
+    np.testing.assert_allclose(
+        np.asarray(conv_c), np.asarray(conv_1), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(ssm_c), np.asarray(ssm_1), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_c), np.asarray(logits_1), rtol=1e-5, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------- trace pinning
+
+
+def test_chunk_step_traces_once():
+    """The chunk step compiles ONCE per (model config, chunk size): any
+    mix of long prompt lengths reuses it, and generate()'s chunked path
+    adds one decode trace — never a per-length prefill trace."""
+    from mamba_distributed_tpu.inference.generate import (
+        TRACE_COUNTS as GEN_TRACES,
+    )
+
+    # own model shape so the jit cache can't already hold the signature
+    cfg = ModelConfig(d_model=16, n_layer=2, vocab_size=32, ssm_layer="mamba2",
+                      headdim=4, chunk_size=8, d_state=8,
+                      compute_dtype="float32", prefill_chunk_tokens=8)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    key = jax.random.PRNGKey(0)
+    c0, g0, d0 = (TRACE_COUNTS["chunk"], GEN_TRACES["generate"],
+                  GEN_TRACES["decode"])
+    for t in (9, 13, 24, 31):  # 2-4 chunks each
+        generate(params, cfg, jnp.ones((1, t), jnp.int32), key,
+                 max_new_tokens=3, top_k=16)
+    assert TRACE_COUNTS["chunk"] == c0 + 1
+    assert GEN_TRACES["decode"] == d0 + 1
+    assert GEN_TRACES["generate"] == g0  # the one-shot impl never ran
+
+
+def test_engine_chunked_prefill_traces_once():
+    """Engine side of the same pin: long prompts of different lengths
+    share the one chunk-step compile; the tick still traces once."""
+    from mamba_distributed_tpu.serving.engine import (
+        TRACE_COUNTS as ENG_TRACES,
+    )
+
+    cfg = ModelConfig(d_model=16, n_layer=3, vocab_size=32, ssm_layer="mamba2",
+                      headdim=4, chunk_size=8, d_state=8,
+                      compute_dtype="float32", prefill_chunk_tokens=8,
+                      prefill_tokens_per_tick=8)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        max_top_k=20)
+    c0, t0 = TRACE_COUNTS["chunk"], ENG_TRACES["tick"]
+    reqs = [GenerationRequest(prompt_ids=rand_prompt(n, seed=n, vocab=32),
+                              top_k=20, max_new_tokens=3,
+                              key=jax.random.PRNGKey(n))
+            for n in (9, 14, 22, 17)]
+    eng.run(reqs)
+    assert TRACE_COUNTS["chunk"] == c0 + 1
+    assert ENG_TRACES["tick"] == t0 + 1
+
+
+# ----------------------------------------------------------- engine parity
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+def test_engine_chunked_single_request_parity(layer):
+    """A chunked-prefill request's tokens are bit-identical to solo
+    generate() with the same key (which runs the same chunk step)."""
+    cfg = tiny_cfg(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = rand_prompt(53)
+    key = jax.random.PRNGKey(7)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    res = eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=7,
+                                     temperature=0.9, key=key)])[0]
+    assert res.finish_reason == "length"
+    assert res.new_tokens.tolist() == solo(
+        params, cfg, prompt, key, max_new_tokens=7, temperature=0.9
+    )
+    s = eng.metrics.summary()
+    assert s["prefill_chunks"] == plan_chunks(53, CHUNK).n_chunks
+
+
+def test_interleaved_chunked_admit_evict_parity():
+    """The acceptance scenario: a long prompt streams in chunk-by-chunk
+    WHILE other slots decode, finish, and a new request takes a freed
+    slot — every stream still matches its solo generate() run, and the
+    budget forces the prefill to span multiple ticks."""
+    cfg = tiny_cfg()  # budget 16 == one chunk per tick
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    keys = {n: jax.random.PRNGKey(30 + i) for i, n in enumerate("LAB")}
+    prompts = {"L": rand_prompt(53), "A": rand_prompt(5, seed=2),
+               "B": rand_prompt(7, seed=3)}
+    budgets = {"L": 5, "A": 4, "B": 6}
+
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1)
+    ids = {}
+    ids["A"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["A"], max_new_tokens=budgets["A"], key=keys["A"]))
+    eng.step()  # A decoding alone
+    ids["L"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["L"], max_new_tokens=budgets["L"], key=keys["L"]))
+    eng.step()  # L admitted: first chunk in, A still decoding
+    tracked_L = eng._slots[[s for s, t in eng._slots.items()
+                            if t.request_id == ids["L"]][0]]
+    assert tracked_L.status is RequestStatus.PREFILL  # mid-prefill residency
+    assert 0 < tracked_L.chunks_done < tracked_L.plan.n_chunks
+    ids["B"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["B"], max_new_tokens=budgets["B"], key=keys["B"]))
+    # capacity 2: B waits for A's slot while L is still mid-prefill
+    assert eng.scheduler.depth == 1
+    while eng.pending:
+        eng.step()
+    for name in "LAB":
+        got = eng.results[ids[name]].new_tokens.tolist()
+        want = solo(params, cfg, prompts[name], keys[name],
+                    max_new_tokens=budgets[name])
+        assert got == want, f"request {name} diverged: {got} vs {want}"
+
+
+def test_prefill_budget_paces_chunks():
+    """prefill_tokens_per_tick=chunk => exactly one chunk per step, so an
+    n-chunk prompt's prefill spans n steps; 0 (unbounded) does it all
+    before the first tick."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = rand_prompt(53)  # 4 chunks
+    n_chunks = plan_chunks(53, CHUNK).n_chunks
+
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+    eng.submit(GenerationRequest(prompt_ids=prompt, max_new_tokens=3,
+                                 key=jax.random.PRNGKey(0)))
+    per_step = []
+    while eng.pending:
+        before = eng.metrics.prefill_chunks
+        eng.step()
+        per_step.append(eng.metrics.prefill_chunks - before)
+    assert per_step[:n_chunks] == [1] * n_chunks  # one chunk per grant
+
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2,
+                        prefill_tokens_per_tick=0)  # unbounded
+    eng.submit(GenerationRequest(prompt_ids=prompt, max_new_tokens=3,
+                                 key=jax.random.PRNGKey(0)))
+    eng.step()
+    assert eng.metrics.prefill_chunks == n_chunks  # all before the tick
+    s = eng.metrics.summary()
+    assert s["prefill_chunk_tokens"] == n_chunks * CHUNK
+    assert s["prefill_stall_ms"]["count"] >= 1
+
+
+def test_tickless_steps_roll_accounting_into_next_tick_record(tmp_path):
+    """A lone long request produces tick-less prefill-only steps; their
+    chunk tokens and stall must still reach the serving_tick jsonl
+    stream (rolled into the next tick's record), so obs_report totals
+    match ServingMetrics exactly."""
+    import json
+
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    jsonl = tmp_path / "ticks.jsonl"
+    metrics = ServingMetrics(capacity=1, jsonl_path=str(jsonl))
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2,
+                        metrics=metrics)
+    eng.run([GenerationRequest(prompt_ids=rand_prompt(53), max_new_tokens=3,
+                               key=jax.random.PRNGKey(0))])
+    ticks = [json.loads(ln) for ln in open(jsonl)
+             if json.loads(ln)["kind"] == "serving_tick"]
+    plan = plan_chunks(53, CHUNK)
+    assert sum(t["prefill_chunk_tokens"] for t in ticks) == plan.bucket
+    assert sum(t["prefill_stall_ms"] for t in ticks) > 0
+    assert sum(t["prefill_chunk_ms"] for t in ticks) > 0
+
+
+# ------------------------------------------------ partial-prefill residency
+
+
+def test_stash_survives_tick():
+    """A stashed carry must come through a decode tick bit-identical —
+    the tick's lm_step writes are masked for prefilling slots."""
+    from mamba_distributed_tpu.serving import engine as engine_mod
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    dparams = cast_decode_params(params, cfg=cfg)
+    pool = init_pool(cfg, capacity=2)
+    # slot 0: a real decodable request
+    logits, state = lm_prefill(dparams, cfg, jnp.ones((1, 8), jnp.int32))
+    pool = state_cache.insert(pool, 0, state, logits, jax.random.PRNGKey(0),
+                              8, 5, 1.0, -1)
+    # slot 1: a partial carry (chunk 1 of a longer prompt)
+    prompt = rand_prompt(40)
+    plan = plan_chunks(40, CHUNK)
+    from mamba_distributed_tpu.models.lm import init_lm_state
+    from mamba_distributed_tpu.serving.prefill import prefill_chunk
+
+    ids, mask = chunk_inputs(prompt, plan, 0)
+    _, carry = prefill_chunk(dparams, ids, mask, init_lm_state(cfg, 1),
+                             cfg=cfg)
+    pool = state_cache.stash_prefill(pool, 1, carry, jax.random.PRNGKey(1),
+                                     8, 5, 1.0, -1)
+    assert np.asarray(pool["meta"]["prefilling"]).tolist() == [False, True]
+    before = [np.asarray(x) for x in jax.tree.leaves(
+        state_cache.read_state(pool, 1))]
+    pool, tokens, emitted, done = engine_mod._tick(
+        dparams, pool, cfg=cfg, k_max=5, steps=3
+    )
+    # slot 0 decoded, slot 1 emitted nothing and its carry is untouched
+    assert np.asarray(emitted)[:, 0].all()
+    assert not np.asarray(emitted)[:, 1].any()
+    after = [np.asarray(x) for x in jax.tree.leaves(
+        state_cache.read_state(pool, 1))]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    # finish flips the slot decodable
+    pool = state_cache.finish_prefill(pool, 1, carry,
+                                      jnp.zeros((1, cfg.vocab_size_padded)))
+    assert np.asarray(pool["meta"]["prefilling"]).tolist() == [False, False]
+    assert np.asarray(pool["meta"]["active"]).tolist() == [True, True]
+
+
+def test_failed_chunk_requeues_and_frees_slot(monkeypatch):
+    """A chunk step that raises mid-prefill must free the slot, evict the
+    stash, and requeue the request from chunk 0 (same contract as the
+    one-shot prefill failure path)."""
+    from mamba_distributed_tpu.serving import engine as engine_mod
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+    rid = eng.submit(GenerationRequest(prompt_ids=rand_prompt(40),
+                                       max_new_tokens=4,
+                                       key=jax.random.PRNGKey(0)))
+    real = engine_mod.prefill_chunk
+    monkeypatch.setattr(engine_mod, "prefill_chunk",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("boom")))
+    with pytest.raises(RuntimeError, match="boom"):
+        eng.step()
+    assert eng.pending == 1 and eng.scheduler.depth == 1  # not dropped
+    assert eng._free == [0] and eng._prefill_queue == []  # slot reclaimed
+    monkeypatch.setattr(engine_mod, "prefill_chunk", real)
+    while eng.pending:
+        eng.step()
+    assert len(eng.results[rid].new_tokens) == 4  # served after recovery
+
+
+# ------------------------------------------------------------- satellites
+
+
+def test_hybrid_rejection_names_docs():
+    cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
+                      headdim=8, chunk_size=16, d_state=16,
+                      compute_dtype="float32", attn_layer_idx=(1,),
+                      attn_num_heads=4, remat=False)
+    with pytest.raises(ValueError, match="docs/SERVING.md"):
+        init_pool(cfg, capacity=2)
+
+
+def test_chunking_disabled_reproduces_oneshot_streams():
+    """prefill_chunk_tokens=0 must reproduce the pre-chunking pow2 path
+    exactly (the opt-out knob)."""
+    cfg_on = tiny_cfg()
+    cfg_off = dataclasses.replace(cfg_on, prefill_chunk_tokens=0)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg_on)
+    prompt = rand_prompt(53)
+    key = jax.random.PRNGKey(3)
+    on = solo(params, cfg_on, prompt, key, max_new_tokens=6)
+    off = solo(params, cfg_off, prompt, key, max_new_tokens=6)
+    # different prefill layouts (48-bucket chunked vs 64-bucket one-shot)
+    # sample the same stream here because the fp noise between them is
+    # far below sampling resolution; the engine matches whichever layout
+    # its cfg selects
+    assert on == off
+    eng = ServingEngine(params, cfg_off, capacity=1, tokens_per_tick=2)
+    res = eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=6,
+                                     key=key)])[0]
+    assert res.new_tokens.tolist() == off
+    assert eng.metrics.prefill_chunks == 0  # never chunked
