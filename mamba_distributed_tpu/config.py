@@ -143,6 +143,28 @@ class ModelConfig:
     # multiple of ``chunk_size`` for mamba2 (SSD chunk alignment).
     # 0 disables (always one-shot pow2-bucketed prefill).
     prefill_chunk_tokens: int = 256
+    # --- paged attention KV cache (hybrid decode/serving; models/
+    # attention.py, serving/state_cache.py, ops/pallas/attention_kernels
+    # .py ragged decode kernel).  The decode-time KV cache is a pool of
+    # fixed-size pages plus a per-row page table and per-row lengths, so
+    # serving slots at different positions share one cache and KV HBM is
+    # O(pages in use), not O(slots * max_len). ---
+    # Tokens per KV page.  Must be a multiple of 8: padded-width masked
+    # attention is bit-stable across page-count buckets only at 8-lane
+    # granularity (the engine<->generate() exact-parity contract leans
+    # on it), and 8 sublanes is the TPU tile granule anyway.
+    kv_page_tokens: int = 64
+    # Per-request KV budget in the SERVING pool: one slot's page-table
+    # row holds ceil(kv_slot_tokens / kv_page_tokens) entries, so a
+    # hybrid request needs prompt + max_new_tokens <= kv_slot_tokens.
+    kv_slot_tokens: int = 1024
+    # Total pages in the serving pool.  0 => auto: capacity * pages-per-
+    # slot (every slot can run to kv_slot_tokens simultaneously — the
+    # dense-equivalent worst case).  Set lower to oversubscribe HBM when
+    # typical sequences are far shorter than kv_slot_tokens; admission
+    # then waits for pages, never OOMs mid-flight (pages for the whole
+    # request are reserved up front, serving/engine.py).
+    kv_pool_pages: int = 0
     # Serving-engine interleaving budget: max prefill-chunk tokens
     # dispatched between two decode ticks (serving/engine.py).  Bounds
     # the tick-to-tick stall a long prompt can inject (ITL of running
@@ -197,6 +219,22 @@ class ModelConfig:
             raise ValueError(
                 f"prefill_tokens_per_tick must be >= 0 (0 => unbounded), "
                 f"got {self.prefill_tokens_per_tick}"
+            )
+        if self.kv_page_tokens < 8 or self.kv_page_tokens % 8:
+            raise ValueError(
+                f"kv_page_tokens must be a positive multiple of 8 (page-"
+                f"bucketed masked attention is bit-stable only at 8-lane "
+                f"granularity), got {self.kv_page_tokens}"
+            )
+        if self.kv_slot_tokens < self.kv_page_tokens:
+            raise ValueError(
+                f"kv_slot_tokens={self.kv_slot_tokens} must hold at least "
+                f"one page of kv_page_tokens={self.kv_page_tokens}"
+            )
+        if self.kv_pool_pages < 0:
+            raise ValueError(
+                f"kv_pool_pages must be >= 0 (0 => auto-size from "
+                f"capacity), got {self.kv_pool_pages}"
             )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
@@ -254,6 +292,12 @@ class ModelConfig:
         if self.ssm_layer == "mamba2" and c % self.chunk_size:
             return ((c + self.chunk_size - 1) // self.chunk_size) * self.chunk_size
         return c
+
+    @property
+    def kv_pages_per_slot(self) -> int:
+        """Page-table width of one serving slot (ceil of the per-request
+        KV budget in pages)."""
+        return -(-self.kv_slot_tokens // self.kv_page_tokens)
 
     @property
     def nheads(self) -> int:
@@ -532,6 +576,23 @@ PRESETS: dict[str, TrainConfig] = {
     "mamba2-tiny": _mk(
         dict(d_model=128, n_layer=4, ssm_layer="mamba2", headdim=32,
              d_state=64, chunk_size=64, vocab_size=4096),
+        dict(
+            seq_len=256,
+            micro_batch_size=8,
+            total_batch_size=4096,
+            max_steps=300,
+            warmup_steps=20,
+            val_every=25,
+        ),
+    ),
+    # 0c. CPU-runnable hybrid: attention every 2nd layer at tiny scale —
+    # the serving/bench shape for the paged-KV hybrid decode path
+    "hybrid-tiny": _mk(
+        dict(d_model=128, n_layer=4, ssm_layer="mamba2", headdim=32,
+             d_state=64, chunk_size=64, vocab_size=4096,
+             attn_layer_idx=(1, 3), attn_num_heads=4, attn_num_kv_heads=2,
+             prefill_chunk_tokens=128, kv_page_tokens=32,
+             kv_slot_tokens=512),
         dict(
             seq_len=256,
             micro_batch_size=8,

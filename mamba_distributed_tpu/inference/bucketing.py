@@ -17,8 +17,11 @@ the conv cache IS bit-identical).  Anything needing exact token
 streams must compare padded-vs-padded, which is how the serving
 engine's parity contract works: engine and solo ``generate()`` pad the
 same prompt identically.  (Hybrid stacks with attention layers can't
-mask pads this way — real queries would still attend to pad keys — so
-callers skip bucketing when ``cfg.attn_layer_idx`` is non-empty.)
+mask pads through a full-sequence forward — real queries would still
+attend to pad keys — so they skip the pow2 one-shot path and instead
+take the chunk-aligned bucket through the CHUNK step for every prompt
+length: pad keys are simply never written to the paged KV, see
+serving/prefill.py and models/attention.attention_mixer_chunk.)
 
 Shared by ``inference/generate.py`` and the serving prefill path
 (``serving/engine.py``); the trace-count test in tests/test_serving.py

@@ -245,16 +245,23 @@ def generate(
     for long prompts too.
     """
     b, t = prompt_ids.shape
-    if (
-        length_bucketing
-        and not cfg.attn_layer_idx
-        and use_chunked_prefill(t, cfg.effective_prefill_chunk_tokens)
+    hybrid = bool(cfg.attn_layer_idx)
+    chunk = cfg.effective_prefill_chunk_tokens
+    if length_bucketing and (
+        (chunk > 0) if hybrid else use_chunked_prefill(t, chunk)
     ):
         # deferred import: serving imports this module at package-load
-        # time, so the reverse edge must stay out of import time
+        # time, so the reverse edge must stay out of import time.
+        # HYBRID prompts of ANY length go through the chunk step — it is
+        # the one prefill that both masks pad keys (pads never reach the
+        # paged KV) and is the exact computation the serving engine runs,
+        # so hybrid engine<->generate() parity is by construction too.
         from mamba_distributed_tpu.serving.prefill import chunked_prefill
 
-        last_logits, state = chunked_prefill(params, cfg, prompt_ids)
+        last_logits, state = chunked_prefill(
+            params, cfg, prompt_ids,
+            max_len=(t + max_new_tokens) if hybrid else 0,
+        )
         new_tokens = _decode_impl(
             params, cfg, state, last_logits, key, max_new_tokens, top_k,
             temperature, jnp.int32(-1 if eos_id is None else eos_id),

@@ -8,6 +8,11 @@ GQA layout: packed qkv projection, ``num_heads`` query heads sharing
 ``num_kv_heads`` KV heads; rotary embedding on the leading ``rotary_dim``
 of each head.  Under sequence parallelism the score/value contraction runs
 as ring attention over the mesh's ``seq`` axis (parallel/ring_attention.py).
+
+Decode state is a PAGED KV cache with per-row lengths (the ragged/paged
+attention pattern — see the section marker below): rows of one decode
+batch may sit at different sequence positions, which is what admits
+hybrid models into the serving slot pool (serving/state_cache.py).
 """
 
 from __future__ import annotations
@@ -47,29 +52,35 @@ def init_attention_params(key: jax.Array, cfg: ModelConfig) -> dict:
 
 
 def rope_angles(positions: jax.Array, rotary_dim: int, theta: float) -> jax.Array:
-    """(t,) int positions -> (t, rotary_dim/2) angles."""
+    """(t,) or (b, t) int positions -> positions.shape + (rotary_dim/2,)
+    angles.  Per-ROW positions are what lets slots at different sequence
+    positions share one decode batch (the paged-KV serving pool)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim)
     )
-    return positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    return positions.astype(jnp.float32)[..., None] * inv_freq
 
 
 def apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
     """Rotate the leading ``2*angles.shape[-1]`` channels of each head.
 
-    x (b, t, h, hd); angles (t, rot/2).  Rotate-half (GPT-NeoX,
-    non-interleaved) convention on the rotary slice — pairs are
-    (x[i], x[i + rot/2]) — matching the flash-attn RotaryEmbedding
-    default (``interleaved=False``) that mamba_ssm's MHA layers use, so
-    hybrid checkpoints import with bit-compatible attention semantics.
-    The tail past the rotary slice passes through.
+    x (b, t, h, hd); angles (t, rot/2) shared across the batch, or
+    (b, t, rot/2) per-row (paged decode: every row sits at its own
+    position).  Rotate-half (GPT-NeoX, non-interleaved) convention on
+    the rotary slice — pairs are (x[i], x[i + rot/2]) — matching the
+    flash-attn RotaryEmbedding default (``interleaved=False``) that
+    mamba_ssm's MHA layers use, so hybrid checkpoints import with
+    bit-compatible attention semantics.  The tail past the rotary slice
+    passes through.
     """
     rot = 2 * angles.shape[-1]
     xr, x_pass = x[..., :rot], x[..., rot:]
     xf = xr.astype(jnp.float32)
     x1, x2 = xf[..., : rot // 2], xf[..., rot // 2 :]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    if angles.ndim == 2:
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     o1 = x1 * cos - x2 * sin
     o2 = x1 * sin + x2 * cos
     out = jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
@@ -116,9 +127,9 @@ def attention_mixer(
 ):
     """Full-sequence causal attention.  u (b, t, d) -> (b, t, d).
 
-    The decode "state" is the (k_cache, v_cache, length) triple; for the
-    full-sequence path with ``return_final_state`` the caches hold the whole
-    sequence (used by prefill).
+    With ``return_final_state`` the raw (k, v) of the whole sequence are
+    returned alongside; the caller (models/lm.lm_prefill) packs them into
+    the paged decode cache (``pack_attention_pages``).
     """
     nh, nkv, hd, rot = _attn_dims(cfg)
     b, t, _ = u.shape
@@ -160,48 +171,235 @@ def attention_mixer(
         )
 
         # O(t*block) memory — never materializes the (t, t) score tensor
-        # (config 5 at T=8192); the tiny-t decode path keeps _sdpa_causal
+        # (config 5 at T=8192); the tiny-t paged decode path keeps the
+        # explicit-mask _sdpa_positions
         out = blockwise_sdpa_causal(q, k, v)
     # remat_policy="mixer" save point (models/lm.py:_remat)
     out = checkpoint_name(out, "mixer_out")
     y = linear(params["out_proj"], out.reshape(b, t, nh * hd), compute_dtype)
     if return_final_state:
-        return y, (k, v, jnp.array(t, jnp.int32))
+        return y, (k, v)
     return y
+
+
+# ---------------------------------------------------------------------------
+# Paged decode-time KV cache ("Ragged Paged Attention", PAPERS.md)
+#
+# The decode cache is a pool of fixed-size pages plus per-ROW metadata:
+#
+#   k_pages / v_pages  (P, page, nkv, hd)   physical pages; page 0 is a
+#                                           reserved trash page that
+#                                           masked-out rows write into
+#   page_table         (b, W) int32         row r's logical page j lives
+#                                           in physical page table[r, j]
+#   lengths            (b,) int32           tokens cached per row
+#
+# Rows at DIFFERENT sequence positions share one batch (per-row RoPE
+# angles, per-row causal masks, per-row scatter writes), which is what
+# lets hybrid models into the serving slot pool (serving/state_cache.py);
+# KV HBM is O(pages in use) because pages are handed out by a host-side
+# allocator on admission and recycled on evict.  ``generate()`` uses the
+# same structure with an identity table — the SAME decode step serves
+# both, which is what keeps engine<->generate() token parity exact.
+#
+# Bit-stability note: masked attention over a zero-padded key axis is
+# bit-identical across padded widths at 8-lane granularity (verified on
+# CPU XLA; cfg enforces kv_page_tokens % 8 == 0), so the engine's
+# page-count bucket may differ from generate()'s without perturbing
+# token streams.
+# ---------------------------------------------------------------------------
+
+
+def attention_page_count(cfg: ModelConfig, max_len: int) -> int:
+    """Pages needed per row for ``max_len`` tokens (at least one)."""
+    return max(1, -(-max_len // cfg.kv_page_tokens))
 
 
 def init_attention_state(cfg: ModelConfig, batch: int, max_len: int,
                          dtype=None):
-    """KV caches in the compute dtype — matching what attention_mixer's
-    prefill path produces, so init- and prefill-built states share avals."""
+    """Empty paged KV cache for one attention layer: (k_pages, v_pages)
+    of shape (1 + batch*W, page, nkv, hd) — page 0 is the trash page —
+    in the compute dtype, matching what the prefill path produces.
+    The shared (page_table, lengths) metadata is built once per model by
+    ``attention_page_meta`` (models/lm.init_lm_state)."""
     nh, nkv, hd, _ = _attn_dims(cfg)
     if dtype is None:
         dtype = jnp.dtype(cfg.compute_dtype)
-    k = jnp.zeros((batch, max_len, nkv, hd), dtype)
-    v = jnp.zeros((batch, max_len, nkv, hd), dtype)
-    return k, v, jnp.array(0, jnp.int32)
+    W = attention_page_count(cfg, max_len)
+    shape = (1 + batch * W, cfg.kv_page_tokens, nkv, hd)
+    # two INDEPENDENT allocations: returning one aliased array twice
+    # would blow up any donating jit downstream ("donate the same
+    # buffer twice") if a caller ever skips the re-stacking copy
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array, state):
-    """Single-token decode with a fixed-capacity KV cache.
+def attention_page_meta(cfg: ModelConfig, batch: int, max_len: int):
+    """Identity page table + zero lengths for a private (non-pooled)
+    paged cache: row r owns physical pages [1 + r*W, 1 + (r+1)*W)."""
+    W = attention_page_count(cfg, max_len)
+    tbl = 1 + jnp.arange(batch * W, dtype=jnp.int32).reshape(batch, W)
+    return tbl, jnp.zeros((batch,), jnp.int32)
 
-    u_t (b, d); state = (k_cache (b, L, nkv, hd), v_cache, length).
+
+def pack_attention_pages(cfg: ModelConfig, k: jax.Array, v: jax.Array,
+                         max_len: int):
+    """(b, t, nkv, hd) full-sequence K/V -> identity-paged (k_pages,
+    v_pages) with capacity ``max_len`` (lm_prefill's state packing)."""
+    b, t, nkv, hd = k.shape
+    pg = cfg.kv_page_tokens
+    W = attention_page_count(cfg, max_len)
+
+    def pack(x):
+        x = jnp.pad(x, ((0, 0), (0, W * pg - t), (0, 0), (0, 0)))
+        x = x.reshape(b * W, pg, nkv, hd)
+        return jnp.concatenate([jnp.zeros_like(x[:1]), x], axis=0)
+
+    return pack(k), pack(v)
+
+
+def gather_kv_pages(k_pages: jax.Array, v_pages: jax.Array,
+                    page_table: jax.Array):
+    """Reassemble each row's logical KV view: (P, pg, nkv, hd) pages +
+    (b, W) table -> (b, W*pg, nkv, hd).  The lax fallback path — the
+    Pallas ragged kernel (ops/pallas/attention_kernels.py) walks the
+    table in-kernel instead of materializing this."""
+    b, W = page_table.shape
+    _, pg, nkv, hd = k_pages.shape
+    k = k_pages[page_table].reshape(b, W * pg, nkv, hd)
+    v = v_pages[page_table].reshape(b, W * pg, nkv, hd)
+    return k, v
+
+
+def _sdpa_positions(q, k, v, qpos):
+    """Masked SDPA with per-row absolute query positions.
+
+    q (b, tq, nh, hd); k/v (b, L, nkv, hd) — the gathered logical cache
+    view; qpos (b, tq) int32 — query i of row r may attend cache
+    position j iff ``j <= qpos[r, i]`` (the cache holds positions
+    [0, lengths) plus this call's freshly written tokens, so the bound
+    is exactly the causal rule).
+    """
+    b, tq, nh, hd = q.shape
+    nkv = k.shape[2]
+    rep = nh // nkv
+    qh = q.reshape(b, tq, nkv, rep, hd)
+    scores = jnp.einsum(
+        "bqgrh,bkgh->bgrqk", qh, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)
+    kpos = jnp.arange(k.shape[1])
+    mask = qpos[:, None, None, :, None] >= kpos[None, None, None, None, :]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrqk,bkgh->bqgrh", w, v, preferred_element_type=jnp.float32)
+    return out.reshape(b, tq, nh, hd).astype(q.dtype)
+
+
+def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array,
+                         kv, page_table: jax.Array, lengths: jax.Array,
+                         write_mask: jax.Array | None = None):
+    """Single-token decode against the paged KV cache.
+
+    u_t (b, d); kv = (k_pages, v_pages); page_table (b, W); lengths (b,)
+    — the row's token count BEFORE this step (the new token lands at
+    cache position ``lengths[r]``).  ``write_mask`` (b,) bool routes
+    masked rows' KV writes to the trash page and is how the serving tick
+    protects recycled pages from dead slots; the shared ``lengths``
+    update happens once per model step in models/lm.py.
+
+    Returns (y (b, d), (k_pages, v_pages)).
     """
     nh, nkv, hd, rot = _attn_dims(cfg)
     b, _ = u_t.shape
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    k_cache, v_cache, length = state
+    k_pages, v_pages = kv
+    pg = cfg.kv_page_tokens
+    W = page_table.shape[1]
 
     qkv = linear(params["wqkv"], u_t[:, None, :], compute_dtype)
     q, k, v = _split_qkv(qkv, cfg)
     if rot > 0:
-        angles = rope_angles(length[None], rot, cfg.rope_theta)
+        angles = rope_angles(lengths[:, None], rot, cfg.rope_theta)
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
 
-    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(k_cache.dtype), length, axis=1)
-    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(v_cache.dtype), length, axis=1)
-    # mask out cache slots beyond the current length via the causal offset
-    out = _sdpa_causal(q, k_cache, v_cache, offset=length)
+    mask = (
+        jnp.ones((b,), bool) if write_mask is None else write_mask
+    )
+    pidx = jnp.clip(lengths // pg, 0, W - 1)
+    phys = jnp.where(
+        mask, jnp.take_along_axis(page_table, pidx[:, None], axis=1)[:, 0], 0
+    )
+    off = jnp.where(mask, lengths % pg, 0)
+    k_pages = k_pages.at[phys, off].set(k[:, 0].astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, off].set(v[:, 0].astype(v_pages.dtype))
+
+    from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
+
+    qpos = jnp.minimum(lengths, W * pg - 1)
+    if resolve_attn_impl(cfg.attn_impl) == "pallas":
+        from mamba_distributed_tpu.ops.pallas.attention_kernels import (
+            ragged_paged_decode_attention,
+        )
+
+        # kv_len = tokens readable AFTER the write; the kernel skips
+        # whole pages past it, so decode cost tracks live tokens
+        out = ragged_paged_decode_attention(
+            q[:, 0], k_pages, v_pages, page_table,
+            jnp.minimum(qpos + 1, W * pg),
+        )[:, None]
+    else:
+        kk, vv = gather_kv_pages(k_pages, v_pages, page_table)
+        out = _sdpa_positions(q, kk, vv, qpos[:, None])
     y = linear(params["out_proj"], out.reshape(b, nh * hd), compute_dtype)
-    return y, (k_cache, v_cache, length + 1)
+    return y, (k_pages, v_pages)
+
+
+def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
+                          kv, page_table: jax.Array, lengths: jax.Array,
+                          token_mask: jax.Array | None = None):
+    """One prefill CHUNK against the paged cache: write the chunk's real
+    tokens' K/V into this row's pages at positions [lengths, lengths +
+    n_real), then attend every chunk query over the page view (prefix +
+    the freshly written chunk — intra-chunk causality falls out of the
+    per-position bound).
+
+    u (b, c, d); token_mask (b, c) {0,1} marks real tokens — the pad is
+    a LEFT prefix (serving/prefill.chunk_inputs), so real token j of the
+    chunk sits at absolute position ``lengths[r] + j`` regardless of the
+    pad, and pad queries (clamped to position 0) produce garbage that
+    dies with their discarded stream positions.  The shared ``lengths``
+    advance (+ n_real) happens once per model chunk in models/lm.py.
+
+    Returns (y (b, c, d), (k_pages, v_pages)).
+    """
+    nh, nkv, hd, rot = _attn_dims(cfg)
+    b, c, _ = u.shape
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    k_pages, v_pages = kv
+    pg = cfg.kv_page_tokens
+    W = page_table.shape[1]
+
+    qkv = linear(params["wqkv"], u, compute_dtype)
+    q, k, v = _split_qkv(qkv, cfg)
+    if token_mask is None:
+        real = jnp.ones((b, c), bool)
+    else:
+        real = token_mask > 0.5
+    pad = c - jnp.sum(real.astype(jnp.int32), axis=1)          # (b,)
+    pos = lengths[:, None] + jnp.arange(c)[None, :] - pad[:, None]
+    posc = jnp.maximum(pos, 0)                                  # (b, c)
+    if rot > 0:
+        angles = rope_angles(posc, rot, cfg.rope_theta)
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+
+    pidx = jnp.clip(posc // pg, 0, W - 1)
+    phys = jnp.where(real, jnp.take_along_axis(page_table, pidx, axis=1), 0)
+    off = jnp.where(real, posc % pg, 0)
+    k_pages = k_pages.at[phys, off].set(k.astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, off].set(v.astype(v_pages.dtype))
+
+    kk, vv = gather_kv_pages(k_pages, v_pages, page_table)
+    out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
+    y = linear(params["out_proj"], out.reshape(b, c, nh * hd), compute_dtype)
+    return y, (k_pages, v_pages)

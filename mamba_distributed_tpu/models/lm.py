@@ -27,9 +27,12 @@ import jax.numpy as jnp
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.attention import (
     attention_mixer,
+    attention_mixer_chunk,
     attention_mixer_step,
+    attention_page_meta,
     init_attention_params,
     init_attention_state,
+    pack_attention_pages,
 )
 from mamba_distributed_tpu.models.common import init_linear, linear
 from mamba_distributed_tpu.models.mamba1 import (
@@ -177,11 +180,13 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
     """One prenorm block: fused add+norm -> mixer [-> add+norm -> MLP/MoE].
 
     ``return_state=True`` (prefill) additionally returns the mixer's decode
-    state (conv+SSM caches, or attention KV caches).  ``token_mask``
-    (prefill only) zeroes the mixer's scan inputs at left-pad positions
-    (inference/bucketing.py).  ``initial_state`` (chunked prefill,
-    SSM-only) is a ``(conv_state, ssm_state)`` carry from the previous
-    chunk, resuming the mixer's scan mid-prompt (lm_prefill_chunk).
+    state (conv+SSM caches, or attention K/V).  ``token_mask`` (prefill
+    only) zeroes the mixer's scan inputs at left-pad positions
+    (inference/bucketing.py).  ``initial_state`` (chunked prefill) is the
+    ``(conv_state, ssm_state)`` carry from the previous chunk for SSM
+    mixers, or ``((k_pages, v_pages), page_table, lengths)`` for
+    attention mixers — the paged KV cache the chunk writes into
+    (lm_prefill_chunk).
     With a MoE model (``cfg.moe_num_experts > 0``) the non-state form
     returns ``(hidden, residual, aux)`` — the layer's load-balance loss
     term.
@@ -202,18 +207,24 @@ def _block_fwd(block_params, cfg, hidden, residual, attn: bool, seq_ctx=None,
         )
     state = None
     if attn:
-        if token_mask is not None:
-            raise ValueError(
-                "token_mask prefill is SSM-only: attention layers would "
-                "still attend to the pad keys (skip bucketing for hybrids)"
-            )
         if initial_state is not None:
-            raise ValueError(
-                "initial_state carry is SSM-only: attention layers resume "
-                "via their KV cache, not a scan carry (chunked prefill is "
-                "pure-SSM, serving/prefill.py)"
+            # chunked prefill: resume against the paged KV cache —
+            # initial_state = ((k_pages, v_pages), page_table, lengths);
+            # the mask'd pad prefix is handled inside (pad keys are never
+            # written to pages, so nothing can attend them)
+            kv, page_table, lengths = initial_state
+            hidden, state = attention_mixer_chunk(
+                block_params["mixer"], cfg, normed, kv, page_table,
+                lengths, token_mask=token_mask,
             )
-        if return_state:
+        elif token_mask is not None:
+            raise ValueError(
+                "token_mask one-shot prefill is SSM-only: full-sequence "
+                "attention would attend the pad keys; hybrid bucketed "
+                "prompts go through the chunk step instead "
+                "(serving/prefill.py)"
+            )
+        elif return_state:
             hidden, state = attention_mixer(
                 block_params["mixer"], cfg, normed, return_final_state=True
             )
@@ -655,15 +666,18 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     hidden = params["embedding"][input_ids].astype(compute_dtype)
     residual = None
 
-    def pad_attn(state):
-        k, v, length = state
-        pad = [(0, 0), (0, max_len - k.shape[1]), (0, 0), (0, 0)]
-        return jnp.pad(k, pad), jnp.pad(v, pad), length
+    def to_pages(state):
+        # raw full-sequence (k, v) -> identity-paged decode cache with
+        # ``max_len`` capacity (the shared page_table/lengths meta is
+        # attached once, below)
+        k, v = state
+        return pack_attention_pages(cfg, k, v, max_len)
 
     if cfg.attn_layer_idx and token_mask is not None:
         raise ValueError(
-            "token_mask prefill is SSM-only (attention layers would attend "
-            "to pad keys); call with the exact prompt length instead"
+            "token_mask prefill is SSM-only (full-sequence attention would "
+            "attend the pad keys); hybrid bucketed prompts go through the "
+            "chunk step (serving/prefill.py) instead"
         )
 
     if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
@@ -693,7 +707,7 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
             m_st = jax.tree.map(
                 lambda a, b: jnp.concatenate([a, b], axis=0), st_pre, st_post
             )
-            return carry, (m_st, pad_attn(a_st))
+            return carry, (m_st, to_pages(a_st))
 
         (hidden, residual), (m_states, a_states) = jax.lax.scan(
             group, (hidden, residual), (mstack, params["attn_blocks"])
@@ -704,6 +718,10 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
                 lambda x: x.reshape((-1,) + x.shape[2:]), m_states
             ),
             "attn_blocks": a_states,
+            "attn_meta": (
+                attention_page_meta(cfg, b, max_len)[0],
+                jnp.full((b,), t, jnp.int32),
+            ),
         }
     elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
@@ -717,13 +735,20 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
                 bp, cfg, hidden, residual, attn, return_state=True
             )
             if attn:
-                a_states.append(pad_attn(st))
+                a_states.append(to_pages(st))
                 ai += 1
             else:
                 m_states.append(st)
                 mi += 1
         stack = lambda sts: jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
-        state = {"blocks": stack(m_states), "attn_blocks": stack(a_states)}
+        state = {
+            "blocks": stack(m_states),
+            "attn_blocks": stack(a_states),
+            "attn_meta": (
+                attention_page_meta(cfg, b, max_len)[0],
+                jnp.full((b,), t, jnp.int32),
+            ),
+        }
     else:
         residual = jnp.zeros_like(
             hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
@@ -769,14 +794,16 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     comes from both sides running THIS function over identical chunks,
     not from chunked == one-shot.
 
+    Hybrid stacks resume attention layers against the PAGED KV cache in
+    ``state["attn_blocks"]``/``state["attn_meta"]`` — each chunk writes
+    its real tokens' K/V into the row's pages at [lengths, lengths +
+    n_real) and attends over the page view (models/attention.
+    attention_mixer_chunk), so a hybrid prompt's pages fill as chunks
+    land and the serving engine can interleave them with decode ticks.
+
     Returns (last_logits (b, V) fp32, new state) — same contract as
     ``lm_prefill``.
     """
-    if cfg.attn_layer_idx:
-        raise ValueError(
-            "chunked prefill is pure-SSM only: attention layers have no "
-            "scan carry to resume (serving/prefill.py)"
-        )
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = params["embedding"][input_ids].astype(compute_dtype)
     residual = jnp.zeros_like(
@@ -792,6 +819,98 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
         )
         return (hidden, residual), new_st
 
+    if cfg.attn_layer_idx:
+        tbl, lengths = state["attn_meta"]
+        b, c = input_ids.shape
+        if token_mask is None:
+            n_real = jnp.full((b,), c, jnp.int32)
+        else:
+            n_real = jnp.sum(
+                (token_mask > 0.5).astype(jnp.int32), axis=1
+            )
+
+        def abody(ablk, h, rs, akv):
+            return _block_fwd(
+                ablk, cfg, h, rs, True, return_state=True,
+                token_mask=token_mask,
+                initial_state=(akv, tbl, lengths),
+            )
+
+        if (per := _hybrid_period(cfg)) is not None:
+            p, r = per
+            n_attn = len(cfg.attn_layer_idx)
+            mstack = _group_mamba_stack(params, cfg, p)
+            mstate = jax.tree.map(
+                lambda s: s.reshape((n_attn, p - 1) + s.shape[1:]),
+                state["blocks"],
+            )
+
+            def group(carry, xs):
+                mblk, ablk, mst, akv = xs
+                pre = lambda x: jax.tree.map(lambda v: v[:r], x)
+                post = lambda x: jax.tree.map(lambda v: v[r:], x)
+                carry, new_pre = jax.lax.scan(
+                    body, carry, (pre(mblk), pre(mst))
+                )
+                hidden, residual, new_kv = abody(ablk, *carry, akv)
+                carry, new_post = jax.lax.scan(
+                    body, (hidden, residual), (post(mblk), post(mst))
+                )
+                new_m = jax.tree.map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0),
+                    new_pre, new_post,
+                )
+                return carry, (new_m, new_kv)
+
+            (hidden, residual), (new_m, new_a) = jax.lax.scan(
+                group, (hidden, residual),
+                (mstack, params["attn_blocks"], mstate,
+                 state["attn_blocks"]),
+            )
+            new_blocks = jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), new_m
+            )
+        else:
+            attn_idx = set(cfg.attn_layer_idx)
+            mi = ai = 0
+            new_ms, new_as = [], []
+            for i in range(cfg.n_layer):
+                attn = i in attn_idx
+                if attn:
+                    bp = jax.tree.map(
+                        lambda p_, j=ai: p_[j], params["attn_blocks"]
+                    )
+                    akv = jax.tree.map(
+                        lambda s, j=ai: s[j], state["attn_blocks"]
+                    )
+                    hidden, residual, st = abody(bp, hidden, residual, akv)
+                    new_as.append(st)
+                    ai += 1
+                else:
+                    bp = jax.tree.map(
+                        lambda p_, j=mi: p_[j], params["blocks"]
+                    )
+                    st = jax.tree.map(
+                        lambda s, j=mi: s[j], state["blocks"]
+                    )
+                    hidden, residual, st = _block_fwd(
+                        bp, cfg, hidden, residual, False,
+                        return_state=True, token_mask=token_mask,
+                        initial_state=st,
+                    )
+                    new_ms.append(st)
+                    mi += 1
+            stack = lambda sts: jax.tree.map(
+                lambda *xs: jnp.stack(xs), *sts
+            )
+            new_blocks, new_a = stack(new_ms), stack(new_as)
+        logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
+        return logits[:, 0].astype(jnp.float32), {
+            "blocks": new_blocks,
+            "attn_blocks": new_a,
+            "attn_meta": (tbl, lengths + n_real),
+        }
+
     (hidden, residual), state_blocks = jax.lax.scan(
         body, (hidden, residual), (params["blocks"], state["blocks"])
     )
@@ -799,36 +918,56 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     return logits[:, 0].astype(jnp.float32), {"blocks": state_blocks}
 
 
-def init_lm_state(cfg: ModelConfig, batch: int, max_len: int = 0):
-    """Per-layer decode states, layer-stacked to mirror the param layout."""
+def init_lm_blocks_state(cfg: ModelConfig, batch: int):
+    """Layer-stacked conv+SSM decode states for the MAMBA layers only —
+    what the serving slot pool's per-slot writes cover (the paged
+    attention KV lives in the shared page pool, not per-slot rows)."""
     init_mix = init_mamba2_state if cfg.ssm_layer == "mamba2" else init_mamba1_state
+    n = cfg.n_layer - len(cfg.attn_layer_idx)
+    cs, ss = init_mix(cfg, batch)
+    return (
+        jnp.tile(cs[None], (n,) + (1,) * cs.ndim),
+        jnp.tile(ss[None], (n,) + (1,) * ss.ndim),
+    )
+
+
+def init_lm_state(cfg: ModelConfig, batch: int, max_len: int = 0):
+    """Per-layer decode states, layer-stacked to mirror the param layout.
+
+    Hybrid stacks additionally carry the paged attention KV cache:
+    per-layer page pools under ``"attn_blocks"`` plus the layer-shared
+    ``"attn_meta" = (page_table (b, W), lengths (b,))`` (every attention
+    layer caches the same positions, so one table serves them all).
+    ``max_len`` sizes the per-row page budget."""
     if cfg.attn_layer_idx:
         n_attn = len(cfg.attn_layer_idx)
-        n_mamba = cfg.n_layer - n_attn
-        mamba_states = [init_mix(cfg, batch) for _ in range(n_mamba)]
         attn_states = [
             init_attention_state(cfg, batch, max_len) for _ in range(n_attn)
         ]
         stack = lambda states: jax.tree.map(lambda *xs: jnp.stack(xs), *states)
-        return {"blocks": stack(mamba_states), "attn_blocks": stack(attn_states)}
-    cs, ss = init_mix(cfg, batch)
-    n = cfg.n_layer
-    return {
-        "blocks": (
-            jnp.tile(cs[None], (n,) + (1,) * cs.ndim),
-            jnp.tile(ss[None], (n,) + (1,) * ss.ndim),
-        )
-    }
+        return {
+            "blocks": init_lm_blocks_state(cfg, batch),
+            "attn_blocks": stack(attn_states),
+            "attn_meta": attention_page_meta(cfg, batch, max_len),
+        }
+    return {"blocks": init_lm_blocks_state(cfg, batch)}
 
 
-def _block_step(bp, cfg: ModelConfig, hidden, residual, st, attn: bool):
-    """One decode-step block (shared by the scan and unrolled paths)."""
+def _block_step(bp, cfg: ModelConfig, hidden, residual, st, attn: bool,
+                attn_ctx=None):
+    """One decode-step block (shared by the scan and unrolled paths).
+    ``attn_ctx = (page_table, lengths, write_mask)`` is the layer-shared
+    paged-KV metadata (attention layers only)."""
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     normed, residual = add_rms_norm(
         hidden, residual, bp["norm"]["weight"], cfg.norm_eps,
     )
     if attn:
-        hidden, st = attention_mixer_step(bp["mixer"], cfg, normed, st)
+        page_table, lengths, write_mask = attn_ctx
+        hidden, st = attention_mixer_step(
+            bp["mixer"], cfg, normed, st, page_table, lengths,
+            write_mask=write_mask,
+        )
     else:
         mix_step = (
             mamba2_mixer_step if cfg.ssm_layer == "mamba2" else mamba1_mixer_step
@@ -848,8 +987,17 @@ def _block_step(bp, cfg: ModelConfig, hidden, residual, st, attn: bool):
     return hidden, residual, st
 
 
-def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
-    """One decode step.  token (b,) int32 -> (logits (b, V), new state)."""
+def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array,
+            write_mask: jax.Array | None = None):
+    """One decode step.  token (b,) int32 -> (logits (b, V), new state).
+
+    ``write_mask`` (b,) bool (hybrid stacks only) marks rows whose paged
+    attention KV may be written this step; masked rows' writes land in
+    the trash page and their ``lengths`` freeze — how the serving tick
+    keeps dead/empty/prefilling slots from touching live pages while
+    still computing the whole batch in one trace.  ``None`` (generate's
+    decode loop) writes every row.
+    """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = params["embedding"][token].astype(compute_dtype)
     residual = None
@@ -859,6 +1007,15 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
         bp, st = xs
         h, rs, st = _block_step(bp, cfg, h, rs, st, False)
         return (h, rs), st
+
+    if cfg.attn_layer_idx:
+        tbl, lengths = state["attn_meta"]
+        attn_ctx = (tbl, lengths, write_mask)
+        adv = (
+            jnp.ones_like(lengths) if write_mask is None
+            else write_mask.astype(lengths.dtype)
+        )
+        new_meta = (tbl, lengths + adv)
 
     if cfg.attn_layer_idx and (per := _hybrid_period(cfg)) is not None:
         p, r = per
@@ -874,7 +1031,9 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
             pre = lambda x: jax.tree.map(lambda v: v[:r], x)
             post = lambda x: jax.tree.map(lambda v: v[r:], x)
             carry, new_pre = jax.lax.scan(mbody, carry, (pre(mblk), pre(mst)))
-            hidden, residual, ast = _block_step(ablk, cfg, *carry, ast, True)
+            hidden, residual, ast = _block_step(
+                ablk, cfg, *carry, ast, True, attn_ctx=attn_ctx
+            )
             carry, new_post = jax.lax.scan(
                 mbody, (hidden, residual), (post(mblk), post(mst))
             )
@@ -892,6 +1051,7 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
                 lambda x: x.reshape((-1,) + x.shape[2:]), new_m
             ),
             "attn_blocks": new_a,
+            "attn_meta": new_meta,
         }
     elif cfg.attn_layer_idx:
         attn_idx = set(cfg.attn_layer_idx)
@@ -905,7 +1065,10 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
             else:
                 bp = jax.tree.map(lambda p, j=mi: p[j], params["blocks"])
                 st = jax.tree.map(lambda s, j=mi: s[j], state["blocks"])
-            hidden, residual, st = _block_step(bp, cfg, hidden, residual, st, attn)
+            hidden, residual, st = _block_step(
+                bp, cfg, hidden, residual, st, attn,
+                attn_ctx=attn_ctx if attn else None,
+            )
             if attn:
                 new_a.append(st)
                 ai += 1
@@ -913,7 +1076,11 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array):
                 new_m.append(st)
                 mi += 1
         stack = lambda states: jax.tree.map(lambda *xs: jnp.stack(xs), *states)
-        new_state = {"blocks": stack(new_m), "attn_blocks": stack(new_a)}
+        new_state = {
+            "blocks": stack(new_m),
+            "attn_blocks": stack(new_a),
+            "attn_meta": new_meta,
+        }
     else:
         residual = jnp.zeros_like(hidden, dtype=jnp.float32)
         (hidden, residual), new_blocks = jax.lax.scan(

@@ -6,10 +6,26 @@ matrix (O(b*t*h*l) bytes) per layer, and the selective-scan path remats
 around a transient (b, l, d, n) tensor.  These kernels keep those
 intermediates in VMEM instead — the SSD decay matrix is rebuilt per tile,
 the selective-scan state lives in registers for the whole sequence — which
-is where the MFU headroom lives (SURVEY.md §7 stage 5).
+is where the MFU headroom lives (SURVEY.md §7 stage 5).  Decode-side,
+``ragged_paged_decode_attention`` walks the serving pool's paged KV per
+slot (models/attention.py).
+
+Every submodule takes ``CompilerParams`` from ``ops.pallas.common`` — a
+compat alias over jax's TPUCompilerParams/CompilerParams rename — so
+importing ANY kernel module works on either jax API, in any import order
+(a partially imported package can no longer shadow the rest).
 """
 
+from mamba_distributed_tpu.ops.pallas.attention_kernels import (
+    flash_sdpa_causal,
+    ragged_paged_decode_attention,
+)
 from mamba_distributed_tpu.ops.pallas.scan_kernels import selective_scan_pallas
 from mamba_distributed_tpu.ops.pallas.ssd_kernels import ssd_chunked_pallas
 
-__all__ = ["selective_scan_pallas", "ssd_chunked_pallas"]
+__all__ = [
+    "flash_sdpa_causal",
+    "ragged_paged_decode_attention",
+    "selective_scan_pallas",
+    "ssd_chunked_pallas",
+]

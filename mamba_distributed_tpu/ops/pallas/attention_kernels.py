@@ -40,7 +40,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from mamba_distributed_tpu.ops.pallas.common import resolve_interpret
+from mamba_distributed_tpu.ops.pallas.common import (
+    CompilerParams,
+    resolve_interpret,
+)
 
 _NEG_INF = float("-inf")
 
@@ -91,8 +94,10 @@ def _fa_fwd_kernel(
         m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)   # (qb, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         # rows with every key masked so far keep m = -inf; guard both exps
-        scale = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
-        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_new), 0.0)   # (qb, kb)
+        # (values are finite or -inf, never NaN/+inf, so `> -inf` stands in
+        # for isfinite — which this jax's Mosaic lowering lacks)
+        scale = jnp.where(m_prev > _NEG_INF, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(s > _NEG_INF, jnp.exp(s - m_new), 0.0)      # (qb, kb)
 
         acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
@@ -249,7 +254,7 @@ def _fa_fwd_impl(qt, kt, vt, offset, tk_valid, qb, kb, interpret):
             pltpu.VMEM((qb, 128), jnp.float32),
             pltpu.VMEM((qb, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -273,7 +278,7 @@ def _fa_bwd_dq_call(qt, kt, vt, do, lse, dlt, offset, tk_valid, qb, kb,
         (1, 1, kb, hd), lambda bi, hi, qi, kj: (bi, hi // rep, kj, 0)
     )
     lse_spec = pl.BlockSpec((1, 1, qb, 8), lambda bi, hi, qi, kj: (bi, hi, qi, 0))
-    seq_kv = pltpu.CompilerParams(
+    seq_kv = CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
     )
 
@@ -300,7 +305,7 @@ def _fa_bwd_dkv_call(qt, kt, vt, do, lse, dlt, offset, tk_valid, qb, kb,
     rep = nh // nkv
     nq, nk = tq // qb, tk // kb
     sm_scale = 1.0 / math.sqrt(hd)
-    seq_kv = pltpu.CompilerParams(
+    seq_kv = CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
     )
 
@@ -494,3 +499,154 @@ def flash_sdpa_causal(
     if pad_q:
         o = o[:, :, :tq]
     return jnp.moveaxis(o, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged decode attention ("Ragged Paged Attention: A High-Performance
+# and Flexible LLM Inference Kernel for TPU", PAPERS.md).
+#
+# Serving decode over the paged KV pool (models/attention.py): each row of
+# the slot batch sits at its OWN position, its KV scattered across pool
+# pages named by its page-table row.  The kernel walks each row's page
+# list with the table scalar-prefetched (the BlockSpec index map picks the
+# physical page per grid step, so no (S, W*page) gather ever exists) and
+# skips every page at or past the row's kv_len via ``pl.when`` — decode
+# FLOPs track live tokens, not pool capacity.  Grid (slots, kv-heads,
+# pages), pages sequential; the online-softmax accumulator lives in VMEM
+# scratch exactly like the flash forward above.
+# ---------------------------------------------------------------------------
+
+# python-side-effect trace counter (one bump per jit trace): the whole
+# point of the fixed (S, W) layout is that occupancy/length changes never
+# retrace — tests/test_paged_attention.py pins it.
+TRACE_COUNTS = {"ragged_decode": 0}
+
+
+def _rpa_kernel(
+    tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, den_scr, acc_scr,
+    *, nw: int, pg: int, sm_scale: float,
+):
+    """One (slot, kv-head, page) cell of the ragged decode forward."""
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        den_scr[...] = jnp.zeros_like(den_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    kv_len = len_ref[s]
+
+    # whole pages at/past the row's length are SKIPPED, not masked —
+    # the ragged saving (a dead row, kv_len == 0, skips everything)
+    @pl.when(j * pg < kv_len)
+    def _():
+        q = q_ref[0, 0]                                  # (R8, hd)
+        k = k_ref[0, 0]                                  # (pg, hd)
+        scores = jax.lax.dot_general(                    # (R8, pg) fp32
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        kpos = jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        ) + j * pg
+        scores = jnp.where(kpos < kv_len, scores, _NEG_INF)
+
+        # lane-replicated row stats; lane-max reads (no sub-128 slices)
+        m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        scale = jnp.where(m_prev > _NEG_INF, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(scores > _NEG_INF, jnp.exp(scores - m_new), 0.0)
+
+        v = v_ref[0, 0]                                  # (pg, hd)
+        acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        den_scr[...] = den_scr[...] * scale + jnp.sum(
+            p, axis=1, keepdims=True
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == nw - 1)
+    def _():
+        den = jnp.max(den_scr[...], axis=1, keepdims=True)
+        # rows with no live page (kv_len == 0) emit zeros, not NaN
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(den, 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def ragged_paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    kv_len: jax.Array,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Paged decode attention with per-row lengths.
+
+    q (S, nh, hd) — one query token per slot; k_pages/v_pages
+    (P, page, nkv, hd) — the shared page pool (page 0 = trash);
+    page_table (S, W) int32; kv_len (S,) int32 — tokens readable per
+    row (INCLUDING any token written this step).  Returns (S, nh, hd).
+
+    Numerics match the lax fallback (gather + masked SDPA,
+    models/attention._sdpa_positions) to fp tolerance; one jit trace
+    covers every occupancy / length mix at a fixed (S, W) layout
+    (``TRACE_COUNTS["ragged_decode"]``).  ``interpret=None``
+    auto-selects the Pallas interpreter off-TPU.
+    """
+    interpret = resolve_interpret(interpret)
+    TRACE_COUNTS["ragged_decode"] += 1
+    S, nh, hd = q.shape
+    P, pg, nkv, _ = k_pages.shape
+    W = page_table.shape[1]
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} not a multiple of kv heads {nkv}")
+    rep = nh // nkv
+    # GQA rep as the sublane dim of each (slot, kv-head) cell, padded to
+    # the 8-sublane granule; pad rows attend real keys and are sliced off
+    R8 = -(-rep // 8) * 8
+    qh = q.reshape(S, nkv, rep, hd)
+    if R8 != rep:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, R8 - rep), (0, 0)))
+    # head-major page view so KV blocks are (1, 1, pg, hd) — Mosaic's
+    # last-two-dims tiling wants (pg, hd), not a mid-array head slice.
+    # (A production pool would STORE pages head-major and skip this
+    # transpose; the lax fallback's scatter/gather prefers token-major.)
+    kp = jnp.swapaxes(k_pages, 1, 2)                     # (P, nkv, pg, hd)
+    vp = jnp.swapaxes(v_pages, 1, 2)
+
+    grid = (S, nkv, W)
+    q_spec = pl.BlockSpec(
+        (1, 1, R8, hd), lambda s, h, j, tbl, ln: (s, h, 0, 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, pg, hd), lambda s, h, j, tbl, ln: (tbl[s, j], h, 0, 0)
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _rpa_kernel, nw=W, pg=pg, sm_scale=1.0 / math.sqrt(hd)
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=[
+                pltpu.VMEM((R8, 128), jnp.float32),
+                pltpu.VMEM((R8, 128), jnp.float32),
+                pltpu.VMEM((R8, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, nkv, R8, hd), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_len.astype(jnp.int32),
+      qh, kp, vp)
+    return out[:, :, :rep].reshape(S, nh, hd)

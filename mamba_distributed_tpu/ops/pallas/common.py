@@ -5,6 +5,16 @@ from __future__ import annotations
 import os
 
 import jax
+from jax.experimental.pallas import tpu as _pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams across releases; every
+# kernel module takes the alias from here so importing any one of them
+# works on either API, in any import order (the tier-1 quirk where
+# tests/test_attention_pallas.py only passed under the full suite came
+# from ssd_kernels failing this lookup at import time).
+CompilerParams = getattr(_pltpu, "CompilerParams", None) or getattr(
+    _pltpu, "TPUCompilerParams"
+)
 
 
 def resolve_interpret(interpret: bool | None) -> bool:

@@ -57,7 +57,12 @@ from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
 from mamba_distributed_tpu.obs import NULL_TRACER, StreamingHistogram
 from mamba_distributed_tpu.inference.generate import vocab_pad_mask
-from mamba_distributed_tpu.models.lm import init_lm_state, lm_prefill, lm_step
+from mamba_distributed_tpu.models.attention import attention_page_count
+from mamba_distributed_tpu.models.lm import (
+    init_lm_blocks_state,
+    lm_prefill,
+    lm_step,
+)
 from mamba_distributed_tpu.serving import state_cache
 from mamba_distributed_tpu.serving.prefill import (
     cast_decode_params,
@@ -92,13 +97,25 @@ def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig):
 @functools.partial(
     jax.jit, static_argnames=("cfg", "k_max", "steps"), donate_argnums=(1,)
 )
-def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
+def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
+          cfg: ModelConfig, k_max: int, steps: int):
     """Advance every slot ``steps`` tokens.  Returns (pool', tokens
     (steps, S), emitted (steps, S), done (steps, S)) — ``emitted[j, s]``
     marks a real token (slot live at sub-step j), ``done[j, s]`` the
     slot's finish state after it; the rest is masked garbage.  The host
     consumes ``done`` rather than re-deriving the finish rule, so there
     is exactly one copy of it (here).
+
+    HYBRID stacks additionally take the host-owned paged-KV metadata:
+    ``tbl`` (S, B) int32 — page-table rows sliced to the tick's page
+    BUCKET B (pow2 of the largest active slot's allocation, so attention
+    reads scale with what is actually resident, and one trace per bucket
+    covers every occupancy/length mix) — and ``lengths`` (S,) int32.
+    The per-sub-step KV writes of non-live slots are routed to the trash
+    page via ``lm_step``'s write_mask, so a dead slot can never touch a
+    page that was recycled to someone else; the host re-derives the
+    lengths advance from ``emitted`` (bit-equal: both count live
+    sub-steps), so nothing metadata-shaped needs fetching.
 
     Mirrors generate()'s decode loop exactly: sample from the carried
     logits with key fold_in(key, step), then lm_step.  Slots that hit
@@ -110,8 +127,10 @@ def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
     TRACE_COUNTS["tick"] += 1
     pad_mask = vocab_pad_mask(cfg)
     col = jnp.arange(k_max)[None, :]
+    hybrid = tbl is not None
 
-    def one(pool, _):
+    def one(carry, _):
+        pool, lengths = carry
         meta = pool["meta"]
         # a slot mid-chunked-prefill is resident but NOT live: it emits
         # nothing, and its parked scan carry must survive the tick
@@ -126,18 +145,28 @@ def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
         )(keys, vals, meta["temperature"])
         tok = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
         tok = jnp.where(meta["done"] & has_eos, meta["eos_id"], tok)
-        logits, state = lm_step(params, cfg, pool["state"], tok)
+        if hybrid:
+            state_in = {**pool["state"], "attn_meta": (tbl, lengths)}
+            logits, state = lm_step(params, cfg, state_in, tok,
+                                    write_mask=live)
+            lengths = state["attn_meta"][1]
+            state = {k: v for k, v in state.items() if k != "attn_meta"}
+        else:
+            logits, state = lm_step(params, cfg, pool["state"], tok)
         # empty/done slots may compute garbage freely (masked, overwritten
         # by the next insert), but a prefilling slot's rows hold a REAL
-        # carry — keep them (select per (L, S, ...) leaf on the S axis)
+        # carry — keep them (select per (L, S, ...) leaf on the S axis).
+        # Only the conv+SSM "blocks" subtree has a per-slot axis; the
+        # attention page pool is protected by write_mask instead.
         hold = meta["prefilling"]
-        state = jax.tree.map(
+        blocks = jax.tree.map(
             lambda new, old: jnp.where(
                 hold.reshape((1, -1) + (1,) * (new.ndim - 2)), old, new
             ),
-            state,
-            pool["state"],
+            state["blocks"],
+            pool["state"]["blocks"],
         )
+        state = {**state, "blocks": blocks}
         logits = jnp.where(hold[:, None], pool["logits"], logits)
         step = meta["step"] + live.astype(jnp.int32)
         done = meta["done"] | (
@@ -148,9 +177,11 @@ def _tick(params: dict, pool: dict, cfg: ModelConfig, k_max: int, steps: int):
             "logits": logits,
             "meta": {**meta, "step": step, "done": done},
         }
-        return new_pool, (tok, live, done)
+        return (new_pool, lengths), (tok, live, done)
 
-    pool, (tokens, emitted, done) = jax.lax.scan(one, pool, None, length=steps)
+    (pool, _), (tokens, emitted, done) = jax.lax.scan(
+        one, (pool, lengths), None, length=steps
+    )
     return pool, tokens, emitted, done
 
 
@@ -159,8 +190,13 @@ class ServingEngine:
 
     Args:
       params: trained fp32 params (cast once to the decode layout here).
-      cfg: pure-SSM ModelConfig (attention hybrids are rejected by the
-        slot pool — ROADMAP open item).
+      cfg: ModelConfig.  Hybrid stacks (``attn_layer_idx`` non-empty)
+        serve through the paged attention KV pool: admission reserves
+        ceil((prompt + max_new) / kv_page_tokens) pages up front (a
+        request waits in the queue while the pool is short), every
+        hybrid prompt prefills through the chunk step (which writes
+        straight into its slot's pages), and eviction recycles the
+        pages.  Requests must fit ``cfg.kv_slot_tokens``.
       capacity: slot count S — the max concurrent requests.
       max_top_k: static top-k width of the compiled sampler; per-request
         ``top_k`` may be anything in [1, max_top_k] (see parity note in
@@ -228,9 +264,24 @@ class ServingEngine:
         self.tracer = tracer
         self._free: list[int] = list(range(capacity))
         self._slots: dict[int, _Tracked] = {}
-        # slots holding a partial chunked prefill, in admission order
-        # (the budget drains them FCFS)
+        # slots holding a partial chunked prefill, in admission order;
+        # the per-tick budget round-robins ONE chunk at a time across
+        # them so one long prompt can't starve another's TTFT
         self._prefill_queue: list[int] = []
+        # --- hybrid paged-KV bookkeeping (host-owned; the tick takes the
+        # sliced table + lengths as plain arguments, so admission/evict
+        # page moves are pure host work) ---
+        self.hybrid = bool(cfg.attn_layer_idx)
+        if self.hybrid:
+            self.page_pool = state_cache.PagePool(
+                state_cache.hybrid_pool_pages(cfg, capacity)
+            )
+            self._page_tbl = np.zeros(
+                (capacity, cfg.kv_pages_per_slot), np.int32
+            )
+            self._kv_len = np.zeros((capacity,), np.int32)
+            self._page_allocs = 0  # per-step gauges -> serving_tick
+            self._page_frees = 0
         # prefill accounting awaiting a tick record: tick-less steps
         # (everything resident still mid-prefill) roll their stall /
         # chunk counters into the NEXT tick's jsonl record so the
@@ -249,19 +300,65 @@ class ServingEngine:
                 f"request top_k={request.top_k} must be in "
                 f"[1, max_top_k={self.max_top_k}]"
             )
+        if self.hybrid:
+            need = len(request.prompt_ids) + request.max_new_tokens
+            if need > self.cfg.kv_slot_tokens:
+                raise ValueError(
+                    f"hybrid request needs {need} KV tokens (prompt + "
+                    f"max_new_tokens) > cfg.kv_slot_tokens="
+                    f"{self.cfg.kv_slot_tokens}; raise the knob or split "
+                    f"the request"
+                )
+            need_pages = attention_page_count(self.cfg, need)
+            if need_pages > self.page_pool.num_pages:
+                # an oversubscribed pool (kv_pool_pages < slots * pages)
+                # may be smaller than one slot's budget: admission waits
+                # for frees, so a request bigger than the WHOLE pool
+                # would stall the queue forever — reject it up front
+                raise ValueError(
+                    f"hybrid request needs {need_pages} KV pages but the "
+                    f"page pool only has {self.page_pool.num_pages} "
+                    f"(cfg.kv_pool_pages); it could never be admitted"
+                )
         tracked = self.scheduler.submit(request)
         return tracked.request_id
 
-    def _admit(self, tracked: _Tracked) -> None:
-        """Grant the next queued request a slot.  Short prompts prefill
-        one-shot right here (PR-1 path); long prompts register a chunk
-        plan and park a zero carry — their chunks run in the budget
-        phase (``_advance_prefill``)."""
+    def _release_pages(self, slot: int, tracked: _Tracked) -> None:
+        """Recycle a slot's KV pages (evict/failure): return them to the
+        allocator and point the slot's table row at the trash page so
+        nothing it computes can ever touch a recycled page."""
+        if not (self.hybrid and tracked.pages):
+            return
+        self.page_pool.free(tracked.pages)
+        self._page_frees += len(tracked.pages)
+        tracked.pages = None
+        self._page_tbl[slot] = 0
+        self._kv_len[slot] = 0
+
+    def _admit(self, tracked: _Tracked) -> bool:
+        """Grant the next queued request a slot.  Short pure-SSM prompts
+        prefill one-shot right here (PR-1 path); long prompts — and ALL
+        hybrid prompts, whose chunk step writes straight into the paged
+        KV pool — register a chunk plan and park a zero carry, their
+        chunks running in the budget phase (``_advance_prefill``).
+
+        Returns False (request back at the queue head, admission stalls)
+        when a hybrid request's page reservation doesn't fit the free
+        pool yet — evictions recycle pages, never a mid-flight OOM."""
+        r = tracked.request
+        n_pages = 0
+        if self.hybrid:
+            n_pages = attention_page_count(
+                self.cfg, len(r.prompt_ids) + r.max_new_tokens
+            )
+            if n_pages > self.page_pool.free_pages:
+                self.scheduler.requeue(tracked)
+                return False
         slot = self._free.pop(0)
         tracked.status = RequestStatus.PREFILL
-        r = tracked.request
         plan = plan_chunks(len(r.prompt_ids),
-                           self.cfg.effective_prefill_chunk_tokens)
+                           self.cfg.effective_prefill_chunk_tokens,
+                           force=self.hybrid)
         t0 = time.perf_counter()
         try:
             if plan is None:
@@ -284,8 +381,15 @@ class ServingEngine:
                 tracked.plan = plan
                 tracked.chunks_done = 0
                 tracked.prefill_dt = 0.0
+                if self.hybrid:
+                    tracked.pages = self.page_pool.alloc(n_pages)
+                    self._page_allocs += n_pages
+                    self._page_tbl[slot] = 0
+                    self._page_tbl[slot, :n_pages] = tracked.pages
+                    self._kv_len[slot] = 0
                 self.pool = state_cache.stash_prefill(
-                    self.pool, slot, init_lm_state(self.cfg, batch=1),
+                    self.pool, slot,
+                    {"blocks": init_lm_blocks_state(self.cfg, batch=1)},
                     r.resolve_key(), r.max_new_tokens, r.top_k,
                     r.temperature, -1 if r.eos_id is None else r.eos_id,
                 )
@@ -294,6 +398,7 @@ class ServingEngine:
             # shrink for the process lifetime) nor drop the request — it
             # goes back to the queue head so a caller catching the raise
             # still sees it in `pending` and can retry or cancel
+            self._release_pages(slot, tracked)
             self._free.insert(0, slot)
             self.scheduler.requeue(tracked)
             raise
@@ -314,32 +419,54 @@ class ServingEngine:
             tracked.status = RequestStatus.DECODE
         else:
             self._prefill_queue.append(slot)
+        return True
 
     def _advance_prefill(self, slot: int, budget_left: float) -> float:
-        """Run chunks for ``slot``'s partial prefill until its plan or the
-        budget runs out (>= 1 chunk per call: progress is guaranteed even
-        when ``budget_left < chunk``).  Completion flips the slot
-        decodable; otherwise the carry is re-stashed.  Returns the
-        remaining budget."""
+        """Run ONE chunk of ``slot``'s partial prefill (the budget loop
+        round-robins single chunks across concurrent prefills, so the
+        caller controls fairness).  Completion flips the slot decodable;
+        otherwise the carry is re-stashed.  Returns the remaining
+        budget."""
         tracked = self._slots[slot]
         plan, r = tracked.plan, tracked.request
-        logits = None
         try:
             state = state_cache.read_state(self.pool, slot)
-            while tracked.chunks_done < plan.n_chunks and budget_left > 0:
-                i = tracked.chunks_done
-                ids, mask = chunk_inputs(r.prompt_ids, plan, i)
-                t0 = time.perf_counter()
-                with self.tracer.span("serving_prefill_chunk", slot=slot,
-                                      chunk=i, of=plan.n_chunks):
-                    logits, state = prefill_chunk(
-                        self._params, ids, mask, state, cfg=self.cfg
+            if self.hybrid:
+                # the chunk step writes THIS slot's pages in the shared
+                # pool directly (donated through the call): compose the
+                # full carry from the pool pages + the host-owned
+                # table row / length
+                state["attn_blocks"] = self.pool["state"]["attn_blocks"]
+                state["attn_meta"] = (
+                    jnp.asarray(self._page_tbl[slot : slot + 1]),
+                    jnp.asarray(self._kv_len[slot : slot + 1]),
+                )
+            i = tracked.chunks_done
+            ids, mask = chunk_inputs(r.prompt_ids, plan, i)
+            t0 = time.perf_counter()
+            with self.tracer.span("serving_prefill_chunk", slot=slot,
+                                  chunk=i, of=plan.n_chunks):
+                logits, state = prefill_chunk(
+                    self._params, ids, mask, state, cfg=self.cfg
+                )
+                if self.hybrid:
+                    # pages were written in place (donated): swap the
+                    # fresh buffers into the pool IMMEDIATELY — before
+                    # any tracer/metrics host work can raise — so the
+                    # except path below never touches donated-away
+                    # buffers; advance the host-side length mirror by
+                    # this chunk's REAL tokens (the left pad of chunk 0
+                    # is never written)
+                    self.pool["state"]["attn_blocks"] = state["attn_blocks"]
+                    self._kv_len[slot] += (
+                        plan.chunk - (plan.pad if i == 0 else 0)
                     )
-                dt = time.perf_counter() - t0  # host dispatch time
-                tracked.chunks_done += 1
-                tracked.prefill_dt += dt
-                budget_left -= plan.chunk
-                self.metrics.record_prefill_chunk(plan.chunk, dt)
+            dt = time.perf_counter() - t0  # host dispatch time
+            tracked.chunks_done += 1
+            tracked.prefill_dt += dt
+            budget_left -= plan.chunk
+            self.metrics.record_prefill_chunk(plan.chunk, dt)
+            state = {"blocks": state["blocks"]}
             if tracked.chunks_done == plan.n_chunks:
                 self.pool = state_cache.finish_prefill(
                     self.pool, slot, state, logits
@@ -355,10 +482,24 @@ class ServingEngine:
                     r.max_new_tokens, r.top_k, r.temperature,
                     -1 if r.eos_id is None else r.eos_id,
                 )
+                # rotate to the back: the NEXT chunk grant (this step or
+                # the next) goes to the other in-flight prefills first —
+                # round-robin across ticks, not just within one pass
+                self._prefill_queue.remove(slot)
+                self._prefill_queue.append(slot)
         except Exception:
-            # mirror the one-shot contract: free the slot, requeue the
-            # request (restarting its prefill from chunk 0), re-raise
+            # mirror the one-shot contract: free the slot (and its KV
+            # pages), requeue the request (restarting its prefill from
+            # chunk 0), re-raise.  This recovery covers host- and
+            # trace-time failures (bad inputs, retrace errors) — the
+            # donated buffers are still intact then.  A RUNTIME device
+            # failure inside a dispatched step poisons the donated pool
+            # buffers (here via the chunk step's state donation, exactly
+            # as it would via the tick's own pool donation) — that class
+            # has never been recoverable engine-side and surfaces as
+            # deleted-array errors on the next use.
             self.pool = state_cache.evict(self.pool, slot)
+            self._release_pages(slot, tracked)
             self._prefill_queue.remove(slot)
             del self._slots[slot]
             self._free.insert(0, slot)
@@ -372,7 +513,12 @@ class ServingEngine:
 
     def _prefill_phase(self) -> tuple[float, int]:
         """Between-ticks prefill work: admit what fits, then spend the
-        chunk budget on in-flight partial prefills (oldest first).
+        chunk budget ROUND-ROBIN across in-flight partial prefills —
+        one chunk each per pass, oldest first within a pass — so a
+        second long prompt makes proportional progress instead of
+        waiting for the first to drain (FCFS head-of-line blocking on
+        TTFT).  At least one chunk runs per step even when the budget
+        is smaller than a chunk, so progress is guaranteed.
         Returns (host seconds spent — the tick's ``prefill_stall`` —
         and chunk tokens dispatched)."""
         if not ((self._free and self.scheduler.depth) or self._prefill_queue):
@@ -384,13 +530,21 @@ class ServingEngine:
             with self.tracer.span("serving_admit",
                                   queued=self.scheduler.depth):
                 while self._free and self.scheduler.depth:
-                    self._admit(self.scheduler.pop())
+                    if not self._admit(self.scheduler.pop()):
+                        break  # hybrid: waiting for KV pages
         budget = self.prefill_tokens_per_tick
         left = float("inf") if budget == 0 else float(budget)
-        for slot in list(self._prefill_queue):
-            if left <= 0:
+        chunks_run = 0
+        while self._prefill_queue and (left > 0 or chunks_run == 0):
+            ran_this_pass = False
+            for slot in list(self._prefill_queue):
+                if chunks_run > 0 and left <= 0:
+                    break
+                left = self._advance_prefill(slot, left)
+                chunks_run += 1
+                ran_this_pass = True
+            if not ran_this_pass:
                 break
-            left = self._advance_prefill(slot, left)
         self._pending_chunk_ms += (
             self.metrics.prefill_chunk_time_s - chunk_s0
         ) * 1000
@@ -426,13 +580,33 @@ class ServingEngine:
         occupied = len(self._slots)
         t0 = time.perf_counter()
         with self.tracer.span("serving_tick", occupied=occupied):
+            tick_kv = ()
+            if self.hybrid:
+                # page-count BUCKET: pow2 of the largest resident
+                # allocation, so the tick's attention reads scale with
+                # what is actually live (one trace per bucket; bucket
+                # width changes never perturb token streams — masked
+                # attention is bit-stable across page-bucket widths,
+                # models/attention.py)
+                largest = max(
+                    (len(t.pages) for t in self._slots.values()
+                     if t.pages), default=1,
+                )
+                bucket = min(next_pow2_bucket(largest, min_bucket=1),
+                             self._page_tbl.shape[1])
+                tick_kv = (jnp.asarray(self._page_tbl[:, :bucket]),
+                           jnp.asarray(self._kv_len))
             self.pool, tokens, emitted, done = _tick(
-                self._params, self.pool, cfg=self.cfg, k_max=self.max_top_k,
-                steps=self.tokens_per_tick,
+                self._params, self.pool, *tick_kv, cfg=self.cfg,
+                k_max=self.max_top_k, steps=self.tokens_per_tick,
             )
             tokens = np.asarray(tokens)  # (steps, S) — the host sync point
             emitted = np.asarray(emitted)
             done = np.asarray(done)
+        if self.hybrid:
+            # mirror the device-side lengths advance: +1 per live
+            # sub-step, which is exactly what `emitted` marks
+            self._kv_len += emitted.sum(axis=0).astype(np.int32)
         t_now = time.perf_counter()
         dt = t_now - t0
 
@@ -480,6 +654,7 @@ class ServingEngine:
                      if t.status is RequestStatus.FINISHED]:
             tracked = self._slots.pop(slot)
             self.pool = state_cache.evict(self.pool, slot)
+            self._release_pages(slot, tracked)
             self._free.append(slot)
             r = tracked.request
             self.metrics.record_request({
@@ -502,12 +677,26 @@ class ServingEngine:
                     finish_reason=tracked.finish_reason,
                 )
         self._free.sort()
+        kv_gauges = {}
+        if self.hybrid:
+            # KV-page gauges ride the serving_tick record (rendered by
+            # scripts/obs_report.py): occupancy of the page pool plus
+            # this window's allocator churn
+            kv_gauges = dict(
+                kv_pages_used=self.page_pool.pages_in_use,
+                kv_pages_capacity=self.page_pool.num_pages,
+                kv_page_allocs=self._page_allocs,
+                kv_page_frees=self._page_frees,
+            )
+            self._page_allocs = 0
+            self._page_frees = 0
         self.metrics.record_tick(
             occupied=occupied, queue_depth=self.scheduler.depth,
             tokens_emitted=len(events), dt_s=dt,
             prefill_stall_ms=self._pending_stall_ms,
             prefill_chunk_tokens=self._pending_chunk_tokens,
             prefill_chunk_ms=self._pending_chunk_ms,
+            **kv_gauges,
         )
         self._pending_stall_ms = 0.0
         self._pending_chunk_tokens = 0

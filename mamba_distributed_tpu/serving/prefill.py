@@ -81,11 +81,17 @@ class ChunkPlan:
         return self.bucket - self.prompt_len
 
 
-def plan_chunks(prompt_len: int, chunk_tokens: int) -> ChunkPlan | None:
+def plan_chunks(prompt_len: int, chunk_tokens: int,
+                force: bool = False) -> ChunkPlan | None:
     """The chunk planner.  None => the prompt takes the one-shot pow2
-    path (too short to chunk, or chunking disabled)."""
+    path (too short to chunk, or chunking disabled).  ``force`` plans
+    even prompts that fit one chunk (>= 1 chunk) — the HYBRID path,
+    where every prompt runs through the chunk step because it is the
+    one prefill that both masks pad keys (pads are never written to KV
+    pages) and writes straight into the paged pool."""
     if not use_chunked_prefill(prompt_len, chunk_tokens):
-        return None
+        if not (force and chunk_tokens > 0):
+            return None
     bucket = chunk_aligned_bucket(prompt_len, chunk_tokens)
     return ChunkPlan(
         prompt_len=prompt_len,
@@ -124,7 +130,7 @@ def chunk_inputs(
     return jnp.asarray(out), jnp.asarray(mask)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def prefill_chunk(
     params: dict, ids: jax.Array, mask: jax.Array, state, cfg: ModelConfig
 ):
@@ -132,29 +138,40 @@ def prefill_chunk(
 
     ``params`` must already be decode-cast (``cast_decode_params``) —
     both drivers pass the same cast output, which is what makes their
-    chunk computations bit-identical.
+    chunk computations bit-identical.  ``state`` is donated: for hybrid
+    stacks it carries the (large) paged KV pool through every chunk, and
+    the donation lets XLA write pages in place instead of copying the
+    pool per chunk.
     """
     TRACE_COUNTS["chunk"] += 1
     return lm_prefill_chunk(params, cfg, ids, state, token_mask=mask)
 
 
 def chunked_prefill(
-    params: dict, cfg: ModelConfig, prompt_ids, plan: ChunkPlan | None = None
+    params: dict, cfg: ModelConfig, prompt_ids,
+    plan: ChunkPlan | None = None, max_len: int = 0,
 ):
     """Drive a whole prompt through the chunk step (the solo-`generate()`
     driver; the serving engine paces the same loop itself, against its
     per-tick budget).
 
     ``params`` are the fp32 master params — cast here via the shared
-    jitted cast.  Returns (last_logits (b, V) fp32, state), the
+    jitted cast.  For HYBRID stacks ``max_len`` (prompt + decode budget)
+    sizes the private paged KV cache; its page count is pow2-bucketed so
+    the downstream decode trace count stays O(log pages) across prompt/
+    budget mixes (page-width differences never perturb the token stream
+    — masked attention is bit-stable across page-bucket widths, see
+    models/attention.py).  Returns (last_logits (b, V) fp32, state), the
     ``lm_prefill`` contract, ready for the decode loop.
     """
     prompt = np.asarray(prompt_ids, np.int32)
     if prompt.ndim == 1:
         prompt = prompt[None, :]
     b, t = prompt.shape
+    hybrid = bool(cfg.attn_layer_idx)
     if plan is None:
-        plan = plan_chunks(t, cfg.effective_prefill_chunk_tokens)
+        plan = plan_chunks(t, cfg.effective_prefill_chunk_tokens,
+                           force=hybrid)
     if plan is None:
         raise ValueError(
             f"prompt length {t} does not take the chunked path "
@@ -162,7 +179,26 @@ def chunked_prefill(
             f"lm_prefill via the pow2 bucket instead"
         )
     dparams = cast_decode_params(params, cfg=cfg)
-    state = init_lm_state(cfg, batch=b)
+    if hybrid:
+        if max_len < t:
+            raise ValueError(
+                f"hybrid chunked prefill needs KV capacity for the whole "
+                f"request: max_len={max_len} < prompt length {t}"
+            )
+        from mamba_distributed_tpu.inference.bucketing import (
+            next_pow2_bucket,
+        )
+        from mamba_distributed_tpu.models.attention import (
+            attention_page_count,
+        )
+
+        pages = next_pow2_bucket(
+            attention_page_count(cfg, max_len), min_bucket=1
+        )
+        state = init_lm_state(cfg, batch=b,
+                              max_len=pages * cfg.kv_page_tokens)
+    else:
+        state = init_lm_state(cfg, batch=b)
     logits = None
     for i in range(plan.n_chunks):
         ids, mask = chunk_inputs(prompt, plan, i)

@@ -114,6 +114,10 @@ class _Tracked:
     plan: object | None = None
     chunks_done: int = 0
     prefill_dt: float = 0.0
+    # hybrid paged KV: physical page ids reserved for this request at
+    # admission (prompt + max_new worth), recycled on evict/failure
+    # (serving/engine.py page allocator)
+    pages: list | None = None
 
 
 class FCFSScheduler:

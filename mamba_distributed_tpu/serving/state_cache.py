@@ -12,7 +12,10 @@ serving pattern, minus the paging that attention's growing KV needs).
 The pool is a plain pytree:
 
   pool = {
-    "state":  init_lm_state(cfg, batch=capacity)   # (L, S, ...) leaves
+    "state": {
+      "blocks": conv+SSM states, (L, S, ...) leaves  # per-slot rows
+      "attn_blocks": (A, P, page, nkv, hd) x2        # hybrid only: the
+    },                                               # shared KV page pool
     "logits": (S, V_padded) fp32                    # last logits per slot
     "meta": {
       "active":      (S,) bool   # slot holds a live request
@@ -42,9 +45,21 @@ back out to resume at the next budget grant, and ``finish_prefill``
 writes the final state + logits and flips ``prefilling`` off, making
 the slot decodable.
 
-Pure-SSM stacks only: per-slot attention KV caches need a per-row
-length (the stacked cache carries one scalar), a ROADMAP open item
-(docs/SERVING.md "Limits / open items", hybrid-KV entry).
+HYBRID stacks (``attn_layer_idx`` non-empty) pool too: the attention KV
+lives in a fixed PAGE pool — per-layer ``(P, page, nkv, hd)`` page
+arrays under ``state["attn_blocks"]`` (page 0 is a reserved trash page)
+— while the page table and per-slot lengths stay HOST-side on the
+engine (they change only between ticks, and the tick takes them as
+plain array arguments).  ``PagePool`` is the host allocator: admission
+reserves ceil((prompt + max_new) / page) pages up front (so a request
+can never run out mid-flight), eviction recycles them.  KV HBM is
+therefore O(pages in use), not O(capacity * max_len), and slots at
+arbitrary positions coexist because everything per-row — RoPE angles,
+causal masks, KV write offsets — is computed from the per-slot lengths
+(models/attention.py, the ragged/paged-attention pattern).  The state
+pytree the jitted slot writes cover is the ``"blocks"`` (conv+SSM)
+subtree; attention pages flow through the chunk step's and the tick's
+own donations instead.
 """
 
 from __future__ import annotations
@@ -55,28 +70,81 @@ import jax
 import jax.numpy as jnp
 
 from mamba_distributed_tpu.config import ModelConfig
-from mamba_distributed_tpu.models.lm import init_lm_state
+from mamba_distributed_tpu.models.lm import init_lm_blocks_state
+
+
+class PagePool:
+    """Host-side KV page allocator (hybrid pools): a free list over
+    physical pages [1, P) — page 0 is the trash page and never handed
+    out.  Purely bookkeeping; the page *arrays* live in the pool pytree
+    and are written by the compiled chunk/tick steps."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"need >= 1 usable page, got {num_pages}")
+        self.num_pages = num_pages
+        self._free = list(range(1, num_pages + 1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """Reserve ``n`` pages, or raise if the pool can't cover them
+        (callers check ``free_pages`` first — admission just waits)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: want {n}, have {len(self._free)}"
+            )
+        ids, self._free = self._free[:n], self._free[n:]
+        return ids
+
+    def free(self, ids: list[int]) -> None:
+        self._free.extend(ids)
+        self._free.sort()  # deterministic reuse order
+
+
+def hybrid_pool_pages(cfg: ModelConfig, capacity: int) -> int:
+    """Usable page count of a serving pool (excluding the trash page):
+    ``cfg.kv_pool_pages``, or auto = every slot can run to its full
+    ``kv_slot_tokens`` budget simultaneously."""
+    return cfg.kv_pool_pages or capacity * cfg.kv_pages_per_slot
 
 
 def init_pool(cfg: ModelConfig, capacity: int) -> dict:
     """Allocate an empty slot pool for ``capacity`` concurrent requests."""
-    if cfg.attn_layer_idx:
-        raise ValueError(
-            f"hybrid models don't serve yet: cfg.attn_layer_idx="
-            f"{cfg.attn_layer_idx} puts attention layers in the stack, and "
-            f"the layer-stacked attention KV cache carries ONE sequence-"
-            f"length scalar for the whole batch, so slots at different "
-            f"positions can't share the pool.  Per-slot KV write indices "
-            f"(the ragged/paged-attention pattern) are the fix — see "
-            f"docs/SERVING.md, 'Limits / open items' hybrid-KV entry, and "
-            f"the ROADMAP 'Hybrid-model serving' item.  Serve a pure-SSM "
-            f"config (attn_layer_idx=()) instead."
-        )
     if capacity < 1:
         raise ValueError(f"capacity must be >= 1, got {capacity}")
     S = capacity
+    state = {"blocks": init_lm_blocks_state(cfg, batch=S)}
+    if cfg.attn_layer_idx:
+        if cfg.effective_prefill_chunk_tokens <= 0:
+            raise ValueError(
+                "hybrid serving needs chunked prefill: every hybrid "
+                "prompt runs through the chunk step (the one prefill "
+                "that writes straight into the paged KV pool); set "
+                "prefill_chunk_tokens > 0"
+            )
+        from mamba_distributed_tpu.models.attention import (
+            init_attention_state,
+        )
+
+        n_pages = hybrid_pool_pages(cfg, capacity)
+        # init_attention_state builds (1 + batch*W) pages; ask for the
+        # pool's page count directly via batch=n_pages, W=1-page slots
+        pages = [
+            init_attention_state(cfg, n_pages, cfg.kv_page_tokens)
+            for _ in cfg.attn_layer_idx
+        ]
+        state["attn_blocks"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *pages
+        )
     return {
-        "state": init_lm_state(cfg, batch=S),
+        "state": state,
         "logits": jnp.zeros((S, cfg.vocab_size_padded), jnp.float32),
         "meta": {
             "active": jnp.zeros((S,), bool),
@@ -114,7 +182,7 @@ def insert(
     into ``slot``.  One trace serves every (slot, request) combination —
     all arguments are traced, the pool buffers are donated."""
     # state leaves are layer-stacked (L, 1, ...) -> write batch axis 1
-    new_state = _write_state(pool["state"], slot, state)
+    new_state = _write_blocks(pool["state"], slot, state)
     meta = pool["meta"]
     new_meta = {
         "active": _set_row(meta["active"], slot, True),
@@ -134,16 +202,24 @@ def insert(
     }
 
 
-def _write_state(pool_state, slot: jax.Array, state):
-    """Write a batch-1 state pytree into ``slot`` of the (L, S, ...) pool
-    leaves (shared by insert / stash_prefill / finish_prefill)."""
-    return jax.tree.map(
+def _write_blocks(pool_state, slot: jax.Array, state):
+    """Write a batch-1 ``{"blocks": ...}`` pytree into ``slot`` of the
+    (L, S, ...) conv+SSM pool leaves (shared by insert / stash_prefill /
+    finish_prefill).  Only the "blocks" subtree has a per-slot batch
+    axis — hybrid attention KV lives in the shared page pool and is
+    written by the chunk/tick steps themselves, so any attn entries on
+    ``pool_state`` pass through untouched (and ``state`` must not carry
+    them: the engine strips to the blocks subtree before these calls,
+    which also keeps the donated page buffers from aliasing another
+    argument)."""
+    new_blocks = jax.tree.map(
         lambda p, n: jax.lax.dynamic_update_slice_in_dim(
             p, n.astype(p.dtype), slot, axis=1
         ),
-        pool_state,
-        state,
+        pool_state["blocks"],
+        state["blocks"],
     )
+    return {**pool_state, "blocks": new_blocks}
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -193,7 +269,7 @@ def stash_prefill(
         "eos_id": _set_row(meta["eos_id"], slot, eos_id),
     }
     return {
-        "state": _write_state(pool["state"], slot, state),
+        "state": _write_blocks(pool["state"], slot, state),
         "logits": pool["logits"],
         "meta": new_meta,
     }
@@ -203,10 +279,12 @@ def stash_prefill(
 def read_state(pool: dict, slot: jax.Array):
     """Slice ``slot``'s batch-1 state pytree back out (resume a stashed
     prefill at the next budget grant).  NOT donated — the pool lives on."""
-    return jax.tree.map(
-        lambda p: jax.lax.dynamic_slice_in_dim(p, slot, 1, axis=1),
-        pool["state"],
-    )
+    return {
+        "blocks": jax.tree.map(
+            lambda p: jax.lax.dynamic_slice_in_dim(p, slot, 1, axis=1),
+            pool["state"]["blocks"],
+        )
+    }
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -218,7 +296,7 @@ def finish_prefill(pool: dict, slot: jax.Array, state: dict,
     meta = dict(pool["meta"])
     meta["prefilling"] = _set_row(meta["prefilling"], slot, False)
     return {
-        "state": _write_state(pool["state"], slot, state),
+        "state": _write_blocks(pool["state"], slot, state),
         "logits": _set_row(pool["logits"], slot, logits),
         "meta": meta,
     }

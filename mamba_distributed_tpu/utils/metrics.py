@@ -84,6 +84,21 @@ class MetricsLogger:
         )
 
 
+def emit_bench_record(record: dict, json_path: str | None = None) -> None:
+    """Print a bench record as one JSON line and, when ``json_path`` is
+    given, write the same line there — the machine-readable perf-
+    trajectory artifact (BENCH_SERVING.json collects these).  Shared by
+    scripts/bench_serving.py and scripts/bench_decode.py so the two
+    artifacts can never drift in format."""
+    import json
+
+    line = json.dumps(record)
+    print(line, flush=True)
+    if json_path:
+        with open(json_path, "w") as f:
+            f.write(line + "\n")
+
+
 class ServingMetrics:
     """Serving-engine counters: queue depth, slot occupancy, throughput.
 
@@ -129,6 +144,14 @@ class ServingMetrics:
         self._occupied_sum = 0
         self._queue_depth_sum = 0
         self.peak_queue_depth = 0
+        # hybrid paged-KV gauges (serving/engine.py): last-seen pool
+        # occupancy + cumulative allocator churn; None/0 until a hybrid
+        # engine reports them
+        self.kv_pages_used: int | None = None
+        self.kv_pages_capacity: int | None = None
+        self.kv_page_allocs = 0
+        self.kv_page_frees = 0
+        self.peak_kv_pages_used = 0
         self.finished_requests = 0
         self.queue_wait_ms = StreamingHistogram()
         self.ttft_ms = StreamingHistogram()
@@ -199,6 +222,9 @@ class ServingMetrics:
         self, occupied: int, queue_depth: int, tokens_emitted: int,
         dt_s: float, prefill_stall_ms: float = 0.0,
         prefill_chunk_tokens: int = 0, prefill_chunk_ms: float = 0.0,
+        kv_pages_used: int | None = None,
+        kv_pages_capacity: int | None = None,
+        kv_page_allocs: int = 0, kv_page_frees: int = 0,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -206,24 +232,42 @@ class ServingMetrics:
         next tick's record — the jsonl stream never drops any);
         ``prefill_chunk_tokens``/``prefill_chunk_ms`` are the chunked-
         prefill tokens dispatched in that window and their dispatch
-        time."""
+        time.  ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
+        engines) gauge the page pool at this tick, with
+        ``kv_page_allocs``/``kv_page_frees`` the allocator churn in the
+        window — rendered by scripts/obs_report.py."""
         self.ticks += 1
         self.decode_tokens += tokens_emitted
         self.decode_time_s += dt_s
         self._occupied_sum += occupied
         self._queue_depth_sum += queue_depth
         self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
-        if self.jsonl_path:
-            self._write_jsonl({
-                "kind": "serving_tick", "tick": self.ticks,
-                "occupied": occupied, "capacity": self.capacity,
-                "queue_depth": queue_depth,
-                "tokens_emitted": tokens_emitted,
-                "tick_ms": round(dt_s * 1000, 3),
-                "prefill_stall_ms": round(prefill_stall_ms, 3),
-                "prefill_chunk_tokens": prefill_chunk_tokens,
-                "prefill_chunk_ms": round(prefill_chunk_ms, 3),
+        record = {
+            "kind": "serving_tick", "tick": self.ticks,
+            "occupied": occupied, "capacity": self.capacity,
+            "queue_depth": queue_depth,
+            "tokens_emitted": tokens_emitted,
+            "tick_ms": round(dt_s * 1000, 3),
+            "prefill_stall_ms": round(prefill_stall_ms, 3),
+            "prefill_chunk_tokens": prefill_chunk_tokens,
+            "prefill_chunk_ms": round(prefill_chunk_ms, 3),
+        }
+        if kv_pages_used is not None:
+            self.kv_pages_used = kv_pages_used
+            self.kv_pages_capacity = kv_pages_capacity
+            self.kv_page_allocs += kv_page_allocs
+            self.kv_page_frees += kv_page_frees
+            self.peak_kv_pages_used = max(
+                self.peak_kv_pages_used, kv_pages_used
+            )
+            record.update({
+                "kv_pages_used": kv_pages_used,
+                "kv_pages_capacity": kv_pages_capacity,
+                "kv_page_allocs": kv_page_allocs,
+                "kv_page_frees": kv_page_frees,
             })
+        if self.jsonl_path:
+            self._write_jsonl(record)
 
     def summary(self) -> dict:
         return {
@@ -261,6 +305,15 @@ class ServingMetrics:
             "prefill_stall_s": round(self.prefill_stall_s, 4),
             "prefill_stall_ms": self.prefill_stall_ms.summary(),
             "finished_requests": self.finished_requests,
+            "kv_pages": (
+                None if self.kv_pages_used is None else {
+                    "used": self.kv_pages_used,
+                    "capacity": self.kv_pages_capacity,
+                    "peak_used": self.peak_kv_pages_used,
+                    "allocs": self.kv_page_allocs,
+                    "frees": self.kv_page_frees,
+                }
+            ),
             "latency": {
                 "queue_wait_ms": self.queue_wait_ms.summary(),
                 "ttft_ms": self.ttft_ms.summary(),

@@ -6,18 +6,40 @@ train.py:176-194) — O(T) work per token.  This framework decodes from
 carried conv/SSM state (inference/generate.py), so per-token cost is
 O(1); this script measures that as sampled tokens/sec/chip.
 
-Prints one JSON line.  Env knobs: DECODE_B (default 8), DECODE_PROMPT
-(default 128), DECODE_NEW (default 256), BENCH_PRESET, BENCH_PLATFORM.
+Prints one JSON line; ``--json PATH`` also writes it to PATH (the
+machine-readable bench artifact BENCH_SERVING.json collects).  Env
+knobs: DECODE_B (default 8), DECODE_PROMPT (default 128), DECODE_NEW
+(default 256), BENCH_PRESET, BENCH_PLATFORM.
+
+``--hybrid-paged`` benches the RAGGED PAGED attention decode instead
+(BENCH_PRESET defaults to hybrid-tiny there): a serving-style slot pool
+at LOW occupancy — DECODE_LIVE (2) of DECODE_SLOTS (8) slots live at
+DECODE_KV_LEN (96) cached tokens — decoded two ways through the same
+``lm_step``:
+
+  * paged: the page-table slice covers only the pow2 bucket of pages
+    the live slots actually occupy (what serving/engine.py's tick
+    does), so attention reads scale with resident tokens;
+  * dense fallback: the table spans every slot's FULL kv_slot_tokens
+    budget — the cost a batch-max-length dense cache (one shared length
+    scalar) would pay every tick.
+
+The ratio is the paged win at that occupancy; on TPU the Pallas ragged
+kernel (ops/pallas/attention_kernels.py) additionally skips dead slots'
+work entirely.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mamba_distributed_tpu.utils.metrics import emit_bench_record  # noqa: E402
 
 _T0 = time.time()
 
@@ -26,7 +48,142 @@ def _progress(msg: str) -> None:
     print(f"[decode +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def _hybrid_paged_bench(args) -> dict:
+    """Low-occupancy paged decode vs the dense batch-max-length cost."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mamba_distributed_tpu.config import get_preset
+    from mamba_distributed_tpu.models import init_lm_params
+    from mamba_distributed_tpu.models.lm import init_lm_blocks_state, lm_step
+    from mamba_distributed_tpu.serving import state_cache
+    from mamba_distributed_tpu.serving.prefill import cast_decode_params
+
+    preset = os.environ.get("BENCH_PRESET", "hybrid-tiny")
+    cfg = get_preset(preset).model
+    if not cfg.attn_layer_idx:
+        raise SystemExit(f"--hybrid-paged needs a hybrid preset, got {preset}")
+    if os.environ.get("DECODE_KV_SLOT"):
+        # per-slot KV budget = the dense fallback's read span; raising it
+        # models a longer-context pool (dense pays more, paged doesn't)
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, kv_slot_tokens=int(os.environ["DECODE_KV_SLOT"])
+        )
+    S = int(os.environ.get("DECODE_SLOTS", "8"))
+    live_n = int(os.environ.get("DECODE_LIVE", "2"))
+    kv_len0 = int(os.environ.get("DECODE_KV_LEN", "96"))
+    steps = int(os.environ.get("DECODE_NEW", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    pg = cfg.kv_page_tokens
+    W_full = cfg.kv_pages_per_slot
+    dev = jax.devices()[0]
+
+    params = cast_decode_params(
+        jax.jit(lambda k: init_lm_params(k, cfg))(jax.random.PRNGKey(0)),
+        cfg=cfg,
+    )
+    jax.block_until_ready(params)
+    _progress(f"params ready ({preset}); S={S} live={live_n} kv_len={kv_len0}")
+
+    # serving-style pool state: live slots hold kv_len0 cached tokens in
+    # pool pages handed out by the allocator, dead slots point at trash
+    n_pages = state_cache.hybrid_pool_pages(cfg, S)
+    alloc = state_cache.PagePool(n_pages)
+    tbl = np.zeros((S, W_full), np.int32)
+    lengths = np.zeros((S,), np.int32)
+    need = -(-(kv_len0 + steps) // pg)
+    for s in range(live_n):
+        ids = alloc.alloc(need)
+        tbl[s, :need] = ids
+        lengths[s] = kv_len0
+    A = len(cfg.attn_layer_idx)
+    nkv, hd = cfg.effective_attn_num_kv_heads, cfg.effective_attn_head_dim
+    key = jax.random.PRNGKey(1)
+    kv = jax.random.normal(key, (A, n_pages + 1, pg, nkv, hd),
+                           jnp.dtype(cfg.compute_dtype))
+    state_blocks = {
+        "blocks": init_lm_blocks_state(cfg, S),
+        "attn_blocks": (kv, kv),
+    }
+    live = np.zeros((S,), bool)
+    live[:live_n] = True
+
+    @functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+    def decode_run(params, state, tbl, lengths, live, tok, cfg, steps):
+        def one(carry, _):
+            state, lengths, tok = carry
+            st = {**state, "attn_meta": (tbl, lengths)}
+            logits, st = lm_step(params, cfg, st, tok, write_mask=live)
+            lengths = st["attn_meta"][1]
+            st = {k: v for k, v in st.items() if k != "attn_meta"}
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (st, lengths, tok), None
+
+        (state, lengths, tok), _ = jax.lax.scan(
+            one, (state, lengths, tok), None, length=steps
+        )
+        return state, tok
+
+    def run_width(n_pages_width: int) -> float:
+        t = jnp.asarray(tbl[:, :n_pages_width])
+        ln = jnp.asarray(lengths)
+        lv = jnp.asarray(live)
+        tok = jnp.zeros((S,), jnp.int32)
+        out = decode_run(params, state_blocks, t, ln, lv, tok,
+                         cfg=cfg, steps=steps)
+        jax.block_until_ready(out)  # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = decode_run(params, state_blocks, t, ln, lv, tok,
+                             cfg=cfg, steps=steps)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket
+
+    # same bucket rule the engine's tick uses, so the bench measures
+    # exactly what serving pays
+    bucket = min(next_pow2_bucket(need, min_bucket=1), W_full)
+    dt_paged = run_width(bucket)
+    _progress(f"paged (bucket {bucket} pages): {dt_paged * 1000:.1f} ms")
+    dt_dense = run_width(W_full)
+    _progress(f"dense batch-max ({W_full} pages): {dt_dense * 1000:.1f} ms")
+
+    tok_paged = live_n * steps / dt_paged
+    record = {
+        "metric": f"hybrid_paged_decode_tokens_per_sec_{preset.replace('-', '_')}",
+        "value": round(tok_paged, 1),
+        "unit": "sampled tokens/sec (live slots, paged page-bucket)",
+        "dense_fallback_tokens_per_sec": round(live_n * steps / dt_dense, 1),
+        "paged_vs_dense_speedup": round(dt_dense / dt_paged, 2),
+        "slots": S,
+        "live_slots": live_n,
+        "kv_len": kv_len0,
+        "decode_steps": steps,
+        "kv_page_tokens": pg,
+        "bucket_pages": bucket,
+        "dense_pages": W_full,
+        "kv_pages_in_use": alloc.pages_in_use,
+        "kv_pool_pages": n_pages,
+        "device": dev.device_kind,
+    }
+    return record
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the JSON record to PATH")
+    ap.add_argument("--hybrid-paged", action="store_true",
+                    help="bench ragged paged hybrid decode at low "
+                         "occupancy vs the dense batch-max-length cost")
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
 
@@ -36,6 +193,10 @@ def main() -> None:
     _progress("initializing backend...")
     dev = jax.devices()[0]
     _progress(f"backend up: {dev.device_kind or dev.platform}")
+
+    if args.hybrid_paged:
+        emit_bench_record(_hybrid_paged_bench(args), args.json)
+        return
 
     from mamba_distributed_tpu.config import get_preset
     from mamba_distributed_tpu.inference import generate
@@ -70,20 +231,18 @@ def main() -> None:
     dt = (time.time() - t0) / iters
 
     tok_per_sec = B * new_tokens / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"decode_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
-                "value": round(tok_per_sec, 1),
-                "unit": "sampled tokens/sec/chip",
-                "per_token_ms": round(1000 * dt / new_tokens, 3),
-                "batch": B,
-                "prompt_len": prompt_len,
-                "new_tokens": new_tokens,
-                "device": dev.device_kind,
-            }
-        ),
-        flush=True,
+    emit_bench_record(
+        {
+            "metric": f"decode_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
+            "value": round(tok_per_sec, 1),
+            "unit": "sampled tokens/sec/chip",
+            "per_token_ms": round(1000 * dt / new_tokens, 3),
+            "batch": B,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "device": dev.device_kind,
+        },
+        args.json,
     )
 
 

@@ -19,7 +19,11 @@ SERVE_TOKENS_PER_TICK (8), BENCH_PLATFORM, BENCH_SEED (0).
 ``--jsonl PATH`` streams the timed engine run's per-tick and per-request
 telemetry records (kind serving_tick / request) to PATH — the stream
 ``scripts/obs_report.py`` turns into queue-wait/TTFT/ITL percentile
-tables — and folds the latency summary into the JSON line.
+tables — and folds the latency summary into the JSON line.  ``--json
+PATH`` additionally writes the final record to PATH (the machine-
+readable bench artifact; BENCH_SERVING.json collects these).  Hybrid
+presets (e.g. BENCH_PRESET=hybrid-tiny) serve through the paged KV pool
+and report its page gauges.
 
 ``--long-prompt`` switches to the head-of-line-blocking workload: a few
 LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
@@ -41,6 +45,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mamba_distributed_tpu.utils.metrics import emit_bench_record  # noqa: E402
 
 _T0 = time.time()
 
@@ -137,6 +143,9 @@ def main() -> None:
     ap.add_argument("--jsonl", default=None, metavar="PATH",
                     help="write the timed run's serving_tick + request "
                          "jsonl stream here (obs_report.py input)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the final one-line JSON record to "
+                         "PATH (machine-readable bench artifact)")
     ap.add_argument("--long-prompt", action="store_true",
                     help="mixed long+short workload; report short-request "
                          "TTFT p95 with chunked vs one-shot prefill")
@@ -232,7 +241,7 @@ def main() -> None:
         }
         if args.jsonl:
             record["jsonl"] = args.jsonl
-        print(json.dumps(record), flush=True)
+        emit_bench_record(record, args.json)
         return
 
     requests = _workload(rng, n_requests, pmin, pmax, max_new, cfg.vocab_size)
@@ -296,9 +305,11 @@ def main() -> None:
         "latency": summary["latency"],
         "device": dev.device_kind,
     }
+    if summary.get("kv_pages"):
+        record["kv_pages"] = summary["kv_pages"]
     if args.jsonl:
         record["jsonl"] = args.jsonl
-    print(json.dumps(record), flush=True)
+    emit_bench_record(record, args.json)
 
 
 if __name__ == "__main__":

@@ -150,6 +150,22 @@ def build_report(events: list[dict]) -> dict:
         # definition as summary()["prefill_chunk_tokens_per_sec"]) —
         # stall time additionally contains one-shot admissions
         chunk_total_ms = sum(e.get("prefill_chunk_ms", 0.0) for e in ticks)
+        # hybrid paged-KV gauges (absent in pure-SSM streams): pool
+        # occupancy per tick + total allocator churn in the stream
+        kv_ticks = [e for e in ticks if e.get("kv_pages_used") is not None]
+        kv_pages = None
+        if kv_ticks:
+            cap = kv_ticks[-1].get("kv_pages_capacity")
+            kv_pages = {
+                "capacity": cap,
+                "peak_used": max(e["kv_pages_used"] for e in kv_ticks),
+                "mean_used": round(
+                    sum(e["kv_pages_used"] for e in kv_ticks)
+                    / len(kv_ticks), 2
+                ),
+                "allocs": sum(e.get("kv_page_allocs", 0) for e in kv_ticks),
+                "frees": sum(e.get("kv_page_frees", 0) for e in kv_ticks),
+            }
         report["serving"] = {
             "ticks": len(ticks),
             "decode_tokens": tokens,
@@ -168,6 +184,7 @@ def build_report(events: list[dict]) -> dict:
                 round(chunk_tokens / (chunk_total_ms / 1000), 1)
                 if chunk_tokens and chunk_total_ms else None
             ),
+            "kv_pages": kv_pages,
         }
 
     # --- per-request latency (the serving stream's "request" records)
@@ -267,6 +284,13 @@ def format_report(report: dict) -> str:
             head += (
                 f"   prefill chunk tokens: {s['prefill_chunk_tokens']}"
                 f" (dispatch tok/s: {_fmt(s['prefill_chunk_tokens_per_sec'])})"
+            )
+        if s.get("kv_pages"):
+            kv = s["kv_pages"]
+            head += (
+                f"\nkv pages: peak {kv['peak_used']}/{_fmt(kv['capacity'])}"
+                f"   mean {kv['mean_used']}   allocs {kv['allocs']}"
+                f"   frees {kv['frees']}"
             )
         rows = [_pct_row("tick_ms", s["tick_ms"])]
         if s.get("prefill_stall_ms") is not None:

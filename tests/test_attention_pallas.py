@@ -2,6 +2,7 @@
 (interpret mode on CPU; the same kernel compiles for real on TPU)."""
 
 import jax
+import jax.export  # attribute access alone fails on 0.4.37's lazy module
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -54,6 +55,8 @@ def test_flash_fwd_bf16(rng):
     )
 
 
+@pytest.mark.slow  # 5-20s interpret-mode run: keeps tier-1 'not slow'
+# inside its wall-clock budget (fwd parity + lowering stay in tier-1)
 @pytest.mark.parametrize("shapes", [
     dict(),
     dict(nh=8, nkv=2, hd=32),               # GQA partials group-summed
@@ -78,6 +81,8 @@ def test_flash_grads_match_blockwise(rng, shapes):
                                    atol=2e-4, rtol=1e-3)
 
 
+@pytest.mark.slow  # 5-20s interpret-mode run: keeps tier-1 'not slow'
+# inside its wall-clock budget (fwd parity + lowering stay in tier-1)
 def test_flash_model_drop_in(rng):
     """attn_impl='pallas' reproduces the XLA hybrid model exactly-ish."""
     from mamba_distributed_tpu.config import ModelConfig

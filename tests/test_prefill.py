@@ -376,13 +376,14 @@ def test_failed_chunk_requeues_and_frees_slot(monkeypatch):
 # ------------------------------------------------------------- satellites
 
 
-def test_hybrid_rejection_names_docs():
-    cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
-                      headdim=8, chunk_size=16, d_state=16,
-                      compute_dtype="float32", attn_layer_idx=(1,),
-                      attn_num_heads=4, remat=False)
-    with pytest.raises(ValueError, match="docs/SERVING.md"):
-        init_pool(cfg, capacity=2)
+def test_hybrid_requests_always_plan_chunks():
+    """Hybrid prompts of ANY length take the chunk path (force=True):
+    it is the one prefill that masks pad keys (never written to pages)
+    and writes straight into the slot's pool pages."""
+    assert plan_chunks(5, 16) is None          # short pure-SSM: one-shot
+    plan = plan_chunks(5, 16, force=True)      # short hybrid: 1 chunk
+    assert (plan.bucket, plan.n_chunks, plan.pad) == (16, 1, 11)
+    assert plan_chunks(5, 0, force=True) is None  # chunking off: no plan
 
 
 def test_chunking_disabled_reproduces_oneshot_streams():
@@ -405,3 +406,37 @@ def test_chunking_disabled_reproduces_oneshot_streams():
                                      key=key)])[0]
     assert res.new_tokens.tolist() == off
     assert eng.metrics.prefill_chunks == 0  # never chunked
+
+
+def test_budget_round_robins_across_concurrent_longs():
+    """Two long prompts in flight split the per-tick chunk budget
+    round-robin (satellite: the ROADMAP PR-3 refinement) — with a
+    one-chunk budget they alternate grants instead of FCFS-draining the
+    older prompt first, so neither starves the other's TTFT."""
+    cfg = tiny_cfg()  # budget 16 == one chunk per step
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1)
+    r1 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(53, seed=1),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(0)))
+    r2 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(53, seed=2),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(1)))
+    by_rid = {}
+    eng.step()  # both admitted; ONE chunk granted (to r1)
+    by_rid = {t.request_id: t for t in eng._slots.values()}
+    assert by_rid[r1].chunks_done == 1 and by_rid[r2].chunks_done == 0
+    eng.step()  # next grant goes to r2, not r1 (rotation)
+    assert by_rid[r2].chunks_done == 1
+    assert abs(by_rid[r1].chunks_done - by_rid[r2].chunks_done) <= 1
+    eng.step()
+    eng.step()
+    # after 4 single-chunk grants the split is 2/2 — FCFS would be 4/0
+    assert (by_rid[r1].chunks_done, by_rid[r2].chunks_done) == (2, 2)
+    # streams still match solo generate() exactly
+    while eng.pending:
+        eng.step()
+    for rid, seed, key in ((r1, 1, 0), (r2, 2, 1)):
+        want = solo(params, cfg, rand_prompt(53, seed=seed),
+                    jax.random.PRNGKey(key), max_new_tokens=3)
+        assert eng.results[rid].new_tokens.tolist() == want

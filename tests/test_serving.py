@@ -85,13 +85,26 @@ def test_evict_frees_slot_only(setup):
     assert np.asarray(pool["meta"]["active"]).tolist() == [False, True]
 
 
-def test_pool_rejects_hybrid():
+def test_pool_admits_hybrid_with_paged_kv():
+    """Hybrid configs build a pool whose attention KV is a PAGE pool
+    (per-layer (P, page, nkv, hd) arrays, page 0 reserved as trash) —
+    the ragged/paged-attention pattern that unlocked hybrid serving."""
     cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
                       headdim=8, chunk_size=16, d_state=16,
                       compute_dtype="float32", attn_layer_idx=(1,),
-                      attn_num_heads=4, remat=False)
-    with pytest.raises(ValueError, match="pure-SSM"):
-        init_pool(cfg, capacity=2)
+                      attn_num_heads=4, attn_num_kv_heads=2, remat=False,
+                      prefill_chunk_tokens=16, kv_page_tokens=8,
+                      kv_slot_tokens=64)
+    pool = init_pool(cfg, capacity=2)
+    k_pages, v_pages = pool["state"]["attn_blocks"]
+    n_pages = state_cache.hybrid_pool_pages(cfg, 2)   # 2 slots * 8 pages
+    assert n_pages == 16
+    assert k_pages.shape == (1, n_pages + 1, 8, 2, 8)  # (A, P+trash, pg, nkv, hd)
+    assert v_pages.shape == k_pages.shape
+    # hybrid serving requires the chunk path (it writes the pages)
+    import dataclasses
+    with pytest.raises(ValueError, match="chunked prefill"):
+        init_pool(dataclasses.replace(cfg, prefill_chunk_tokens=0), 2)
 
 
 # -------------------------------------------------------------- engine parity
@@ -370,17 +383,20 @@ def test_bench_serving_cli_smoke(tmp_path):
     import json
 
     jsonl = str(tmp_path / "serve.jsonl")
+    json_out = str(tmp_path / "serve.json")
     env = dict(os.environ)
     env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="3", SERVE_CAPACITY="2",
                SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="12",
                SERVE_MAX_NEW="6", SERVE_TOKENS_PER_TICK="3")
     p = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
-         "--jsonl", jsonl],
+         "--jsonl", jsonl, "--json", json_out],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
     )
     assert p.returncode == 0, p.stderr[-2000:]
     rec = json.loads(p.stdout.strip().splitlines()[-1])
+    # --json writes the SAME record as a machine-readable artifact
+    assert json.loads(open(json_out).read()) == rec
     assert rec["value"] > 0 and rec["requests"] == 3
     assert 0.0 < rec["mean_slot_occupancy"] <= 1.0
     assert rec["total_new_tokens"] >= 3
@@ -400,3 +416,190 @@ def test_bench_serving_cli_smoke(tmp_path):
     report = json.loads(r.stdout)
     assert report["requests"]["count"] == 3
     assert report["requests"]["ttft_ms"]["p99"] is not None
+
+
+# ------------------------------------------------- hybrid paged-KV serving
+
+
+def hybrid_cfg(**kw):
+    kw.setdefault("prefill_chunk_tokens", 16)
+    kw.setdefault("prefill_tokens_per_tick", 16)
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64,
+                       ssm_layer="mamba2", headdim=8, chunk_size=16,
+                       d_state=16, compute_dtype="float32",
+                       attn_layer_idx=(1,), attn_num_heads=4,
+                       attn_num_kv_heads=2, remat=False,
+                       kv_page_tokens=8, kv_slot_tokens=64, **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def test_hybrid_engine_generate_parity():
+    """THE acceptance scenario: a hybrid (mamba+attention) config is
+    admitted by the slot pool, and every request's token stream is
+    bit-identical to solo generate() — through admission mid-flight,
+    a chunked-prefill long prompt, eviction, and slot+page reuse."""
+    cfg = hybrid_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    keys = {n: jax.random.PRNGKey(40 + i) for i, n in enumerate("ALC")}
+    prompts = {"A": rand_prompt(9, seed=2), "L": rand_prompt(53, seed=3),
+               "C": rand_prompt(7, seed=4)}
+    budgets = {"A": 4, "L": 5, "C": 6}
+
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1)
+    ids = {}
+    ids["A"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["A"], max_new_tokens=budgets["A"], key=keys["A"]))
+    eng.step()  # A decoding alone
+    ids["L"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["L"], max_new_tokens=budgets["L"], key=keys["L"]))
+    eng.step()  # L admitted: chunks landing in its pool pages
+    ids["C"] = eng.submit(GenerationRequest(
+        prompt_ids=prompts["C"], max_new_tokens=budgets["C"], key=keys["C"]))
+    while eng.pending:
+        eng.step()
+    for name in "ALC":
+        got = eng.results[ids[name]].new_tokens.tolist()
+        want = solo(params, cfg, prompts[name], keys[name],
+                    max_new_tokens=budgets[name])
+        assert got == want, f"hybrid request {name} diverged: {got} vs {want}"
+    # the whole pool recycled: nothing leaked
+    assert eng.page_pool.pages_in_use == 0
+
+
+def test_hybrid_pages_freed_on_evict_no_alias():
+    """Page-free-on-evict: an evicted request's pages return to the
+    allocator; the slots that recycle them produce bit-exact streams
+    (any stale-page aliasing would corrupt their attention reads), and
+    live slots never share a physical page."""
+    cfg = hybrid_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+
+    key_a = jax.random.PRNGKey(50)
+    prompt_a = rand_prompt(40, seed=5)
+    rid_a = eng.submit(GenerationRequest(prompt_ids=prompt_a,
+                                         max_new_tokens=4, key=key_a))
+    eng.step()
+    tracked_a = next(iter(eng._slots.values()))
+    pages_a = list(tracked_a.pages)
+    assert len(pages_a) == -(-(40 + 4) // cfg.kv_page_tokens)
+    while eng.pending:
+        eng.step()
+    # freed on evict: allocator got every page back, table row scrubbed
+    assert eng.page_pool.pages_in_use == 0
+    assert set(pages_a) <= set(eng.page_pool._free)
+    assert (eng._page_tbl == 0).all() and (eng._kv_len == 0).all()
+
+    # a new request recycles those pages and still matches generate()
+    key_b = jax.random.PRNGKey(51)
+    prompt_b = rand_prompt(33, seed=6)
+    rid_b = eng.submit(GenerationRequest(prompt_ids=prompt_b,
+                                         max_new_tokens=5, key=key_b))
+    eng.step()
+    tracked_b = next(iter(eng._slots.values()))
+    assert set(tracked_b.pages) & set(pages_a)  # really recycled
+    while eng.pending:
+        eng.step()
+    assert eng.results[rid_b].new_tokens.tolist() == solo(
+        params, cfg, prompt_b, key_b, max_new_tokens=5
+    )
+    assert eng.results[rid_a].new_tokens.tolist() == solo(
+        params, cfg, prompt_a, key_a, max_new_tokens=4
+    )
+
+
+def test_hybrid_live_slots_never_share_pages():
+    """Allocator invariant under churn: across a mixed workload, the
+    page sets of co-resident slots are always disjoint and within
+    capacity."""
+    cfg = hybrid_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=3, tokens_per_tick=2)
+    for i in range(6):
+        eng.submit(GenerationRequest(
+            prompt_ids=rand_prompt(5 + 7 * i, seed=10 + i),
+            max_new_tokens=3 + i, key=jax.random.PRNGKey(60 + i)))
+    while eng.pending:
+        eng.step()
+        held = [t.pages for t in eng._slots.values() if t.pages]
+        flat = [p for ps in held for p in ps]
+        assert len(flat) == len(set(flat)), "live slots share a page"
+        assert eng.page_pool.pages_in_use == len(flat)
+    assert eng.page_pool.pages_in_use == 0
+
+
+def test_hybrid_admission_waits_for_pages():
+    """When the page pool can't cover a request it stays QUEUED (no
+    mid-flight OOM is possible: pages are reserved up front) and is
+    admitted once an eviction recycles pages."""
+    import dataclasses
+
+    cfg = hybrid_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    # pool of 8 pages: one 40+4-token request (6 pages) fills most of it
+    cfg_small = dataclasses.replace(cfg, kv_pool_pages=8)
+    eng = ServingEngine(params, cfg_small, capacity=2, tokens_per_tick=2)
+    r1 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(40, seed=7),
+                                      max_new_tokens=4,
+                                      key=jax.random.PRNGKey(70)))
+    r2 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(30, seed=8),
+                                      max_new_tokens=4,
+                                      key=jax.random.PRNGKey(71)))
+    eng.step()
+    # r2 needs 5 pages; only 2 are free while r1 holds 6 of 8
+    assert eng.scheduler.depth == 1  # r2 still queued, slot free
+    assert len(eng._free) == 1
+    while eng.pending:
+        eng.step()
+    assert {r1, r2} <= set(eng.results)  # both served eventually
+    # oversized requests are rejected up front, naming the knob
+    with pytest.raises(ValueError, match="kv_slot_tokens"):
+        eng.submit(GenerationRequest(prompt_ids=rand_prompt(61, seed=9),
+                                     max_new_tokens=10))
+
+
+def test_hybrid_tick_traces_once_across_occupancy():
+    """The hybrid tick compiles once per page BUCKET, not per occupancy
+    or length mix — requests coming and going reuse the trace."""
+    from mamba_distributed_tpu.serving.engine import TRACE_COUNTS
+
+    import dataclasses
+
+    # own vocab size so the jit cache can't already hold the signature
+    cfg = dataclasses.replace(hybrid_cfg(), vocab_size=48)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        max_top_k=20)
+    t0 = TRACE_COUNTS["tick"]
+    # all requests fit one page bucket (<= 2 pages of 8 tokens each)
+    reqs = [GenerationRequest(prompt_ids=rand_prompt(n, seed=n), top_k=20,
+                              max_new_tokens=12 - n,
+                              key=jax.random.PRNGKey(n))
+            for n in (3, 5, 4, 6)]
+    eng.run(reqs)
+    assert TRACE_COUNTS["tick"] == t0 + 1
+
+
+def test_hybrid_request_larger_than_pool_rejected():
+    """A request that could NEVER fit the (oversubscribed) page pool is
+    rejected at submit instead of stalling the queue forever."""
+    import dataclasses
+
+    cfg = dataclasses.replace(hybrid_cfg(), kv_pool_pages=4)  # 32 tokens
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    with pytest.raises(ValueError, match="page pool"):
+        eng.submit(GenerationRequest(prompt_ids=rand_prompt(40, seed=1),
+                                     max_new_tokens=4))
+    # a pool-sized request still serves
+    rid = eng.submit(GenerationRequest(prompt_ids=rand_prompt(20, seed=2),
+                                       max_new_tokens=4,
+                                       key=jax.random.PRNGKey(0)))
+    while eng.pending:
+        eng.step()
+    assert len(eng.results[rid].new_tokens) == 4
