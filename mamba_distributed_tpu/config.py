@@ -171,6 +171,26 @@ class ModelConfig:
     # slots) while it streams in.  0 => unbounded (a whole prompt
     # prefills between two ticks, the pre-chunking behavior).
     prefill_tokens_per_tick: int = 512
+    # How the per-tick chunk budget is scheduled across concurrent
+    # partial prefills (serving/engine.py): "rr" rotates one chunk at a
+    # time in admission order; "srpt" grants the prompt with the FEWEST
+    # remaining chunks first (shortest-remaining-processing-time — a
+    # nearly-done prompt reaches its first token before a fresh long one
+    # begins), with a starvation guard so a long prompt still gets a
+    # chunk at least every few grants.
+    prefill_schedule: str = "rr"
+    # --- data-parallel serving fabric (serving/router.py) ---
+    # Engine replicas the request router places over (least-loaded
+    # placement; each replica is a full ServingEngine with its own slot
+    # pool).  The router/bench default; 1 => a single engine.
+    serving_replicas: int = 1
+    # Shards of the serving slot pool's batch axis over `mesh.data`
+    # (parallel/mesh.serving_mesh): slot/page state and the decode
+    # tick's batch axis partition over the data axis via NamedSharding
+    # (weights replicated), so one engine spans every device in the
+    # mesh.  1 => single-device pool (the pre-fabric behavior).
+    # capacity must divide evenly across the shards.
+    serving_data_shards: int = 1
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
@@ -219,6 +239,20 @@ class ModelConfig:
             raise ValueError(
                 f"prefill_tokens_per_tick must be >= 0 (0 => unbounded), "
                 f"got {self.prefill_tokens_per_tick}"
+            )
+        if self.prefill_schedule not in ("rr", "srpt"):
+            raise ValueError(
+                f"prefill_schedule must be 'rr' or 'srpt', got "
+                f"{self.prefill_schedule!r}"
+            )
+        if self.serving_replicas < 1:
+            raise ValueError(
+                f"serving_replicas must be >= 1, got {self.serving_replicas}"
+            )
+        if self.serving_data_shards < 1:
+            raise ValueError(
+                f"serving_data_shards must be >= 1, got "
+                f"{self.serving_data_shards}"
             )
         if self.kv_page_tokens < 8 or self.kv_page_tokens % 8:
             raise ValueError(

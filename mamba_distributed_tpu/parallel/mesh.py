@@ -40,3 +40,26 @@ def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
 
 def single_device_mesh() -> Mesh:
     return build_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def serving_mesh(num_shards: int, devices=None) -> Mesh:
+    """One-axis ``("data",)`` mesh for the serving fabric: the slot
+    pool's batch axis (and the paged-KV page axis) partition over it,
+    weights replicate (parallel/sharding.slot_pool_shardings).
+
+    Serving never shards params — decode is weight-bandwidth-bound and
+    the model fits one replica by assumption — so the full 6-axis
+    training mesh collapses to the one axis the slot pool needs.  On a
+    CPU host, force a multi-device platform first
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=N``, as the
+    test harness does) to exercise the same GSPMD path as a pod slice.
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if devices is None:
+        devices = jax.devices()
+    if num_shards > len(devices):
+        raise ValueError(
+            f"serving mesh wants {num_shards} devices, have {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:num_shards]), ("data",))

@@ -175,6 +175,50 @@ def opt_state_shardings(opt_shapes, params, param_sharding_tree, mesh: Mesh):
     return jtu.tree_map_with_path(leaf_shard, opt_shapes)
 
 
+# --------------------------------------------------- serving slot pool
+
+
+def slot_pool_specs(pool, num_shards: int):
+    """PartitionSpec pytree for a serving slot pool (serving/state_cache
+    .init_pool) sharded over a ``serving_mesh``'s data axis.
+
+    The SLOT axis partitions: ``blocks`` leaves are (L, S, ...) and
+    ``attn_blocks`` page-pool leaves (A, P+1, ...) shard axis 1;
+    ``logits`` (S, V) and every ``meta`` leaf (S, ...) shard axis 0.
+    An axis that doesn't divide by ``num_shards`` replicates (the
+    engine sizes capacity and the page pool so both divide; the
+    fallback keeps arbitrary pools valid).  Weights are NOT covered
+    here — serving replicates them (``NamedSharding(mesh, P())``).
+    """
+    def leaf_spec(path, leaf):
+        names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
+        shape = np.shape(leaf)
+        ax = 1 if ("blocks" in names or "attn_blocks" in names) else 0
+        spec: list = [None] * len(shape)
+        if len(shape) > ax and shape[ax] % num_shards == 0:
+            spec[ax] = "data"
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, pool)
+
+
+def slot_pool_shardings(pool, mesh: Mesh):
+    """NamedSharding pytree for the slot pool over ``mesh``'s data axis
+    (device_put at engine init; re-asserted by the tick's sharding
+    constraints every step so insert/evict propagation can never decay
+    the layout)."""
+    specs = slot_pool_specs(pool, mesh.shape["data"])
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def slot_axis_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for host-owned per-slot arrays the tick takes as plain
+    arguments (the hybrid page table (S, B) and lengths (S,)): leading
+    slot axis over data."""
+    return NamedSharding(mesh, P("data"))
+
+
 def batch_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
     """(B, T) batches: B over (data, fsdp, expert) — expert doubles as a
     pure-DP batch axis for the non-MoE layers — T over seq when SP is on."""

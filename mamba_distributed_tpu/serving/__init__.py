@@ -9,12 +9,20 @@ docs/SERVING.md has the architecture; the short version:
                step threading the mixers' conv/SSM carries
   engine       one compiled decode tick advances all occupied slots;
                admission + budgeted prefill chunks between ticks,
-               no retracing
+               no retracing; optionally mesh-sharded over a
+               serving_mesh's data axis (the shard_slots path)
   scheduler    FCFS queue + request lifecycle (queued -> prefill ->
                decode -> finished)
+  replica      one engine + lifecycle (active/draining/dead) — the
+               router's placement unit
+  router       data-parallel serving fabric front end: least-loaded
+               placement over N replicas, drain, failover with replay
+               dedup (docs/SERVING.md "Multi-host serving")
 """
 
 from mamba_distributed_tpu.serving.engine import ServingEngine
+from mamba_distributed_tpu.serving.replica import EngineReplica, ReplicaState
+from mamba_distributed_tpu.serving.router import RequestRouter
 from mamba_distributed_tpu.serving.prefill import (
     ChunkPlan,
     chunked_prefill,
@@ -31,9 +39,12 @@ from mamba_distributed_tpu.serving.state_cache import evict, init_pool, insert
 
 __all__ = [
     "ChunkPlan",
+    "EngineReplica",
     "FCFSScheduler",
     "GenerationRequest",
     "GenerationResult",
+    "ReplicaState",
+    "RequestRouter",
     "RequestStatus",
     "ServingEngine",
     "TokenEvent",

@@ -53,6 +53,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket, pad_to_bucket
 from mamba_distributed_tpu.obs import NULL_TRACER, StreamingHistogram
@@ -95,10 +97,11 @@ def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "k_max", "steps"), donate_argnums=(1,)
+    jax.jit, static_argnames=("cfg", "k_max", "steps", "mesh"),
+    donate_argnums=(1,),
 )
 def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
-          cfg: ModelConfig, k_max: int, steps: int):
+          cfg: ModelConfig, k_max: int, steps: int, mesh=None):
     """Advance every slot ``steps`` tokens.  Returns (pool', tokens
     (steps, S), emitted (steps, S), done (steps, S)) — ``emitted[j, s]``
     marks a real token (slot live at sub-step j), ``done[j, s]`` the
@@ -128,6 +131,27 @@ def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
     pad_mask = vocab_pad_mask(cfg)
     col = jnp.arange(k_max)[None, :]
     hybrid = tbl is not None
+    if mesh is not None:
+        # the shard_slots path (static ``mesh``, a serving_mesh): pin
+        # the slot/page state — and the host-owned per-slot tick inputs
+        # — to their data-axis layout so the batched lm_step partitions
+        # its batch axis instead of decaying to one device, whatever
+        # the between-ticks insert/evict propagation concluded
+        from mamba_distributed_tpu.parallel.sharding import (
+            slot_axis_sharding,
+            slot_pool_shardings,
+        )
+
+        pool = jax.lax.with_sharding_constraint(
+            pool, slot_pool_shardings(pool, mesh)
+        )
+        if hybrid:
+            tbl = jax.lax.with_sharding_constraint(
+                tbl, slot_axis_sharding(mesh)
+            )
+            lengths = jax.lax.with_sharding_constraint(
+                lengths, slot_axis_sharding(mesh)
+            )
 
     def one(carry, _):
         pool, lengths = carry
@@ -221,6 +245,15 @@ class ServingEngine:
         (``serving_admit`` / ``serving_tick``); default NULL_TRACER
         (off).  Strictly host-side: enabling it adds zero device syncs
         and zero jit traces (pinned by tests/test_obs.py).
+      mesh: a ``parallel/mesh.serving_mesh`` — the shard_slots path.
+        Slot/page state and the tick's batch axis partition over the
+        mesh's data axis via NamedSharding (params replicated), so one
+        engine's pool spans every device in the mesh; ``capacity`` must
+        divide over the shards.  None (default) builds one from
+        ``cfg.serving_data_shards`` when that knob is > 1, else the
+        pool stays single-device.  Host bookkeeping follows the device
+        layout: a slot resident in data-shard d draws KV pages only
+        from shard d's contiguous page range (state_cache.PagePool).
 
     Prefill buckets are the module defaults of inference/bucketing.py —
     deliberately not a knob, so the engine and a solo ``generate()``
@@ -239,6 +272,7 @@ class ServingEngine:
         retain_results: bool = True,
         metrics: ServingMetrics | None = None,
         tracer=NULL_TRACER,
+        mesh=None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -251,14 +285,42 @@ class ServingEngine:
         if prefill_tokens_per_tick < 0:
             raise ValueError("prefill_tokens_per_tick must be >= 0 "
                              "(0 => unbounded)")
+        if mesh is None and cfg.serving_data_shards > 1:
+            from mamba_distributed_tpu.parallel.mesh import serving_mesh
+
+            mesh = serving_mesh(cfg.serving_data_shards)
+        self.mesh = mesh
+        self.num_shards = 1 if mesh is None else int(mesh.shape["data"])
+        if capacity % self.num_shards:
+            raise ValueError(
+                f"capacity={capacity} must divide over "
+                f"serving_data_shards={self.num_shards} (each data shard "
+                f"holds capacity/shards slot rows)"
+            )
         self.cfg = cfg
         self.capacity = capacity
         self.max_top_k = max_top_k
         self.tokens_per_tick = tokens_per_tick
         self.prefill_tokens_per_tick = prefill_tokens_per_tick
         self.retain_results = retain_results
-        self.pool = state_cache.init_pool(cfg, capacity)  # validates cfg
+        self.pool = state_cache.init_pool(  # validates cfg
+            cfg, capacity, self.num_shards
+        )
         self._params = cast_decode_params(params, cfg=cfg)
+        if mesh is not None:
+            from mamba_distributed_tpu.parallel.sharding import (
+                slot_pool_shardings,
+            )
+
+            # weights replicated, slot/page state partitioned over the
+            # data axis — the layout every subsequent insert/evict/tick
+            # inherits (and the tick re-asserts via its constraints)
+            self._params = jax.device_put(
+                self._params, NamedSharding(mesh, P())
+            )
+            self.pool = jax.device_put(
+                self.pool, slot_pool_shardings(self.pool, mesh)
+            )
         self.scheduler = FCFSScheduler()
         self.metrics = metrics or ServingMetrics(capacity)
         self.tracer = tracer
@@ -274,7 +336,9 @@ class ServingEngine:
         self.hybrid = bool(cfg.attn_layer_idx)
         if self.hybrid:
             self.page_pool = state_cache.PagePool(
-                state_cache.hybrid_pool_pages(cfg, capacity)
+                state_cache.hybrid_pool_pages(cfg, capacity,
+                                              self.num_shards),
+                num_shards=self.num_shards,
             )
             self._page_tbl = np.zeros(
                 (capacity, cfg.kv_pages_per_slot), np.int32
@@ -310,18 +374,36 @@ class ServingEngine:
                     f"the request"
                 )
             need_pages = attention_page_count(self.cfg, need)
-            if need_pages > self.page_pool.num_pages:
+            if need_pages > self._max_shard_pages():
                 # an oversubscribed pool (kv_pool_pages < slots * pages)
-                # may be smaller than one slot's budget: admission waits
-                # for frees, so a request bigger than the WHOLE pool
-                # would stall the queue forever — reject it up front
+                # may be smaller than one slot's budget — and a SHARDED
+                # pool confines each slot to its own shard's page range:
+                # admission waits for frees, so a request bigger than
+                # any shard could EVER free would stall the queue
+                # forever — reject it up front (the same check guards
+                # _admit for requests that bypass submit)
                 raise ValueError(
                     f"hybrid request needs {need_pages} KV pages but the "
-                    f"page pool only has {self.page_pool.num_pages} "
-                    f"(cfg.kv_pool_pages); it could never be admitted"
+                    f"page pool's widest shard only holds "
+                    f"{self._max_shard_pages()} "
+                    f"({self.page_pool.num_pages} total over "
+                    f"{self.num_shards} shard(s); cfg.kv_pool_pages); "
+                    f"it could never be admitted"
                 )
         tracked = self.scheduler.submit(request)
         return tracked.request_id
+
+    def _slot_shard(self, slot: int) -> int:
+        """Which data shard holds ``slot``'s pool rows (NamedSharding
+        partitions the slot axis contiguously)."""
+        return slot * self.num_shards // self.capacity
+
+    def _max_shard_pages(self) -> int:
+        """The most KV pages any one shard could EVER have free — the
+        upper bound on a single request's reservation (each slot draws
+        only from its own shard's range)."""
+        return max(self.page_pool.shard_capacity(d)
+                   for d in range(self.num_shards))
 
     def _release_pages(self, slot: int, tracked: _Tracked) -> None:
         """Recycle a slot's KV pages (evict/failure): return them to the
@@ -351,10 +433,41 @@ class ServingEngine:
             n_pages = attention_page_count(
                 self.cfg, len(r.prompt_ids) + r.max_new_tokens
             )
-            if n_pages > self.page_pool.free_pages:
+            if n_pages > self._max_shard_pages():
+                # DEADLOCK check: free + in-flight reservations is all a
+                # shard can ever hold, so this reservation could never
+                # be satisfied by future evictions — waiting would stall
+                # the queue forever.  submit() rejects such requests up
+                # front; this guards ones fed past it (e.g. straight
+                # into the scheduler).  The request is DROPPED, not
+                # requeued: requeueing would park the poison request at
+                # the queue head and re-raise on every subsequent
+                # step(), starving everything behind it.
+                raise RuntimeError(
+                    f"request {tracked.request_id} needs {n_pages} KV "
+                    f"pages but no shard's pool exceeds "
+                    f"{self._max_shard_pages()} pages even with every "
+                    f"in-flight reservation evicted "
+                    f"({self.page_pool.num_pages} usable pages over "
+                    f"{self.num_shards} shard(s)) — it can never be "
+                    f"admitted and has been dropped from the queue; "
+                    f"raise cfg.kv_pool_pages or split the request"
+                )
+            # first free slot whose shard can cover the reservation (a
+            # sharded pool confines each slot to its shard's pages;
+            # unsharded pools have one shard, preserving FCFS slot order)
+            slot = next(
+                (s for s in self._free
+                 if n_pages <= self.page_pool.free_pages_in(
+                     self._slot_shard(s))),
+                None,
+            )
+            if slot is None:
                 self.scheduler.requeue(tracked)
                 return False
-        slot = self._free.pop(0)
+            self._free.remove(slot)
+        else:
+            slot = self._free.pop(0)
         tracked.status = RequestStatus.PREFILL
         plan = plan_chunks(len(r.prompt_ids),
                            self.cfg.effective_prefill_chunk_tokens,
@@ -382,7 +495,9 @@ class ServingEngine:
                 tracked.chunks_done = 0
                 tracked.prefill_dt = 0.0
                 if self.hybrid:
-                    tracked.pages = self.page_pool.alloc(n_pages)
+                    tracked.pages = self.page_pool.alloc(
+                        n_pages, self._slot_shard(slot)
+                    )
                     self._page_allocs += n_pages
                     self._page_tbl[slot] = 0
                     self._page_tbl[slot, :n_pages] = tracked.pages
@@ -511,14 +626,55 @@ class ServingEngine:
             raise
         return budget_left
 
+    # chunk grants a slot can be passed over in a row before it outranks
+    # SRPT's shortest-remaining rule (the starvation guard)
+    SRPT_STARVATION_GRANTS = 4
+
+    def _pick_prefill_slot(self) -> int:
+        """Which in-flight partial prefill gets the next chunk grant.
+
+        ``cfg.prefill_schedule == "rr"`` takes the rotation head —
+        ``_advance_prefill`` moves a still-partial slot to the back, so
+        repeatedly granting the head IS the round-robin PR 4 pinned.
+        ``"srpt"`` grants the slot with the fewest REMAINING chunks
+        (shortest-remaining-processing-time: a nearly-done prompt
+        reaches its first token before a fresh long one begins, which
+        minimizes mean TTFT across concurrent prefills), except that a
+        slot passed over ``SRPT_STARVATION_GRANTS`` times in a row gets
+        the grant regardless — a stream of short arrivals can't starve
+        a long prompt indefinitely.  Ties break toward the prefill
+        queue head (rotation order: a granted-but-partial slot moves to
+        the back, so among tied slots the one granted least recently
+        wins)."""
+        queue = self._prefill_queue
+        if self.cfg.prefill_schedule != "srpt" or len(queue) == 1:
+            self._slots[queue[0]].prefill_skipped = 0  # a grant is a grant
+            return queue[0]
+        starved = [s for s in queue
+                   if (self._slots[s].prefill_skipped
+                       >= self.SRPT_STARVATION_GRANTS)]
+        if starved:
+            pick = starved[0]
+        else:
+            pick = min(queue, key=lambda s: (
+                self._slots[s].plan.n_chunks - self._slots[s].chunks_done
+            ))
+        for s in queue:
+            if s != pick:
+                self._slots[s].prefill_skipped += 1
+        self._slots[pick].prefill_skipped = 0
+        return pick
+
     def _prefill_phase(self) -> tuple[float, int]:
         """Between-ticks prefill work: admit what fits, then spend the
-        chunk budget ROUND-ROBIN across in-flight partial prefills —
-        one chunk each per pass, oldest first within a pass — so a
-        second long prompt makes proportional progress instead of
-        waiting for the first to drain (FCFS head-of-line blocking on
-        TTFT).  At least one chunk runs per step even when the budget
-        is smaller than a chunk, so progress is guaranteed.
+        chunk budget one grant at a time across in-flight partial
+        prefills — ``_pick_prefill_slot`` chooses each grant (rotation
+        under ``cfg.prefill_schedule="rr"``, shortest-remaining-first
+        with a starvation guard under ``"srpt"``) — so a second long
+        prompt makes progress instead of waiting for the first to
+        drain (FCFS head-of-line blocking on TTFT).  At least one
+        chunk runs per step even when the budget is smaller than a
+        chunk, so progress is guaranteed.
         Returns (host seconds spent — the tick's ``prefill_stall`` —
         and chunk tokens dispatched)."""
         if not ((self._free and self.scheduler.depth) or self._prefill_queue):
@@ -536,15 +692,8 @@ class ServingEngine:
         left = float("inf") if budget == 0 else float(budget)
         chunks_run = 0
         while self._prefill_queue and (left > 0 or chunks_run == 0):
-            ran_this_pass = False
-            for slot in list(self._prefill_queue):
-                if chunks_run > 0 and left <= 0:
-                    break
-                left = self._advance_prefill(slot, left)
-                chunks_run += 1
-                ran_this_pass = True
-            if not ran_this_pass:
-                break
+            left = self._advance_prefill(self._pick_prefill_slot(), left)
+            chunks_run += 1
         self._pending_chunk_ms += (
             self.metrics.prefill_chunk_time_s - chunk_s0
         ) * 1000
@@ -599,6 +748,7 @@ class ServingEngine:
             self.pool, tokens, emitted, done = _tick(
                 self._params, self.pool, *tick_kv, cfg=self.cfg,
                 k_max=self.max_top_k, steps=self.tokens_per_tick,
+                mesh=self.mesh,
             )
             tokens = np.asarray(tokens)  # (steps, S) — the host sync point
             emitted = np.asarray(emitted)

@@ -1,0 +1,106 @@
+"""One engine replica in the data-parallel serving fabric.
+
+A replica is the router's placement unit (serving/router.py): a full
+``ServingEngine`` — slot pool, page pool, scheduler, optionally
+mesh-sharded over a ``parallel/mesh.serving_mesh`` — plus the lifecycle
+flag and load signals the router places against.  Weights are shared
+read-only across replicas (engines never donate params), so N replicas
+cost N slot pools, not N param copies.
+
+Lifecycle: ACTIVE -> DRAINING (graceful retire: finish everything
+already submitted, accept nothing new) or -> DEAD (failover: the
+ROUTER requeues the replica's unfinished requests elsewhere — a dead
+replica is never trusted to report anything, and is never stepped
+again).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from mamba_distributed_tpu.obs import NULL_TRACER
+from mamba_distributed_tpu.serving.engine import ServingEngine
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+
+class ReplicaState(enum.Enum):
+    ACTIVE = "active"      # accepting placements and ticking
+    DRAINING = "draining"  # finishing what it holds; no new placements
+    DEAD = "dead"          # failed over; never stepped again
+
+
+class EngineReplica:
+    """One ``ServingEngine`` + the host-side routing state around it.
+
+    The router reads ``place_cost()`` for least-loaded placement,
+    ``drain()`` to retire the replica gracefully, and ``mark_dead()``
+    on failure (requeueing is the router's job — it owns the request
+    records; the replica only stops accepting and ticking).
+    """
+
+    def __init__(self, replica_id: int, params: dict, cfg, *, mesh=None,
+                 metrics: ServingMetrics | None = None, tracer=NULL_TRACER,
+                 **engine_kw):
+        self.replica_id = replica_id
+        if metrics is None:
+            metrics = ServingMetrics(engine_kw.get("capacity", 8),
+                                     replica=replica_id)
+        # every serving_tick/request record this replica emits carries
+        # its id, so a shared jsonl stream splits back per replica
+        metrics.replica = replica_id
+        self.engine = ServingEngine(params, cfg, metrics=metrics,
+                                    tracer=tracer, mesh=mesh, **engine_kw)
+        self.state = ReplicaState.ACTIVE
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def accepting(self) -> bool:
+        return self.state is ReplicaState.ACTIVE
+
+    @property
+    def alive(self) -> bool:
+        return self.state is not ReplicaState.DEAD
+
+    @property
+    def pending(self) -> int:
+        """Unfinished requests resident here (0 once dead: whatever it
+        held is the router's to requeue)."""
+        return self.engine.pending if self.alive else 0
+
+    def drain(self) -> None:
+        """Stop accepting placements; in-flight (and already-queued)
+        requests run to completion via normal ``step()`` calls."""
+        if self.state is ReplicaState.ACTIVE:
+            self.state = ReplicaState.DRAINING
+
+    def mark_dead(self) -> None:
+        self.state = ReplicaState.DEAD
+
+    # ---------------------------------------------------------- placement
+
+    def place_cost(self, request=None) -> float:
+        """Least-loaded placement cost (lower is better): queued +
+        resident work per slot, plus KV page-pool pressure for hybrid
+        engines — a replica whose pages are nearly gone would make a
+        new hybrid request WAIT at admission even with slots free, so
+        free pages weigh in next to queue depth."""
+        eng = self.engine
+        load = (eng.scheduler.depth + len(eng._slots)) / eng.capacity
+        if eng.hybrid:
+            load += eng.page_pool.pages_in_use / eng.page_pool.num_pages
+        return load
+
+    def submit(self, request) -> int:
+        """Place a request here; returns the ENGINE-local request id
+        (the router maps it back to its global id)."""
+        if not self.accepting:
+            raise RuntimeError(
+                f"replica {self.replica_id} is {self.state.value}, not "
+                f"accepting placements"
+            )
+        return self.engine.submit(request)
+
+    def step(self):
+        """One engine iteration (no-op once dead)."""
+        return self.engine.step() if self.alive else []

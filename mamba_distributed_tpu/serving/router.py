@@ -1,0 +1,267 @@
+"""Request router: the front end of the data-parallel serving fabric.
+
+The router owns global admission and places requests onto N engine
+replicas (serving/replica.py) — each a full ServingEngine whose slot
+pool may itself shard over a ``serving_mesh``'s data axis — so one
+serving endpoint spans many engines and, through the sharded pool,
+every device in a pod slice.  The host side of the layout TPU serving
+systems put in front of ragged paged decode ("Ragged Paged Attention",
+PAPERS.md), with the device side a pjit-style sharding-annotation
+problem ("Scalable Training of Language Models using JAX pjit and
+TPUv4").
+
+Placement is LEAST-LOADED: each submit picks the accepting replica
+with the lowest ``place_cost`` (queued + resident work per slot, plus
+KV page-pool pressure for hybrids), stamped as a ``serving_route``
+span.  ``drain(replica_id)`` retires a replica gracefully — no new
+placements, in-flight requests finish.  ``fail(replica_id)`` is
+failover: the dead replica's unfinished requests REQUEUE onto the
+survivors.
+
+Failover preserves the token contract — no request lost, no duplicate
+tokens — by leaning on the engine parity invariant: a request's stream
+is a pure function of (prompt, key), so the restarted stream on the
+new replica re-derives bit-identical tokens, and the router suppresses
+the indices it already delivered (``_Routed.emitted``).  The consumer
+sees one contiguous stream per request, indistinguishable from a
+failure-free run.
+
+The streaming interface is the engine's own: ``serve()`` yields
+TokenEvents (with ROUTER-global request ids), ``run()`` drains to
+GenerationResults, and per-request streams stay token-for-token
+identical to solo ``generate()`` (tests/test_router.py pins this
+across mamba1/mamba2/hybrid mixes, drain, and failover).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from mamba_distributed_tpu.obs import NULL_TRACER
+from mamba_distributed_tpu.serving.replica import EngineReplica
+from mamba_distributed_tpu.serving.scheduler import (
+    GenerationRequest,
+    GenerationResult,
+    TokenEvent,
+)
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+
+@dataclasses.dataclass
+class _Routed:
+    """Router-side record of one request: where it lives now and how
+    much of its stream the consumer has already seen (the failover
+    replay cursor)."""
+
+    request: GenerationRequest
+    global_id: int
+    replica_id: int | None = None
+    local_id: int | None = None
+    emitted: int = 0  # tokens already streamed to the consumer
+    done: bool = False
+    finish_reason: str | None = None
+    tokens: list = dataclasses.field(default_factory=list)
+
+
+class RequestRouter:
+    """Admission + placement over N engine replicas.
+
+    Args:
+      params: trained fp32 params, shared read-only by every replica.
+      cfg: ModelConfig.  ``cfg.serving_replicas`` is the default replica
+        count; ``cfg.serving_data_shards`` > 1 additionally shards each
+        replica's slot pool over a ``serving_mesh`` (engine arg).
+      num_replicas: overrides ``cfg.serving_replicas``.
+      capacity: slots PER replica.
+      jsonl_path: one shared telemetry stream for the whole fabric —
+        every replica's serving_tick/request records land here stamped
+        with their replica id (``scripts/obs_report.py`` renders the
+        per-replica table).  The router truncates it once at
+        construction; the replicas append.
+      tracer: obs.SpanTracer shared by the router (``serving_route``
+        placement spans) and every replica's engine.
+      retain_results: keep finished GenerationResults in ``.results``
+        (what ``run()`` reads); a long-lived streaming server should
+        pass False and consume TokenEvents.
+      engine_kw: forwarded to every ServingEngine (max_top_k,
+        tokens_per_tick, prefill_tokens_per_tick, mesh, ...).
+    """
+
+    def __init__(self, params: dict, cfg, num_replicas: int | None = None,
+                 capacity: int = 8, *, jsonl_path: str | None = None,
+                 tracer=NULL_TRACER, retain_results: bool = True,
+                 **engine_kw):
+        if num_replicas is None:
+            num_replicas = cfg.serving_replicas
+        if num_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {num_replicas}")
+        self.cfg = cfg
+        self.tracer = tracer
+        self.retain_results = retain_results
+        if jsonl_path:
+            open(jsonl_path, "w").close()  # one fresh stream, all replicas
+        self.replicas: list[EngineReplica] = []
+        for i in range(num_replicas):
+            metrics = ServingMetrics(capacity, jsonl_path=jsonl_path,
+                                     replica=i)
+            if jsonl_path:
+                metrics.preserve_history()  # router already truncated
+            self.replicas.append(EngineReplica(
+                i, params, cfg, metrics=metrics, tracer=tracer,
+                capacity=capacity, retain_results=False, **engine_kw,
+            ))
+        self._routed: dict[int, _Routed] = {}
+        self._by_local: dict[tuple[int, int], _Routed] = {}
+        self._next_id = 0
+        self.results: dict[int, GenerationResult] = {}
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, request: GenerationRequest) -> int:
+        """Admit a request: place it on the least-loaded accepting
+        replica.  Returns the ROUTER-global request id (TokenEvents and
+        ``results`` use it).  Raises if the request is invalid (any
+        replica would reject it) or no replica is accepting."""
+        routed = _Routed(request=request, global_id=self._next_id)
+        self._place(routed)  # raises before the id is ever registered
+        self._next_id += 1
+        self._routed[routed.global_id] = routed
+        return routed.global_id
+
+    def _place(self, routed: _Routed) -> None:
+        """Least-loaded placement (one ``serving_route`` span): lowest
+        ``place_cost`` among accepting replicas, ties to the lowest id."""
+        cands = [r for r in self.replicas if r.accepting]
+        if not cands:
+            raise RuntimeError(
+                "no accepting replicas (all draining or dead); request "
+                "not placed"
+            )
+        cost, rep = min(((r.place_cost(routed.request), r) for r in cands),
+                        key=lambda cr: (cr[0], cr[1].replica_id))
+        attrs = dict(request_id=routed.global_id, replica=rep.replica_id,
+                     cost=round(cost, 4),
+                     queue_depth=rep.engine.scheduler.depth)
+        if rep.engine.hybrid:
+            attrs["free_pages"] = rep.engine.page_pool.free_pages
+        with self.tracer.span("serving_route", **attrs):
+            local_id = rep.submit(routed.request)
+        routed.replica_id, routed.local_id = rep.replica_id, local_id
+        self._by_local[(rep.replica_id, local_id)] = routed
+
+    # ------------------------------------------------------------ lifecycle
+
+    def drain(self, replica_id: int) -> None:
+        """Gracefully retire a replica: no new placements; everything it
+        already holds finishes through normal stepping."""
+        self.replicas[replica_id].drain()
+
+    def fail(self, replica_id: int) -> list[int]:
+        """Failover: mark the replica dead and requeue its unfinished
+        requests onto the survivors.  Each restarted stream re-derives
+        the same tokens from the same key (the parity contract), and
+        ``step()`` suppresses the indices already delivered — the
+        consumer loses nothing and sees nothing twice.  Returns the
+        requeued global ids.  Raises BEFORE any request is moved when
+        nothing is accepting — no half-failed-over state."""
+        self.replicas[replica_id].mark_dead()
+        victims = [r for r in self._routed.values()
+                   if not r.done and r.replica_id == replica_id]
+        if victims and not any(r.accepting for r in self.replicas):
+            raise RuntimeError(
+                f"replica {replica_id} died holding "
+                f"{len(victims)} unfinished request(s) "
+                f"{sorted(v.global_id for v in victims)} but no replica "
+                f"is accepting (all draining or dead) — nothing to fail "
+                f"over to"
+            )
+        moved = []
+        for routed in victims:
+            self._by_local.pop((routed.replica_id, routed.local_id), None)
+            self._place(routed)
+            moved.append(routed.global_id)
+        return moved
+
+    # ------------------------------------------------------------- serving
+
+    @property
+    def pending(self) -> int:
+        """Requests admitted but not yet finished, fabric-wide."""
+        return sum(1 for r in self._routed.values() if not r.done)
+
+    def step(self) -> list[TokenEvent]:
+        """One fabric iteration: step every live replica with work,
+        translate its events to global ids, advance replay cursors.
+        Finished requests are pruned from the routing tables (and their
+        token buffers only ever exist under ``retain_results``), so a
+        long-lived streaming server's memory stays bounded by in-flight
+        work, not by everything ever served."""
+        events: list[TokenEvent] = []
+        for rep in self.replicas:
+            if not rep.alive or rep.pending == 0:
+                continue
+            for ev in rep.step():
+                routed = self._by_local.get((rep.replica_id, ev.request_id))
+                if routed is None or routed.done:
+                    continue
+                if ev.index < routed.emitted:
+                    # failover replay of a token the consumer already
+                    # has — identical by the parity contract; drop it
+                    continue
+                if self.retain_results:
+                    routed.tokens.append(ev.token)
+                routed.emitted += 1
+                if ev.done:
+                    routed.done = True
+                    routed.finish_reason = ev.finish_reason
+                    if self.retain_results:
+                        self.results[routed.global_id] = GenerationResult(
+                            request_id=routed.global_id,
+                            prompt_ids=routed.request.prompt_ids,
+                            new_tokens=np.asarray(routed.tokens, np.int32),
+                            finish_reason=ev.finish_reason,
+                        )
+                    self._by_local.pop((rep.replica_id, ev.request_id),
+                                       None)
+                    del self._routed[routed.global_id]
+                events.append(TokenEvent(
+                    routed.global_id, ev.token, routed.emitted - 1,
+                    routed.done, routed.finish_reason,
+                ))
+        if not events and self.pending and not any(
+            rep.alive and rep.pending for rep in self.replicas
+        ):
+            # every pending request is stranded on a dead replica (a
+            # swallowed fail() error) — serve() would busy-loop forever
+            raise RuntimeError(
+                f"{self.pending} pending request(s) are stranded on dead "
+                f"replicas and can never finish; fail() the dead "
+                f"replica(s) while survivors are still accepting"
+            )
+        return events
+
+    def serve(self, requests=()):  # -> Iterator[TokenEvent]
+        """Stream TokenEvents (global ids) until the fabric drains; more
+        requests may be submitted between yields."""
+        for r in requests:
+            self.submit(r)
+        while self.pending:
+            yield from self.step()
+
+    def run(self, requests=()) -> list[GenerationResult]:
+        """Submit ``requests``, drain the fabric, return results in
+        submission order."""
+        if not self.retain_results:
+            raise ValueError("run() needs retain_results=True; stream "
+                             "via serve() instead")
+        ids = [self.submit(r) for r in requests]
+        for _ in self.serve():
+            pass
+        return [self.results[i] for i in ids]
+
+    def summary(self) -> dict:
+        """Per-replica metrics summaries keyed by replica id."""
+        return {r.replica_id: r.engine.metrics.summary()
+                for r in self.replicas}

@@ -114,6 +114,9 @@ class _Tracked:
     plan: object | None = None
     chunks_done: int = 0
     prefill_dt: float = 0.0
+    # consecutive chunk grants this slot was passed over for (the SRPT
+    # starvation guard, serving/engine._pick_prefill_slot)
+    prefill_skipped: int = 0
     # hybrid paged KV: physical page ids reserved for this request at
     # admission (prompt + max_new worth), recycled on evict/failure
     # (serving/engine.py page allocator)
@@ -160,6 +163,7 @@ class FCFSScheduler:
         tracked.plan = None
         tracked.chunks_done = 0
         tracked.prefill_dt = 0.0
+        tracked.prefill_skipped = 0
         self._queue.appendleft(tracked)
 
     @property

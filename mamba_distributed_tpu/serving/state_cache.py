@@ -73,50 +73,134 @@ from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.models.lm import init_lm_blocks_state
 
 
+def page_shard_ranges(
+    num_pages: int, num_shards: int
+) -> list[tuple[int, int]]:
+    """Per-shard usable page-id ranges ``[lo, hi)`` mirroring the DEVICE
+    layout of a page pool sharded over the data axis: the (P+1)-row
+    page arrays (trash page 0 included) partition contiguously, so
+    shard d owns rows ``[d*(P+1)/n, (d+1)*(P+1)/n)``, minus row 0 —
+    the trash page, which lives in shard 0 and is never handed out.
+    Requires ``(num_pages + 1) % num_shards == 0`` (``hybrid_pool_pages``
+    rounds the pool up to guarantee it), so host bookkeeping and the
+    NamedSharding tile boundaries can never disagree about which shard
+    a physical page lives on."""
+    rows = num_pages + 1
+    if rows % num_shards:
+        raise ValueError(
+            f"page array of {rows} rows (pages + trash) does not divide "
+            f"over {num_shards} shards"
+        )
+    per = rows // num_shards
+    if per < 2:
+        # shard 0's tile is the trash page (+ per-2 more): with per == 1
+        # it has ZERO usable pages, silently killing every slot resident
+        # there — refuse the configuration instead
+        raise ValueError(
+            f"{num_pages} usable pages over {num_shards} shards leaves "
+            f"shard 0 with none (its tile is the trash page); raise "
+            f"cfg.kv_pool_pages or lower serving_data_shards"
+        )
+    return [(max(1, d * per), (d + 1) * per) for d in range(num_shards)]
+
+
 class PagePool:
-    """Host-side KV page allocator (hybrid pools): a free list over
+    """Host-side KV page allocator (hybrid pools): free lists over
     physical pages [1, P) — page 0 is the trash page and never handed
     out.  Purely bookkeeping; the page *arrays* live in the pool pytree
-    and are written by the compiled chunk/tick steps."""
+    and are written by the compiled chunk/tick steps.
 
-    def __init__(self, num_pages: int):
+    With ``num_shards > 1`` (the mesh-sharded slot pool), the usable
+    pages partition into per-shard free lists along the SAME contiguous
+    boundaries as the page arrays' NamedSharding over the data axis
+    (``page_shard_ranges``): a slot resident in data-shard d allocates
+    only from shard d's pages, so every slot's KV reads and writes stay
+    on the devices that hold its rows of the pool."""
+
+    def __init__(self, num_pages: int, num_shards: int = 1):
         if num_pages < 1:
             raise ValueError(f"need >= 1 usable page, got {num_pages}")
+        if num_shards < 1:
+            raise ValueError(f"need >= 1 shard, got {num_shards}")
         self.num_pages = num_pages
-        self._free = list(range(1, num_pages + 1))
+        self.num_shards = num_shards
+        self._ranges = page_shard_ranges(num_pages, num_shards)
+        self._free_lists = [list(range(lo, hi)) for lo, hi in self._ranges]
+
+    @property
+    def _free(self) -> list[int]:
+        """Flat sorted view of every free page (shard-agnostic callers
+        and tests; per-shard state lives in ``_free_lists``)."""
+        return sorted(p for lst in self._free_lists for p in lst)
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return sum(len(lst) for lst in self._free_lists)
+
+    def free_pages_in(self, shard: int) -> int:
+        return len(self._free_lists[shard])
+
+    def shard_capacity(self, shard: int) -> int:
+        """Usable pages shard ``shard`` could EVER have free (its range
+        size) — the bound the admission deadlock check tests against."""
+        lo, hi = self._ranges[shard]
+        return hi - lo
 
     @property
     def pages_in_use(self) -> int:
-        return self.num_pages - len(self._free)
+        return self.num_pages - self.free_pages
 
-    def alloc(self, n: int) -> list[int]:
-        """Reserve ``n`` pages, or raise if the pool can't cover them
-        (callers check ``free_pages`` first — admission just waits)."""
-        if n > len(self._free):
+    def _owner(self, page: int) -> int:
+        if not 1 <= page <= self.num_pages:
+            raise ValueError(f"page {page} outside every shard range")
+        # ranges are uniform contiguous tiles of the (P+1)-row page axis
+        return page // ((self.num_pages + 1) // self.num_shards)
+
+    def alloc(self, n: int, shard: int = 0) -> list[int]:
+        """Reserve ``n`` pages from ``shard``'s range, or raise if it
+        can't cover them (callers check ``free_pages_in`` first —
+        admission just waits)."""
+        lst = self._free_lists[shard]
+        if n > len(lst):
             raise RuntimeError(
-                f"KV page pool exhausted: want {n}, have {len(self._free)}"
+                f"KV page pool exhausted: want {n}, shard {shard} has "
+                f"{len(lst)}"
             )
-        ids, self._free = self._free[:n], self._free[n:]
+        ids, self._free_lists[shard] = lst[:n], lst[n:]
         return ids
 
     def free(self, ids: list[int]) -> None:
-        self._free.extend(ids)
-        self._free.sort()  # deterministic reuse order
+        touched = set()
+        for p in ids:
+            d = self._owner(p)
+            self._free_lists[d].append(p)
+            touched.add(d)
+        for d in touched:
+            self._free_lists[d].sort()  # deterministic reuse order
 
 
-def hybrid_pool_pages(cfg: ModelConfig, capacity: int) -> int:
+def hybrid_pool_pages(
+    cfg: ModelConfig, capacity: int, num_shards: int = 1
+) -> int:
     """Usable page count of a serving pool (excluding the trash page):
     ``cfg.kv_pool_pages``, or auto = every slot can run to its full
-    ``kv_slot_tokens`` budget simultaneously."""
-    return cfg.kv_pool_pages or capacity * cfg.kv_pages_per_slot
+    ``kv_slot_tokens`` budget simultaneously.  With a sharded pool the
+    count rounds UP so the page arrays' (P+1)-row page axis divides
+    evenly over the data axis — NamedSharding can't place uneven tiles,
+    and the extra pages are usable capacity, never waste."""
+    pages = cfg.kv_pool_pages or capacity * cfg.kv_pages_per_slot
+    if num_shards > 1 and (pages + 1) % num_shards:
+        pages += num_shards - (pages + 1) % num_shards
+    return pages
 
 
-def init_pool(cfg: ModelConfig, capacity: int) -> dict:
-    """Allocate an empty slot pool for ``capacity`` concurrent requests."""
+def init_pool(cfg: ModelConfig, capacity: int, num_shards: int = 1) -> dict:
+    """Allocate an empty slot pool for ``capacity`` concurrent requests.
+
+    ``num_shards`` sizes a hybrid pool's page count for a mesh-sharded
+    batch axis (``hybrid_pool_pages`` rounding) — the pytree itself is
+    layout-agnostic; the engine device_puts it with
+    ``parallel/sharding.slot_pool_shardings``."""
     if capacity < 1:
         raise ValueError(f"capacity must be >= 1, got {capacity}")
     S = capacity
@@ -133,7 +217,7 @@ def init_pool(cfg: ModelConfig, capacity: int) -> dict:
             init_attention_state,
         )
 
-        n_pages = hybrid_pool_pages(cfg, capacity)
+        n_pages = hybrid_pool_pages(cfg, capacity, num_shards)
         # init_attention_state builds (1 + batch*W) pages; ask for the
         # pool's page count directly via batch=n_pages, W=1-page slots
         pages = [

@@ -122,11 +122,19 @@ class ServingMetrics:
     and calls ``record_queue_wait``/``record_ttft``/``record_itl``;
     ``record_request`` additionally appends one ``"kind": "request"``
     jsonl record per finished request when ``jsonl_path`` is set.
+
+    ``replica`` (the data-parallel serving fabric, serving/router.py)
+    stamps every serving_tick/request record with the owning replica's
+    id, so one shared jsonl stream splits back into per-replica tables
+    (scripts/obs_report.py renders queue depth, occupancy, and
+    free-page gauges per replica).
     """
 
-    def __init__(self, capacity: int, jsonl_path: str | None = None):
+    def __init__(self, capacity: int, jsonl_path: str | None = None,
+                 replica: int | None = None):
         self.capacity = capacity
         self.jsonl_path = jsonl_path
+        self.replica = replica
         self.ticks = 0
         self.decode_tokens = 0
         self.decode_time_s = 0.0
@@ -216,7 +224,10 @@ class ServingMetrics:
         (``"kind": "request"``) when a stream is configured."""
         self.finished_requests += 1
         if self.jsonl_path:
-            self._write_jsonl({"kind": "request", **record})
+            rec = {"kind": "request", **record}
+            if self.replica is not None:
+                rec.setdefault("replica", self.replica)
+            self._write_jsonl(rec)
 
     def record_tick(
         self, occupied: int, queue_depth: int, tokens_emitted: int,
@@ -245,6 +256,7 @@ class ServingMetrics:
         record = {
             "kind": "serving_tick", "tick": self.ticks,
             "occupied": occupied, "capacity": self.capacity,
+            **({} if self.replica is None else {"replica": self.replica}),
             "queue_depth": queue_depth,
             "tokens_emitted": tokens_emitted,
             "tick_ms": round(dt_s * 1000, 3),

@@ -25,6 +25,14 @@ readable bench artifact; BENCH_SERVING.json collects these).  Hybrid
 presets (e.g. BENCH_PRESET=hybrid-tiny) serve through the paged KV pool
 and report its page gauges.
 
+``--replicas N`` drives the data-parallel serving fabric
+(serving/router.py): the same short mix plus a few chunked-prefill
+long prompts routed least-loaded over N engine replicas, reported
+against a single engine on the identical workload
+(``router_vs_single_speedup``); ``SERVE_DATA_SHARDS`` additionally
+shards each replica's slot pool over a ``serving_mesh`` (on CPU,
+combine with ``XLA_FLAGS=--xla_force_host_platform_device_count=K``).
+
 ``--long-prompt`` switches to the head-of-line-blocking workload: a few
 LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
 submitted AHEAD of the usual short mix, and the same workload runs
@@ -149,7 +157,17 @@ def main() -> None:
     ap.add_argument("--long-prompt", action="store_true",
                     help="mixed long+short workload; report short-request "
                          "TTFT p95 with chunked vs one-shot prefill")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="drive the request router over N engine replicas "
+                         "with a mixed short/long workload and report "
+                         "router vs single-engine aggregate decode rate "
+                         "(SERVE_DATA_SHARDS additionally shards each "
+                         "replica's slot pool over a serving_mesh)")
     args = ap.parse_args()
+    if args.long_prompt and args.replicas:
+        ap.error("--long-prompt and --replicas are separate bench modes; "
+                 "pick one (the --replicas workload already mixes long "
+                 "and short prompts)")
 
     import jax
     import jax.numpy as jnp
@@ -183,6 +201,11 @@ def main() -> None:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, prefill_chunk_tokens=chunk_tokens)
+    data_shards = int(os.environ.get("SERVE_DATA_SHARDS", "0"))
+    if data_shards:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, serving_data_shards=data_shards)
     params = jax.jit(lambda k: init_lm_params(k, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     _progress("params initialized")
@@ -237,6 +260,86 @@ def main() -> None:
             "prefill_chunks": summary["prefill_chunks"],
             "prefill_stall_ms": summary["prefill_stall_ms"],
             "latency": summary["latency"],
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
+
+    if args.replicas:
+        from mamba_distributed_tpu.serving import (
+            GenerationRequest,
+            RequestRouter,
+        )
+
+        # mixed short/long: the short mix plus a few chunked-prefill
+        # longs, all routed — the traffic shape the fabric exists for
+        long_count = int(os.environ.get("SERVE_LONG_COUNT", "2"))
+        chunk = cfg.effective_prefill_chunk_tokens
+        long_len = int(os.environ.get(
+            "SERVE_LONG_LEN", str(4 * (chunk or pmax))
+        ))
+        shorts = _workload(rng, n_requests, pmin, pmax, max_new,
+                           cfg.vocab_size)
+        longs = [GenerationRequest(
+            prompt_ids=rng.integers(0, cfg.vocab_size, size=long_len)
+            .astype(np.int32),
+            max_new_tokens=max_new, seed=5000 + i,
+        ) for i in range(long_count)]
+        requests = longs + shorts
+
+        def fresh():
+            # per-run request objects: ids/streams are per-submit
+            return [GenerationRequest(
+                prompt_ids=np.asarray(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens, seed=r.seed,
+            ) for r in requests]
+
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        RequestRouter(params, cfg, num_replicas=args.replicas, **kw).run(
+            fresh())
+        ServingEngine(params, cfg, **kw).run(fresh())
+        _progress("router + single engine warm")
+        router = RequestRouter(params, cfg, num_replicas=args.replicas,
+                               jsonl_path=args.jsonl, **kw)
+        t0 = time.perf_counter()
+        results = router.run(fresh())
+        dt_router = time.perf_counter() - t0
+        router_tokens = sum(len(r.new_tokens) for r in results)
+        _progress(f"router: {router_tokens} tokens in {dt_router:.2f}s")
+        engine = ServingEngine(params, cfg, **kw)
+        t0 = time.perf_counter()
+        single = engine.run(fresh())
+        dt_single = time.perf_counter() - t0
+        single_tokens = sum(len(r.new_tokens) for r in single)
+        assert router_tokens == single_tokens, (router_tokens, single_tokens)
+        _progress(f"single engine: {single_tokens} tokens in {dt_single:.2f}s")
+        per_replica = {
+            str(rid): {
+                "finished_requests": s["finished_requests"],
+                "decode_tokens": s["decode_tokens"],
+                "mean_slot_occupancy": s["mean_slot_occupancy"],
+            }
+            for rid, s in router.summary().items()
+        }
+        record = {
+            "metric": f"router_tokens_per_sec_{preset.replace('-', '_')}",
+            "value": round(router_tokens / dt_router, 1),
+            "unit": "sampled tokens/sec (aggregate across replicas)",
+            "single_engine_tokens_per_sec": round(
+                single_tokens / dt_single, 1),
+            "router_vs_single_speedup": round(dt_single / dt_router, 2),
+            "replicas": args.replicas,
+            "serving_data_shards": cfg.serving_data_shards,
+            "capacity_per_replica": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "requests": len(requests),
+            "long_requests": long_count,
+            "long_prompt_len": long_len,
+            "prompt_len_range": [pmin, pmax],
+            "total_new_tokens": router_tokens,
+            "per_replica": per_replica,
             "device": dev.device_kind,
         }
         if args.jsonl:

@@ -187,6 +187,48 @@ def build_report(events: list[dict]) -> dict:
             "kv_pages": kv_pages,
         }
 
+    # --- per-replica split (the data-parallel serving fabric): tick and
+    # request records stamped with a "replica" id by the router's shared
+    # stream.  Gauges per replica: queue depth, occupancy, free KV pages
+    # (capacity - used; pure-SSM replicas have no page pool -> "-").
+    rep_ticks = [e for e in ticks if e.get("replica") is not None]
+    if rep_ticks:
+        per: dict[int, dict] = {}
+        for e in rep_ticks:
+            d = per.setdefault(e["replica"], {
+                "ticks": 0, "decode_tokens": 0, "occ": [], "queue": [],
+                "kv_free": [],
+            })
+            d["ticks"] += 1
+            d["decode_tokens"] += e.get("tokens_emitted", 0)
+            if e.get("capacity"):
+                d["occ"].append(e["occupied"] / e["capacity"])
+            d["queue"].append(e.get("queue_depth", 0))
+            if e.get("kv_pages_used") is not None:
+                d["kv_free"].append(
+                    (e.get("kv_pages_capacity") or 0) - e["kv_pages_used"]
+                )
+        req_by_rep: dict[int, int] = {}
+        for e in events:
+            if e.get("kind") == "request" and e.get("replica") is not None:
+                req_by_rep[e["replica"]] = req_by_rep.get(e["replica"], 0) + 1
+        report["replicas"] = {
+            rid: {
+                "ticks": d["ticks"],
+                "requests": req_by_rep.get(rid, 0),
+                "decode_tokens": d["decode_tokens"],
+                "mean_occupancy": (
+                    round(sum(d["occ"]) / len(d["occ"]), 4)
+                    if d["occ"] else None
+                ),
+                "peak_queue_depth": max(d["queue"]) if d["queue"] else 0,
+                "min_kv_free_pages": (
+                    min(d["kv_free"]) if d["kv_free"] else None
+                ),
+            }
+            for rid, d in sorted(per.items())
+        }
+
     # --- per-request latency (the serving stream's "request" records)
     reqs = [e for e in events if e.get("kind") == "request"]
     if reqs:
@@ -297,6 +339,17 @@ def format_report(report: dict) -> str:
             rows.append(_pct_row("prefill_stall_ms", s["prefill_stall_ms"]))
         out.append(head + "\n" + _table(
             rows, ["metric", "count", "mean", "p50", "p95", "p99", "max"],
+        ))
+    if "replicas" in report:
+        rows = [
+            [rid, d["requests"], d["ticks"], d["decode_tokens"],
+             _fmt(d["mean_occupancy"]), d["peak_queue_depth"],
+             _fmt(d["min_kv_free_pages"])]
+            for rid, d in report["replicas"].items()
+        ]
+        out.append("== per-replica (serving fabric) ==\n" + _table(
+            rows, ["replica", "requests", "ticks", "decode_tokens",
+                   "mean_occ", "peak_queue", "min_kv_free"]
         ))
     if "requests" in report:
         r = report["requests"]

@@ -440,3 +440,76 @@ def test_budget_round_robins_across_concurrent_longs():
         want = solo(params, cfg, rand_prompt(53, seed=seed),
                     jax.random.PRNGKey(key), max_new_tokens=3)
         assert eng.results[rid].new_tokens.tolist() == want
+
+
+def test_srpt_nearly_done_prompt_finishes_before_fresh_long():
+    """``prefill_schedule="srpt"``: a prompt with one chunk left gets the
+    remaining grants ahead of a freshly-admitted much longer prompt —
+    the nearly-done request reaches its first token while the fresh one
+    hasn't prefilled a single chunk (round-robin would alternate and
+    delay it; the PR-5 SRPT satellite)."""
+    cfg = tiny_cfg(prefill_schedule="srpt")  # budget 16 == 1 grant/step
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1)
+    ra = eng.submit(GenerationRequest(prompt_ids=rand_prompt(53, seed=1),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(0)))
+    eng.step()
+    eng.step()  # A (4 chunks) now has 2 done, 2 remaining
+    by_rid = {t.request_id: t for t in eng._slots.values()}
+    assert by_rid[ra].chunks_done == 2
+    rb = eng.submit(GenerationRequest(prompt_ids=rand_prompt(128, seed=2),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(1)))
+    # A's 2 remaining grants outrank B's fresh 8: A streams its first
+    # token before B has prefilled ANYTHING
+    events = []
+    while not any(ev.request_id == ra for ev in events):
+        events = eng.step()
+        by_rid.update({t.request_id: t for t in eng._slots.values()})
+    assert by_rid[rb].chunks_done == 0
+    while eng.pending:
+        eng.step()
+    for rid, n, seed, key in ((ra, 53, 1, 0), (rb, 128, 2, 1)):
+        want = solo(params, cfg, rand_prompt(n, seed=seed),
+                    jax.random.PRNGKey(key), max_new_tokens=3)
+        assert eng.results[rid].new_tokens.tolist() == want
+
+
+def test_srpt_starvation_guard_grants_passed_over_prompt():
+    """A long prompt passed over ``SRPT_STARVATION_GRANTS`` times in a
+    row takes the next grant even when a shorter prefill is resident —
+    a stream of short arrivals can't starve it indefinitely."""
+    cfg = tiny_cfg(prefill_schedule="srpt")
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=4, tokens_per_tick=1)
+    assert eng.SRPT_STARVATION_GRANTS == 4
+    ra = eng.submit(GenerationRequest(prompt_ids=rand_prompt(128, seed=1),
+                                      max_new_tokens=2,
+                                      key=jax.random.PRNGKey(0)))
+    eng.step()  # A admitted alone: first grant is its
+    shorts = [eng.submit(GenerationRequest(
+        prompt_ids=rand_prompt(21, seed=10 + i), max_new_tokens=2,
+        key=jax.random.PRNGKey(10 + i))) for i in range(2)]
+    by_rid = {t.request_id: t for t in eng._slots.values()}
+    for _ in range(4):  # S1,S1,S2,S2 — A passed over four times
+        eng.step()
+        by_rid.update({t.request_id: t for t in eng._slots.values()})
+    assert by_rid[ra].chunks_done == 1
+    assert by_rid[ra].prefill_skipped == 4
+    assert all(by_rid[s].chunks_done == 2 for s in shorts)
+    # a FRESH short arrives — SRPT alone would grant it (2 remaining vs
+    # A's 7), but A is starved, so A takes the grant
+    rc = eng.submit(GenerationRequest(prompt_ids=rand_prompt(21, seed=30),
+                                      max_new_tokens=2,
+                                      key=jax.random.PRNGKey(30)))
+    eng.step()
+    by_rid.update({t.request_id: t for t in eng._slots.values()})
+    assert by_rid[ra].chunks_done == 2
+    assert by_rid[ra].prefill_skipped == 0
+    assert by_rid[rc].chunks_done == 0
+    while eng.pending:
+        eng.step()
+    want = solo(params, cfg, rand_prompt(128, seed=1),
+                jax.random.PRNGKey(0), max_new_tokens=2)
+    assert eng.results[ra].new_tokens.tolist() == want

@@ -603,3 +603,34 @@ def test_hybrid_request_larger_than_pool_rejected():
     while eng.pending:
         eng.step()
     assert len(eng.results[rid].new_tokens) == 4
+
+
+def test_admission_deadlock_detected_at_admit_time():
+    """The PR-5 deadlock fix: a reservation no amount of FUTURE
+    evictions could ever satisfy must fail loudly at _admit instead of
+    waiting forever behind other prefilling slots.  submit() already
+    rejects such requests, so feed one past it (straight into the
+    scheduler, as a custom front end might) while another slot is
+    mid-flight — pre-fix, step() would requeue it silently every
+    iteration with the queue stalled behind it."""
+    import dataclasses
+
+    cfg = dataclasses.replace(hybrid_cfg(), kv_pool_pages=4)  # 32 tokens
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    ok = eng.submit(GenerationRequest(prompt_ids=rand_prompt(20, seed=2),
+                                      max_new_tokens=4,
+                                      key=jax.random.PRNGKey(0)))
+    # 40 + 4 tokens => 6 pages > the whole 4-page pool
+    doomed = eng.scheduler.submit(GenerationRequest(
+        prompt_ids=rand_prompt(40, seed=1), max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="can never be admitted"):
+        while eng.pending:
+            eng.step()
+    # the poison request was DROPPED (requeueing would park it at the
+    # queue head and re-raise forever); the engine serves on untouched
+    assert all(t.request_id != doomed.request_id
+               for t in eng.scheduler._queue)
+    while eng.pending:
+        eng.step()
+    assert len(eng.results[ok].new_tokens) == 4
