@@ -187,9 +187,18 @@ def attention_mixer(
 #
 # The decode cache is a pool of fixed-size pages plus per-ROW metadata:
 #
-#   k_pages / v_pages  (P, page, nkv, hd)   physical pages; page 0 is a
-#                                           reserved trash page that
-#                                           masked-out rows write into
+#   k_pages / v_pages  (P, nkv, page, hd)   physical pages, HEAD-MAJOR;
+#                                           page 0 is a reserved trash
+#                                           page that masked-out rows
+#                                           write into
+#
+# Head-major storage is the kernel-native layout: the Pallas ragged
+# kernels (ops/pallas/attention_kernels.py) block pages as (page, hd)
+# tiles per (page, kv-head) cell, so storing (nkv, page, hd) lets the
+# BlockSpec index map address a page's head slice directly — no per-call
+# transpose of the whole pool on the decode/prefill hot path.  The lax
+# fallback pays one extra axis move inside its (already materializing)
+# gather instead.
 #   page_table         (b, W) int32         row r's logical page j lives
 #                                           in physical page table[r, j]
 #   lengths            (b,) int32           tokens cached per row
@@ -218,15 +227,15 @@ def attention_page_count(cfg: ModelConfig, max_len: int) -> int:
 def init_attention_state(cfg: ModelConfig, batch: int, max_len: int,
                          dtype=None):
     """Empty paged KV cache for one attention layer: (k_pages, v_pages)
-    of shape (1 + batch*W, page, nkv, hd) — page 0 is the trash page —
-    in the compute dtype, matching what the prefill path produces.
-    The shared (page_table, lengths) metadata is built once per model by
-    ``attention_page_meta`` (models/lm.init_lm_state)."""
+    of shape (1 + batch*W, nkv, page, hd) — HEAD-MAJOR, page 0 is the
+    trash page — in the compute dtype, matching what the prefill path
+    produces.  The shared (page_table, lengths) metadata is built once
+    per model by ``attention_page_meta`` (models/lm.init_lm_state)."""
     nh, nkv, hd, _ = _attn_dims(cfg)
     if dtype is None:
         dtype = jnp.dtype(cfg.compute_dtype)
     W = attention_page_count(cfg, max_len)
-    shape = (1 + batch * W, cfg.kv_page_tokens, nkv, hd)
+    shape = (1 + batch * W, nkv, cfg.kv_page_tokens, hd)
     # two INDEPENDENT allocations: returning one aliased array twice
     # would blow up any donating jit downstream ("donate the same
     # buffer twice") if a caller ever skips the re-stacking copy
@@ -243,15 +252,17 @@ def attention_page_meta(cfg: ModelConfig, batch: int, max_len: int):
 
 def pack_attention_pages(cfg: ModelConfig, k: jax.Array, v: jax.Array,
                          max_len: int):
-    """(b, t, nkv, hd) full-sequence K/V -> identity-paged (k_pages,
-    v_pages) with capacity ``max_len`` (lm_prefill's state packing)."""
+    """(b, t, nkv, hd) full-sequence K/V -> identity-paged head-major
+    (k_pages, v_pages) with capacity ``max_len`` (lm_prefill's state
+    packing)."""
     b, t, nkv, hd = k.shape
     pg = cfg.kv_page_tokens
     W = attention_page_count(cfg, max_len)
 
     def pack(x):
         x = jnp.pad(x, ((0, 0), (0, W * pg - t), (0, 0), (0, 0)))
-        x = x.reshape(b * W, pg, nkv, hd)
+        x = x.reshape(b, W, pg, nkv, hd)
+        x = jnp.moveaxis(x, 3, 2).reshape(b * W, nkv, pg, hd)
         return jnp.concatenate([jnp.zeros_like(x[:1]), x], axis=0)
 
     return pack(k), pack(v)
@@ -259,15 +270,19 @@ def pack_attention_pages(cfg: ModelConfig, k: jax.Array, v: jax.Array,
 
 def gather_kv_pages(k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array):
-    """Reassemble each row's logical KV view: (P, pg, nkv, hd) pages +
-    (b, W) table -> (b, W*pg, nkv, hd).  The lax fallback path — the
-    Pallas ragged kernel (ops/pallas/attention_kernels.py) walks the
-    table in-kernel instead of materializing this."""
+    """Reassemble each row's logical KV view: (P, nkv, pg, hd) head-major
+    pages + (b, W) table -> (b, W*pg, nkv, hd).  The lax fallback path —
+    the Pallas ragged kernels (ops/pallas/attention_kernels.py) walk the
+    table in-kernel instead of materializing this (and read the
+    head-major pages without the axis move this gather folds in)."""
     b, W = page_table.shape
-    _, pg, nkv, hd = k_pages.shape
-    k = k_pages[page_table].reshape(b, W * pg, nkv, hd)
-    v = v_pages[page_table].reshape(b, W * pg, nkv, hd)
-    return k, v
+    _, nkv, pg, hd = k_pages.shape
+
+    def gather(pages):
+        x = jnp.moveaxis(pages[page_table], 2, 3)        # (b, W, pg, nkv, hd)
+        return x.reshape(b, W * pg, nkv, hd)
+
+    return gather(k_pages), gather(v_pages)
 
 
 def _sdpa_positions(q, k, v, qpos):
@@ -330,8 +345,10 @@ def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array,
         mask, jnp.take_along_axis(page_table, pidx[:, None], axis=1)[:, 0], 0
     )
     off = jnp.where(mask, lengths % pg, 0)
-    k_pages = k_pages.at[phys, off].set(k[:, 0].astype(k_pages.dtype))
-    v_pages = v_pages.at[phys, off].set(v[:, 0].astype(v_pages.dtype))
+    # head-major pages: the token offset sits one axis past the heads, so
+    # the (b,) phys/off pair scatters a (b, nkv, hd) row block per write
+    k_pages = k_pages.at[phys, :, off].set(k[:, 0].astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, :, off].set(v[:, 0].astype(v_pages.dtype))
 
     from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
 
@@ -370,6 +387,14 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
     dies with their discarded stream positions.  The shared ``lengths``
     advance (+ n_real) happens once per model chunk in models/lm.py.
 
+    When ``cfg.attn_impl`` resolves to "pallas" the write + attend run as
+    ONE Pallas kernel over the head-major page pool
+    (``ragged_paged_prefill_attention``): the chunk's real K/V are fused
+    into the page walk and pages past ``lengths + n_real`` are skipped,
+    so chunk cost tracks live tokens instead of pool width.  The lax
+    fallback (explicit ``attn_impl="xla"``, or auto off-TPU) keeps the
+    scatter + full-view gather + dense SDPA.
+
     Returns (y (b, c, d), (k_pages, v_pages)).
     """
     nh, nkv, hd, rot = _attn_dims(cfg)
@@ -393,13 +418,27 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
 
-    pidx = jnp.clip(posc // pg, 0, W - 1)
-    phys = jnp.where(real, jnp.take_along_axis(page_table, pidx, axis=1), 0)
-    off = jnp.where(real, posc % pg, 0)
-    k_pages = k_pages.at[phys, off].set(k.astype(k_pages.dtype))
-    v_pages = v_pages.at[phys, off].set(v.astype(v_pages.dtype))
+    from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
 
-    kk, vv = gather_kv_pages(k_pages, v_pages, page_table)
-    out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
+    if resolve_attn_impl(cfg.attn_impl) == "pallas":
+        from mamba_distributed_tpu.ops.pallas.attention_kernels import (
+            ragged_paged_prefill_attention,
+        )
+
+        out, k_pages, v_pages = ragged_paged_prefill_attention(
+            q, k, v, k_pages, v_pages, page_table, lengths, c - pad
+        )
+    else:
+        pidx = jnp.clip(posc // pg, 0, W - 1)
+        phys = jnp.where(
+            real, jnp.take_along_axis(page_table, pidx, axis=1), 0
+        )
+        off = jnp.where(real, posc % pg, 0)
+        # head-major pages: the (b, c) phys/off pair scatters
+        # (b, c, nkv, hd) blocks one axis past the heads
+        k_pages = k_pages.at[phys, :, off].set(k.astype(k_pages.dtype))
+        v_pages = v_pages.at[phys, :, off].set(v.astype(v_pages.dtype))
+        kk, vv = gather_kv_pages(k_pages, v_pages, page_table)
+        out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
     y = linear(params["out_proj"], out.reshape(b, c, nh * hd), compute_dtype)
     return y, (k_pages, v_pages)

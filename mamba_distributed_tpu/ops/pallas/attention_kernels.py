@@ -516,10 +516,10 @@ def flash_sdpa_causal(
 # scratch exactly like the flash forward above.
 # ---------------------------------------------------------------------------
 
-# python-side-effect trace counter (one bump per jit trace): the whole
+# python-side-effect trace counters (one bump per jit trace): the whole
 # point of the fixed (S, W) layout is that occupancy/length changes never
-# retrace — tests/test_paged_attention.py pins it.
-TRACE_COUNTS = {"ragged_decode": 0}
+# retrace — tests/test_paged_attention.py pins both.
+TRACE_COUNTS = {"ragged_decode": 0, "ragged_prefill": 0}
 
 
 def _rpa_kernel(
@@ -589,9 +589,10 @@ def ragged_paged_decode_attention(
     """Paged decode attention with per-row lengths.
 
     q (S, nh, hd) — one query token per slot; k_pages/v_pages
-    (P, page, nkv, hd) — the shared page pool (page 0 = trash);
-    page_table (S, W) int32; kv_len (S,) int32 — tokens readable per
-    row (INCLUDING any token written this step).  Returns (S, nh, hd).
+    (P, nkv, page, hd) — the shared HEAD-MAJOR page pool (page 0 =
+    trash); page_table (S, W) int32; kv_len (S,) int32 — tokens readable
+    per row (INCLUDING any token written this step).  Returns
+    (S, nh, hd).
 
     Numerics match the lax fallback (gather + masked SDPA,
     models/attention._sdpa_positions) to fp tolerance; one jit trace
@@ -602,7 +603,7 @@ def ragged_paged_decode_attention(
     interpret = resolve_interpret(interpret)
     TRACE_COUNTS["ragged_decode"] += 1
     S, nh, hd = q.shape
-    P, pg, nkv, _ = k_pages.shape
+    P, nkv, pg, _ = k_pages.shape
     W = page_table.shape[1]
     if nh % nkv:
         raise ValueError(f"num_heads {nh} not a multiple of kv heads {nkv}")
@@ -613,12 +614,9 @@ def ragged_paged_decode_attention(
     qh = q.reshape(S, nkv, rep, hd)
     if R8 != rep:
         qh = jnp.pad(qh, ((0, 0), (0, 0), (0, R8 - rep), (0, 0)))
-    # head-major page view so KV blocks are (1, 1, pg, hd) — Mosaic's
-    # last-two-dims tiling wants (pg, hd), not a mid-array head slice.
-    # (A production pool would STORE pages head-major and skip this
-    # transpose; the lax fallback's scatter/gather prefers token-major.)
-    kp = jnp.swapaxes(k_pages, 1, 2)                     # (P, nkv, pg, hd)
-    vp = jnp.swapaxes(v_pages, 1, 2)
+    # the pool is STORED head-major (P, nkv, pg, hd), so KV blocks are
+    # (1, 1, pg, hd) — Mosaic's last-two-dims tiling — addressed straight
+    # off the table: no per-call transpose of the pool on the hot path
 
     grid = (S, nkv, W)
     q_spec = pl.BlockSpec(
@@ -648,5 +646,234 @@ def ragged_paged_decode_attention(
         ),
         interpret=interpret,
     )(page_table.astype(jnp.int32), kv_len.astype(jnp.int32),
-      qh, kp, vp)
+      qh, k_pages, v_pages)
     return out[:, :, :rep].reshape(S, nh, hd)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged PREFILL attention: one chunk of prompt ingestion against
+# the head-major page pool, as one kernel.
+#
+# The chunked hybrid prefill (models/attention.attention_mixer_chunk) used
+# to scatter the chunk's K/V into pages and then GATHER the row's entire
+# page view for a dense masked SDPA — O(pool width) work per chunk no
+# matter how few tokens were live.  This kernel is the prefill half of
+# the ragged-paged construction: grid (rows, kv-heads, page-blocks) with
+# the page dimension sequential, the page table scalar-prefetched (the
+# BlockSpec index map picks each row's physical page, so no (b, W*page)
+# view ever exists), and every page at/past ``lengths[r] + chunk_real[r]``
+# skipped outright.  The chunk's K/V page WRITE is fused in: each visited
+# page merges the chunk rows that land in it (an exact one-hot-select
+# matmul — every output row is one input row or the old page row) before
+# the attend, and the page-pool outputs alias the inputs so XLA updates
+# the pool in place.  Cells whose page takes no chunk token flush their
+# (unchanged or garbage) block to the trash page via the output index
+# map — a real page is only ever written by the one cell that owns it.
+# ---------------------------------------------------------------------------
+
+
+def _rpp_kernel(
+    tbl_ref, len_ref, creal_ref, q_ref, kc_ref, vc_ref, kp_ref, vp_ref,
+    o_ref, ko_ref, vo_ref, m_scr, den_scr, acc_scr,
+    *, nw: int, pg: int, c: int, rep: int, sm_scale: float,
+):
+    """One (row, kv-head, page) cell of the fused prefill forward."""
+    r = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        den_scr[...] = jnp.zeros_like(den_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ln = len_ref[r]                      # tokens cached before this chunk
+    creal = creal_ref[r]                 # real (non-pad) chunk tokens
+    total = ln + creal                   # readable extent after the write
+    pad = c - creal                      # left-pad inside the chunk
+
+    # ---- fused page write: merge the chunk rows landing in this page.
+    # Page position t holds absolute kpos = j*pg + t and takes chunk row
+    # i = kpos - ln + pad iff ln <= kpos < total; the (pg, c) one-hot
+    # select contraction is exact (each output row is 1.0 * one chunk row)
+    kc = kc_ref[0, 0]                                    # (C8, hd)
+    vc = vc_ref[0, 0]
+    C8 = kc.shape[0]
+    tpos = jax.lax.broadcasted_iota(jnp.int32, (pg, C8), 0) + j * pg
+    ci = jax.lax.broadcasted_iota(jnp.int32, (pg, C8), 1)
+    sel = (
+        (ci == tpos - ln + pad) & (tpos >= ln) & (tpos < total)
+    ).astype(jnp.float32)
+    k_rows = jax.lax.dot_general(                        # (pg, hd) fp32
+        sel, kc.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    v_rows = jax.lax.dot_general(
+        sel, vc.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    kpos_col = jax.lax.broadcasted_iota(jnp.int32, (pg, 1), 0) + j * pg
+    written = (kpos_col >= ln) & (kpos_col < total)       # (pg, 1)
+    merged_k = jnp.where(written, k_rows.astype(kp_ref.dtype), kp_ref[0, 0])
+    merged_v = jnp.where(written, v_rows.astype(vp_ref.dtype), vp_ref[0, 0])
+    # every cell writes its out block (an unwritten block would flush
+    # undefined VMEM); the out index map sends no-write cells to trash
+    ko_ref[0, 0] = merged_k
+    vo_ref[0, 0] = merged_v
+
+    # ---- attend: whole pages at/past the row's post-write extent are
+    # SKIPPED — chunk cost tracks live tokens (an all-pad row skips all)
+    @pl.when(j * pg < total)
+    def _():
+        q = q_ref[0, 0]                                  # (Q8, hd)
+        scores = jax.lax.dot_general(                    # (Q8, pg) fp32
+            q, merged_k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        # sublane s is (chunk idx i = s // rep, GQA rep e = s % rep);
+        # query i sits at absolute position ln + i - pad (pad queries
+        # clamp to 0 — garbage that dies with its discarded positions)
+        qi = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) // rep
+        qpos = jnp.maximum(ln + qi - pad, 0)
+        kpos = jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        ) + j * pg
+        mask = (kpos <= qpos) & (kpos < total)
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        # lane-replicated row stats; lane-max reads (no sub-128 slices)
+        m_prev = jnp.max(m_scr[...], axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        scale = jnp.where(m_prev > _NEG_INF, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.where(scores > _NEG_INF, jnp.exp(scores - m_new), 0.0)
+
+        acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
+            p.astype(merged_v.dtype), merged_v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        den_scr[...] = den_scr[...] * scale + jnp.sum(
+            p, axis=1, keepdims=True
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == nw - 1)
+    def _():
+        den = jnp.max(den_scr[...], axis=1, keepdims=True)
+        # rows with nothing readable (empty chunk on an empty cache)
+        # emit zeros, not NaN
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(den, 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def ragged_paged_prefill_attention(
+    q: jax.Array,
+    k_chunk: jax.Array,
+    v_chunk: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    lengths: jax.Array,
+    chunk_real: jax.Array,
+    interpret: bool | None = None,
+):
+    """Fused paged prefill: write one chunk's K/V into each row's pages,
+    then attend every chunk query over the page view.
+
+    q (b, c, nh, hd) — RoPE'd chunk queries; k_chunk/v_chunk
+    (b, c, nkv, hd) — the chunk's RoPE'd K/V (left-pad prefix rows are
+    ignored); k_pages/v_pages (P, nkv, pg, hd) — the shared HEAD-MAJOR
+    page pool (page 0 = trash); page_table (b, W) int32; lengths (b,)
+    int32 — tokens cached per row BEFORE this chunk; chunk_real (b,)
+    int32 — real tokens in this chunk (c - left pad).  Real token i of
+    the chunk lands at absolute position ``lengths[r] + i - pad`` and
+    every query attends positions ``[0, its own position]`` — the causal
+    rule over prefix + fresh chunk.
+
+    Returns (o (b, c, nh, hd), k_pages', v_pages').  The page-pool
+    outputs alias their inputs (in-place under the chunk step's state
+    donation).  Numerics match the lax fallback (scatter + gather +
+    ``models/attention._sdpa_positions``) to fp tolerance; one jit trace
+    covers every (lengths, chunk_real) mix at a fixed (b, c, W) layout
+    (``TRACE_COUNTS["ragged_prefill"]``).  ``interpret=None``
+    auto-selects the Pallas interpreter off-TPU.
+    """
+    interpret = resolve_interpret(interpret)
+    TRACE_COUNTS["ragged_prefill"] += 1
+    b, c, nh, hd = q.shape
+    P, nkv, pg, _ = k_pages.shape
+    W = page_table.shape[1]
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} not a multiple of kv heads {nkv}")
+    rep = nh // nkv
+    # queries head-major with (chunk idx, GQA rep) fused into the sublane
+    # dim: s = i*rep + e.  Sublane pads attend real keys and are sliced
+    # off; chunk-KV sublane pads are never selected by the write one-hot.
+    Q = c * rep
+    Q8 = -(-Q // 8) * 8
+    qh = jnp.moveaxis(q.reshape(b, c, nkv, rep, hd), 1, 2)
+    qh = qh.reshape(b, nkv, Q, hd)
+    if Q8 != Q:
+        qh = jnp.pad(qh, ((0, 0), (0, 0), (0, Q8 - Q), (0, 0)))
+    C8 = -(-c // 8) * 8
+    kc = jnp.moveaxis(k_chunk, 2, 1)                     # (b, nkv, c, hd)
+    vc = jnp.moveaxis(v_chunk, 2, 1)
+    if C8 != c:
+        cpad = ((0, 0), (0, 0), (0, C8 - c), (0, 0))
+        kc, vc = jnp.pad(kc, cpad), jnp.pad(vc, cpad)
+
+    grid = (b, nkv, W)
+    q_spec = pl.BlockSpec(
+        (1, 1, Q8, hd), lambda r, h, j, tbl, ln, cr: (r, h, 0, 0)
+    )
+    c_spec = pl.BlockSpec(
+        (1, 1, C8, hd), lambda r, h, j, tbl, ln, cr: (r, h, 0, 0)
+    )
+    kv_in_spec = pl.BlockSpec(
+        (1, 1, pg, hd), lambda r, h, j, tbl, ln, cr: (tbl[r, j], h, 0, 0)
+    )
+
+    def kv_out_idx(r, h, j, tbl, ln, cr):
+        # only the one cell owning a chunk-written page may flush to it;
+        # everything else (pure-prefix pages, pages past the extent)
+        # flushes its block to the trash page — whose content is garbage
+        # by design and never read
+        takes_write = (j * pg + pg > ln[r]) & (j * pg < ln[r] + cr[r])
+        return (jnp.where(takes_write, tbl[r, j], 0), h, 0, 0)
+
+    kv_out_spec = pl.BlockSpec((1, 1, pg, hd), kv_out_idx)
+
+    out, kp, vp = pl.pallas_call(
+        functools.partial(
+            _rpp_kernel, nw=W, pg=pg, c=c, rep=rep,
+            sm_scale=1.0 / math.sqrt(hd),
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[q_spec, c_spec, c_spec, kv_in_spec, kv_in_spec],
+            out_specs=[q_spec, kv_out_spec, kv_out_spec],
+            scratch_shapes=[
+                pltpu.VMEM((Q8, 128), jnp.float32),
+                pltpu.VMEM((Q8, 128), jnp.float32),
+                pltpu.VMEM((Q8, hd), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nkv, Q8, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # page-pool inputs (post-scalar-prefetch indices 6/7) alias the
+        # page-pool outputs: the write is in place under donation
+        input_output_aliases={6: 1, 7: 2},
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      chunk_real.astype(jnp.int32), qh, kc, vc, k_pages, v_pages)
+
+    o = out[:, :, :Q].reshape(b, nkv, c, rep, hd)
+    o = jnp.moveaxis(o, 1, 2).reshape(b, c, nh, hd)
+    return o, kp, vp

@@ -183,7 +183,10 @@ def slot_pool_specs(pool, num_shards: int):
     .init_pool) sharded over a ``serving_mesh``'s data axis.
 
     The SLOT axis partitions: ``blocks`` leaves are (L, S, ...) and
-    ``attn_blocks`` page-pool leaves (A, P+1, ...) shard axis 1;
+    ``attn_blocks`` page-pool leaves (A, P+1, nkv, page, hd) shard the
+    POOL axis 1 — the page-count axis, not the per-page token axis 3
+    (head-major storage keeps the pool axis in the same position, so
+    the data-axis tiling is layout-independent);
     ``logits`` (S, V) and every ``meta`` leaf (S, ...) shard axis 0.
     An axis that doesn't divide by ``num_shards`` replicates (the
     engine sizes capacity and the page pool so both divide; the

@@ -14,8 +14,8 @@ The pool is a plain pytree:
   pool = {
     "state": {
       "blocks": conv+SSM states, (L, S, ...) leaves  # per-slot rows
-      "attn_blocks": (A, P, page, nkv, hd) x2        # hybrid only: the
-    },                                               # shared KV page pool
+      "attn_blocks": (A, P, nkv, page, hd) x2        # hybrid only: the
+    },                                # shared HEAD-MAJOR KV page pool
     "logits": (S, V_padded) fp32                    # last logits per slot
     "meta": {
       "active":      (S,) bool   # slot holds a live request
@@ -46,8 +46,10 @@ writes the final state + logits and flips ``prefilling`` off, making
 the slot decodable.
 
 HYBRID stacks (``attn_layer_idx`` non-empty) pool too: the attention KV
-lives in a fixed PAGE pool — per-layer ``(P, page, nkv, hd)`` page
-arrays under ``state["attn_blocks"]`` (page 0 is a reserved trash page)
+lives in a fixed PAGE pool — per-layer HEAD-MAJOR ``(P, nkv, page, hd)``
+page arrays under ``state["attn_blocks"]`` (page 0 is a reserved trash
+page; head-major is the Pallas kernels' native block layout, so the
+decode/prefill page walks read pages without any per-call transpose)
 — while the page table and per-slot lengths stay HOST-side on the
 engine (they change only between ticks, and the tick takes them as
 plain array arguments).  ``PagePool`` is the host allocator: admission

@@ -15,7 +15,10 @@ knobs: DECODE_B (default 8), DECODE_PROMPT (default 128), DECODE_NEW
 (BENCH_PRESET defaults to hybrid-tiny there): a serving-style slot pool
 at LOW occupancy — DECODE_LIVE (2) of DECODE_SLOTS (8) slots live at
 DECODE_KV_LEN (96) cached tokens — decoded two ways through the same
-``lm_step``:
+``lm_step``; ``--occupancy 0.25,0.5,1.0`` sweeps the live-slot fraction
+instead and appends a paged-vs-dense row per fill level
+(``occupancy_sweep`` in the JSON record, collected by
+BENCH_SERVING.json):
 
   * paged: the page-table slice covers only the pow2 bucket of pages
     the live slots actually occupy (what serving/engine.py's tick
@@ -49,7 +52,8 @@ def _progress(msg: str) -> None:
 
 
 def _hybrid_paged_bench(args) -> dict:
-    """Low-occupancy paged decode vs the dense batch-max-length cost."""
+    """Paged decode vs the dense batch-max-length cost, optionally swept
+    over pool occupancy (``--occupancy 0.25,0.5,1.0``)."""
     import functools
 
     import jax
@@ -75,43 +79,39 @@ def _hybrid_paged_bench(args) -> dict:
             cfg, kv_slot_tokens=int(os.environ["DECODE_KV_SLOT"])
         )
     S = int(os.environ.get("DECODE_SLOTS", "8"))
-    live_n = int(os.environ.get("DECODE_LIVE", "2"))
     kv_len0 = int(os.environ.get("DECODE_KV_LEN", "96"))
     steps = int(os.environ.get("DECODE_NEW", "64"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
     pg = cfg.kv_page_tokens
     W_full = cfg.kv_pages_per_slot
     dev = jax.devices()[0]
+    if args.occupancy:
+        live_counts = sorted({
+            max(1, min(S, round(float(f) * S)))
+            for f in args.occupancy.split(",")
+        })
+    else:
+        live_counts = [int(os.environ.get("DECODE_LIVE", "2"))]
 
     params = cast_decode_params(
         jax.jit(lambda k: init_lm_params(k, cfg))(jax.random.PRNGKey(0)),
         cfg=cfg,
     )
     jax.block_until_ready(params)
-    _progress(f"params ready ({preset}); S={S} live={live_n} kv_len={kv_len0}")
+    _progress(f"params ready ({preset}); S={S} live={live_counts} "
+              f"kv_len={kv_len0}")
 
-    # serving-style pool state: live slots hold kv_len0 cached tokens in
-    # pool pages handed out by the allocator, dead slots point at trash
-    n_pages = state_cache.hybrid_pool_pages(cfg, S)
-    alloc = state_cache.PagePool(n_pages)
-    tbl = np.zeros((S, W_full), np.int32)
-    lengths = np.zeros((S,), np.int32)
-    need = -(-(kv_len0 + steps) // pg)
-    for s in range(live_n):
-        ids = alloc.alloc(need)
-        tbl[s, :need] = ids
-        lengths[s] = kv_len0
     A = len(cfg.attn_layer_idx)
     nkv, hd = cfg.effective_attn_num_kv_heads, cfg.effective_attn_head_dim
+    n_pages = state_cache.hybrid_pool_pages(cfg, S)
     key = jax.random.PRNGKey(1)
-    kv = jax.random.normal(key, (A, n_pages + 1, pg, nkv, hd),
+    kv = jax.random.normal(key, (A, n_pages + 1, nkv, pg, hd),
                            jnp.dtype(cfg.compute_dtype))
     state_blocks = {
         "blocks": init_lm_blocks_state(cfg, S),
         "attn_blocks": (kv, kv),
     }
-    live = np.zeros((S,), bool)
-    live[:live_n] = True
+    need = -(-(kv_len0 + steps) // pg)
 
     @functools.partial(jax.jit, static_argnames=("cfg", "steps"))
     def decode_run(params, state, tbl, lengths, live, tok, cfg, steps):
@@ -129,49 +129,75 @@ def _hybrid_paged_bench(args) -> dict:
         )
         return state, tok
 
-    def run_width(n_pages_width: int) -> float:
-        t = jnp.asarray(tbl[:, :n_pages_width])
-        ln = jnp.asarray(lengths)
-        lv = jnp.asarray(live)
-        tok = jnp.zeros((S,), jnp.int32)
-        out = decode_run(params, state_blocks, t, ln, lv, tok,
-                         cfg=cfg, steps=steps)
-        jax.block_until_ready(out)  # warm/compile
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = decode_run(params, state_blocks, t, ln, lv, tok,
-                             cfg=cfg, steps=steps)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters
-
     from mamba_distributed_tpu.inference.bucketing import next_pow2_bucket
 
     # same bucket rule the engine's tick uses, so the bench measures
     # exactly what serving pays
     bucket = min(next_pow2_bucket(need, min_bucket=1), W_full)
-    dt_paged = run_width(bucket)
-    _progress(f"paged (bucket {bucket} pages): {dt_paged * 1000:.1f} ms")
-    dt_dense = run_width(W_full)
-    _progress(f"dense batch-max ({W_full} pages): {dt_dense * 1000:.1f} ms")
 
-    tok_paged = live_n * steps / dt_paged
+    def bench_point(live_n: int) -> dict:
+        # serving-style pool state: live slots hold kv_len0 cached tokens
+        # in allocator-issued pages, dead slots point at trash
+        alloc = state_cache.PagePool(n_pages)
+        tbl = np.zeros((S, W_full), np.int32)
+        lengths = np.zeros((S,), np.int32)
+        for s in range(live_n):
+            ids = alloc.alloc(need)
+            tbl[s, :need] = ids
+            lengths[s] = kv_len0
+        live = np.zeros((S,), bool)
+        live[:live_n] = True
+
+        def run_width(n_pages_width: int) -> float:
+            t = jnp.asarray(tbl[:, :n_pages_width])
+            ln = jnp.asarray(lengths)
+            lv = jnp.asarray(live)
+            tok = jnp.zeros((S,), jnp.int32)
+            out = decode_run(params, state_blocks, t, ln, lv, tok,
+                             cfg=cfg, steps=steps)
+            jax.block_until_ready(out)  # warm/compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = decode_run(params, state_blocks, t, ln, lv, tok,
+                                 cfg=cfg, steps=steps)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters
+
+        dt_paged = run_width(bucket)
+        dt_dense = run_width(W_full)
+        _progress(f"live {live_n}/{S}: paged {dt_paged * 1000:.1f} ms, "
+                  f"dense {dt_dense * 1000:.1f} ms "
+                  f"({dt_dense / dt_paged:.2f}x)")
+        return {
+            "occupancy": round(live_n / S, 4),
+            "live_slots": live_n,
+            "tokens_per_sec_paged": round(live_n * steps / dt_paged, 1),
+            "tokens_per_sec_dense": round(live_n * steps / dt_dense, 1),
+            "paged_vs_dense_speedup": round(dt_dense / dt_paged, 2),
+            "kv_pages_in_use": alloc.pages_in_use,
+        }
+
+    points = [bench_point(n) for n in live_counts]
+    head = points[0]
     record = {
         "metric": f"hybrid_paged_decode_tokens_per_sec_{preset.replace('-', '_')}",
-        "value": round(tok_paged, 1),
+        "value": head["tokens_per_sec_paged"],
         "unit": "sampled tokens/sec (live slots, paged page-bucket)",
-        "dense_fallback_tokens_per_sec": round(live_n * steps / dt_dense, 1),
-        "paged_vs_dense_speedup": round(dt_dense / dt_paged, 2),
+        "dense_fallback_tokens_per_sec": head["tokens_per_sec_dense"],
+        "paged_vs_dense_speedup": head["paged_vs_dense_speedup"],
         "slots": S,
-        "live_slots": live_n,
+        "live_slots": head["live_slots"],
         "kv_len": kv_len0,
         "decode_steps": steps,
         "kv_page_tokens": pg,
         "bucket_pages": bucket,
         "dense_pages": W_full,
-        "kv_pages_in_use": alloc.pages_in_use,
+        "kv_pages_in_use": head["kv_pages_in_use"],
         "kv_pool_pages": n_pages,
         "device": dev.device_kind,
     }
+    if args.occupancy:
+        record["occupancy_sweep"] = points
     return record
 
 
@@ -182,6 +208,11 @@ def main() -> None:
     ap.add_argument("--hybrid-paged", action="store_true",
                     help="bench ragged paged hybrid decode at low "
                          "occupancy vs the dense batch-max-length cost")
+    ap.add_argument("--occupancy", default=None, metavar="F1,F2,...",
+                    help="with --hybrid-paged: sweep pool occupancy "
+                         "fractions (e.g. 0.25,0.5,1.0 => live slots = "
+                         "fraction * DECODE_SLOTS) and record a "
+                         "paged-vs-dense row per fill level")
     args = ap.parse_args()
 
     import jax

@@ -25,6 +25,12 @@ readable bench artifact; BENCH_SERVING.json collects these).  Hybrid
 presets (e.g. BENCH_PRESET=hybrid-tiny) serve through the paged KV pool
 and report its page gauges.
 
+``--occupancy 0.25,0.5,1.0`` sweeps slot-pool fill instead of the single
+default point: each fraction F runs the engine-vs-sequential comparison
+with round(F * capacity) concurrent requests and lands one row per fill
+level under ``occupancy_sweep`` (the shape BENCH_SERVING.json collects
+for before/after trajectories).
+
 ``--replicas N`` drives the data-parallel serving fabric
 (serving/router.py): the same short mix plus a few chunked-prefill
 long prompts routed least-loaded over N engine replicas, reported
@@ -157,6 +163,11 @@ def main() -> None:
     ap.add_argument("--long-prompt", action="store_true",
                     help="mixed long+short workload; report short-request "
                          "TTFT p95 with chunked vs one-shot prefill")
+    ap.add_argument("--occupancy", default=None, metavar="F1,F2,...",
+                    help="sweep slot-pool fill: for each fraction F run "
+                         "the engine-vs-sequential comparison with "
+                         "round(F * SERVE_CAPACITY) concurrent requests "
+                         "and record a row per fill level")
     ap.add_argument("--replicas", type=int, default=0, metavar="N",
                     help="drive the request router over N engine replicas "
                          "with a mixed short/long workload and report "
@@ -168,6 +179,10 @@ def main() -> None:
         ap.error("--long-prompt and --replicas are separate bench modes; "
                  "pick one (the --replicas workload already mixes long "
                  "and short prompts)")
+    if args.occupancy and (args.long_prompt or args.replicas):
+        ap.error("--occupancy sweeps the default engine-vs-sequential "
+                 "mode; it does not combine with --long-prompt or "
+                 "--replicas")
 
     import jax
     import jax.numpy as jnp
@@ -211,6 +226,38 @@ def main() -> None:
     _progress("params initialized")
 
     rng = np.random.default_rng(seed)
+
+    def _engine_vs_sequential(make_reqs, warm=True, jsonl_path=None):
+        """The one measurement protocol both the default point and the
+        --occupancy sweep report: (optionally) warm every jit signature
+        off the clock, then time one continuous-batching engine run and
+        one sequential solo-generate() replay of the same requests.
+        ``make_reqs()`` supplies the request list for each submit.
+        Returns (served_tokens, dt_serve, dt_seq, metrics summary)."""
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        if warm:
+            ServingEngine(params, cfg, **kw).run(make_reqs())
+            for r in make_reqs():
+                generate(params, cfg, jnp.asarray(r.prompt_ids)[None],
+                         jax.random.PRNGKey(r.seed),
+                         max_new_tokens=r.max_new_tokens)
+            _progress("both paths warm (all signatures compiled)")
+        # a fresh ServingMetrics truncates a reused --jsonl path on its
+        # first write
+        metrics = ServingMetrics(capacity, jsonl_path=jsonl_path)
+        engine = ServingEngine(params, cfg, metrics=metrics, **kw)
+        t0 = time.perf_counter()
+        results = engine.run(make_reqs())
+        dt_serve = time.perf_counter() - t0
+        served = sum(len(r.new_tokens) for r in results)
+        t0 = time.perf_counter()
+        for r in make_reqs():
+            out = generate(params, cfg, jnp.asarray(r.prompt_ids)[None],
+                           jax.random.PRNGKey(r.seed),
+                           max_new_tokens=r.max_new_tokens)
+            jax.block_until_ready(out)
+        dt_seq = time.perf_counter() - t0
+        return served, dt_serve, dt_seq, metrics.summary()
 
     if args.long_prompt:
         from mamba_distributed_tpu.serving import GenerationRequest
@@ -347,52 +394,90 @@ def main() -> None:
         emit_bench_record(record, args.json)
         return
 
+    if args.occupancy:
+        # occupancy sweep: one engine-vs-sequential comparison per fill
+        # level (requests = fraction * capacity submitted up front, so
+        # mean occupancy tracks the fraction), recording how the
+        # continuous-batching win scales with pool fill
+        from mamba_distributed_tpu.serving import GenerationRequest
+
+        # dedup AFTER rounding (like bench_decode) so fractions landing
+        # on the same request count don't run duplicate bench points
+        counts = sorted({
+            max(1, round(float(f) * capacity))
+            for f in args.occupancy.split(",")
+        })
+        points = []
+        # largest count first: every fraction draws from a fresh
+        # rng(seed), so each request set is an exact prefix of the
+        # largest — warming the first (widest) point covers every jit
+        # signature the whole sweep will hit
+        for i, n in enumerate(reversed(counts)):
+            reqs = _workload(np.random.default_rng(seed), n, pmin, pmax,
+                             max_new, cfg.vocab_size)
+
+            def fresh():
+                # per-run request objects: ids/streams are per-submit
+                return [GenerationRequest(
+                    prompt_ids=np.asarray(r.prompt_ids),
+                    max_new_tokens=r.max_new_tokens, seed=r.seed,
+                ) for r in reqs]
+
+            # --jsonl streams the HIGHEST-fill point's tick/request
+            # records (the headline number; it runs first) — one point
+            # only, since each fresh ServingMetrics truncates the path
+            served, dt_serve, dt_seq, summary = _engine_vs_sequential(
+                fresh, warm=(i == 0),
+                jsonl_path=args.jsonl if i == 0 else None)
+            point = {
+                "occupancy_target": round(n / capacity, 4),
+                "requests": n,
+                "tokens_per_sec": round(served / dt_serve, 1),
+                "sequential_tokens_per_sec": round(served / dt_seq, 1),
+                "speedup_vs_sequential": round(dt_seq / dt_serve, 2),
+                "mean_slot_occupancy": summary["mean_slot_occupancy"],
+                "mean_tick_ms": summary["mean_tick_ms"],
+            }
+            if summary.get("kv_pages"):
+                point["kv_pages"] = summary["kv_pages"]
+            points.append(point)
+            _progress(f"occupancy {point['occupancy_target']}: "
+                      f"{point['tokens_per_sec']} tok/s "
+                      f"({point['speedup_vs_sequential']}x vs sequential)")
+        points.sort(key=lambda p: p["occupancy_target"])
+        head = points[-1]
+        record = {
+            "metric": (f"serving_tokens_per_sec_per_chip_"
+                       f"{preset.replace('-', '_')}"),
+            "value": head["tokens_per_sec"],
+            "unit": "sampled tokens/sec/chip (aggregate, highest fill)",
+            "speedup_vs_sequential": head["speedup_vs_sequential"],
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "max_new_tokens": max_new,
+            "occupancy_sweep": points,
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
+
     requests = _workload(rng, n_requests, pmin, pmax, max_new, cfg.vocab_size)
     total_new = sum(r.max_new_tokens for r in requests)
 
-    # --- warm both paths: compile every signature off the clock ---
-    warm_engine = ServingEngine(
-        params, cfg, capacity=capacity, tokens_per_tick=tokens_per_tick
-    )
-    warm_engine.run(requests)
-    for r in requests:
-        generate(params, cfg, jnp.asarray(r.prompt_ids)[None],
-                 jax.random.PRNGKey(r.seed),
-                 max_new_tokens=r.max_new_tokens)
-    _progress("both paths warm (all signatures compiled)")
-
-    # --- continuous-batching engine, timed (a fresh ServingMetrics
-    # truncates a reused --jsonl path on its first write) ---
-    metrics = ServingMetrics(capacity, jsonl_path=args.jsonl)
-    engine = ServingEngine(
-        params, cfg, capacity=capacity, tokens_per_tick=tokens_per_tick,
-        metrics=metrics,
-    )
-    t0 = time.perf_counter()
-    results = engine.run(requests)
-    dt_serve = time.perf_counter() - t0
-    served_tokens = sum(len(r.new_tokens) for r in results)
+    served_tokens, dt_serve, dt_seq, summary = _engine_vs_sequential(
+        lambda: requests, jsonl_path=args.jsonl)
     assert served_tokens == total_new, (served_tokens, total_new)
     _progress(f"engine: {served_tokens} tokens in {dt_serve:.2f}s")
+    _progress(f"sequential: {total_new} tokens in {dt_seq:.2f}s")
 
-    # --- sequential static generate() baseline, timed ---
-    t0 = time.perf_counter()
-    seq_tokens = 0
-    for r in requests:
-        out = generate(params, cfg, jnp.asarray(r.prompt_ids)[None],
-                       jax.random.PRNGKey(r.seed),
-                       max_new_tokens=r.max_new_tokens)
-        seq_tokens += r.max_new_tokens
-        jax.block_until_ready(out)
-    dt_seq = time.perf_counter() - t0
-    _progress(f"sequential: {seq_tokens} tokens in {dt_seq:.2f}s")
-
-    summary = metrics.summary()
     record = {
         "metric": f"serving_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
         "value": round(served_tokens / dt_serve, 1),
         "unit": "sampled tokens/sec/chip (aggregate)",
-        "sequential_tokens_per_sec": round(seq_tokens / dt_seq, 1),
+        "sequential_tokens_per_sec": round(served_tokens / dt_seq, 1),
         "speedup_vs_sequential": round(dt_seq / dt_serve, 2),
         "requests": n_requests,
         "capacity": capacity,

@@ -1,7 +1,12 @@
-"""Ragged paged decode-attention tests: the Pallas kernel vs the lax
-gather fallback (interpret mode on CPU; the same kernel compiles for
-real on TPU via jax.export), trace pinning across occupancies, and the
-paged-cache helpers in models/attention.py."""
+"""Ragged paged attention tests: the Pallas decode + prefill kernels vs
+the lax gather fallback (interpret mode on CPU; the same kernels compile
+for real on TPU via jax.export), trace pinning across occupancies, and
+the head-major paged-cache helpers in models/attention.py.
+
+Everything here carries the ``pallas`` marker (pytest -m pallas) so the
+kernel surface — parity, ragged skips, lowering pins — can be
+re-verified in isolation after kernel work.
+"""
 
 import jax
 import jax.export  # attribute access alone fails on 0.4.37's lazy module
@@ -16,15 +21,19 @@ from mamba_distributed_tpu.models.attention import (
 from mamba_distributed_tpu.ops.pallas.attention_kernels import (
     TRACE_COUNTS,
     ragged_paged_decode_attention,
+    ragged_paged_prefill_attention,
 )
+
+pytestmark = pytest.mark.pallas
 
 
 def paged_case(rng, S=4, nh=8, nkv=2, hd=32, pg=8, W=4, P=17,
                dtype=jnp.float32, seed_lens=None):
     ks = jax.random.split(rng, 3)
     q = jax.random.normal(ks[0], (S, nh, hd), dtype)
-    k_pages = jax.random.normal(ks[1], (P, pg, nkv, hd), dtype)
-    v_pages = jax.random.normal(ks[2], (P, pg, nkv, hd), dtype)
+    # HEAD-MAJOR pool: (P, nkv, pg, hd)
+    k_pages = jax.random.normal(ks[1], (P, nkv, pg, hd), dtype)
+    v_pages = jax.random.normal(ks[2], (P, nkv, pg, hd), dtype)
     # disjoint per-row pages (pool-allocator invariant), page 0 = trash
     perm = 1 + np.random.default_rng(0).permutation(P - 1)[: S * W]
     tbl = jnp.asarray(perm.reshape(S, W), jnp.int32)
@@ -66,7 +75,7 @@ def test_ragged_kernel_ignores_pages_past_length(rng):
     q, kp, vp, tbl, kv_len = paged_case(rng, seed_lens=[5, 9, 12, 3])
     base = ragged_paged_decode_attention(q, kp, vp, tbl, kv_len,
                                          interpret=True)
-    pg = kp.shape[1]
+    pg = kp.shape[2]
     npg = np.array(kp)
     nvg = np.array(vp)
     for s, ln in enumerate(np.asarray(kv_len)):
@@ -102,7 +111,7 @@ def test_ragged_kernel_tpu_lowering(rng):
     the scalar-prefetched page-table index map."""
     S, nh, nkv, hd, pg, W, P = 8, 8, 2, 64, 16, 4, 33
     q = jnp.zeros((S, nh, hd), jnp.bfloat16)
-    kp = jnp.zeros((P, pg, nkv, hd), jnp.bfloat16)
+    kp = jnp.zeros((P, nkv, pg, hd), jnp.bfloat16)
     tbl = jnp.zeros((S, W), jnp.int32)
     ln = jnp.zeros((S,), jnp.int32)
 
@@ -150,3 +159,283 @@ def test_attention_step_kernel_path_matches_lax(rng, monkeypatch):
                                atol=1e-5, rtol=1e-5)
     for a, c in zip(jax.tree.leaves(kv_pal), jax.tree.leaves(kv_ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+# ------------------------------------------------ ragged paged PREFILL kernel
+
+
+def prefill_case(rng, b=3, c=16, nh=8, nkv=2, hd=32, pg=8, W=8, P=29,
+                 lens=(0, 5, 17), reals=(16, 11, 16), dtype=jnp.float32):
+    """One chunk step's inputs: RoPE'd chunk q/k/v, a seeded head-major
+    pool, disjoint tables, per-row (lengths, chunk_real)."""
+    ks = jax.random.split(rng, 5)
+    q = jax.random.normal(ks[0], (b, c, nh, hd), dtype)
+    kc = jax.random.normal(ks[1], (b, c, nkv, hd), dtype)
+    vc = jax.random.normal(ks[2], (b, c, nkv, hd), dtype)
+    k_pages = jax.random.normal(ks[3], (P, nkv, pg, hd), dtype)
+    v_pages = jax.random.normal(ks[4], (P, nkv, pg, hd), dtype)
+    perm = 1 + np.random.default_rng(1).permutation(P - 1)[: b * W]
+    tbl = jnp.asarray(perm.reshape(b, W), jnp.int32)
+    lengths = jnp.asarray((list(lens) * (1 + b // len(lens)))[:b], jnp.int32)
+    creal = jnp.asarray((list(reals) * (1 + b // len(reals)))[:b], jnp.int32)
+    return q, kc, vc, k_pages, v_pages, tbl, lengths, creal
+
+
+def prefill_lax_ref(q, kc, vc, k_pages, v_pages, tbl, lengths, creal):
+    """The scatter + gather + masked-SDPA fallback, replicated here so
+    the kernel is checked against an INDEPENDENT formulation."""
+    b, c, nh, hd = q.shape
+    pg = k_pages.shape[2]
+    W = tbl.shape[1]
+    pad = c - creal
+    pos = lengths[:, None] + jnp.arange(c)[None, :] - pad[:, None]
+    posc = jnp.maximum(pos, 0)
+    real = jnp.arange(c)[None, :] >= pad[:, None]
+    pidx = jnp.clip(posc // pg, 0, W - 1)
+    phys = jnp.where(real, jnp.take_along_axis(tbl, pidx, axis=1), 0)
+    off = jnp.where(real, posc % pg, 0)
+    k_pages = k_pages.at[phys, :, off].set(kc.astype(k_pages.dtype))
+    v_pages = v_pages.at[phys, :, off].set(vc.astype(v_pages.dtype))
+    kk, vv = gather_kv_pages(k_pages, v_pages, tbl)
+    out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
+    return out, k_pages, v_pages
+
+
+@pytest.mark.parametrize("case", [
+    # ragged mix: fresh row, mid-prefix row, page-straddling row
+    dict(lens=(0, 5, 17), reals=(16, 11, 16)),
+    # EMPTY row (all-pad chunk on an empty cache) next to live rows
+    dict(lens=(0, 9, 0), reals=(0, 16, 7)),
+    # chunk straddling a page boundary from inside a page (len=12, pg=8:
+    # the write spans pages 1..3 of the row)
+    dict(lens=(12,), reals=(16,), b=2),
+    # FULL pool: a row whose chunk tops out its very last page
+    dict(lens=(48,), reals=(16,), b=2, W=8),
+    # MQA + bigger pages
+    dict(nh=4, nkv=1, hd=64, pg=16, W=4, lens=(3, 20), reals=(16, 16)),
+    # zero-token chunk on a row whose length ends MID-page (the one mix
+    # where the straddling live page rides the real-page flush path with
+    # nothing to write) next to a normally-writing row
+    dict(lens=(12, 4), reals=(0, 16), b=2),
+])
+def test_prefill_kernel_matches_lax(rng, case):
+    q, kc, vc, kp, vp, tbl, lens, creal = prefill_case(rng, **case)
+    ref_o, ref_kp, ref_vp = prefill_lax_ref(q, kc, vc, kp, vp, tbl, lens,
+                                            creal)
+    got_o, got_kp, got_vp = ragged_paged_prefill_attention(
+        q, kc, vc, kp, vp, tbl, lens, creal, interpret=True
+    )
+    b, c = q.shape[:2]
+    pad = np.asarray(c - creal)
+    # REAL query positions must match the fallback; pad-query outputs are
+    # garbage on both paths (their stream positions are discarded)
+    for r in range(b):
+        np.testing.assert_allclose(
+            np.asarray(got_o)[r, pad[r]:], np.asarray(ref_o)[r, pad[r]:],
+            atol=1e-5, rtol=1e-5,
+        )
+    assert not np.isnan(np.asarray(got_o)).any()
+    # the fused write landed the chunk K/V in the SAME page positions the
+    # scatter fallback wrote: compare every page either side touched
+    pg = kp.shape[2]
+    total = np.asarray(lens) + np.asarray(creal)
+    for r in range(b):
+        for j in range(tbl.shape[1]):
+            lo, hi = j * pg, (j + 1) * pg
+            if hi <= int(np.asarray(lens)[r]) or lo >= int(total[r]):
+                continue  # untouched by this chunk
+            p = int(np.asarray(tbl)[r, j])
+            w = slice(max(lo, int(np.asarray(lens)[r])) - lo,
+                      min(hi, int(total[r])) - lo)
+            np.testing.assert_allclose(
+                np.asarray(got_kp)[p][:, w], np.asarray(ref_kp)[p][:, w],
+                atol=1e-6, rtol=1e-6,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got_vp)[p][:, w], np.asarray(ref_vp)[p][:, w],
+                atol=1e-6, rtol=1e-6,
+            )
+
+
+def test_prefill_kernel_preserves_prefix_pages(rng):
+    """Pages holding the PREFIX (written by earlier chunks) and pages of
+    OTHER rows must come through the fused write byte-identical — the
+    trash-page flush routing can never touch a live page it doesn't
+    own."""
+    q, kc, vc, kp, vp, tbl, lens, creal = prefill_case(
+        rng, lens=(24, 3, 0), reals=(16, 13, 16)
+    )
+    # snapshot before the call: the kernel's aliased page outputs may
+    # donate the input buffers
+    kp_np, vp_np = np.asarray(kp), np.asarray(vp)
+    _, got_kp, got_vp = ragged_paged_prefill_attention(
+        q, kc, vc, kp, vp, tbl, lens, creal, interpret=True
+    )
+    pg = kp_np.shape[2]
+    touched = set()
+    for r in range(q.shape[0]):
+        ln, tot = int(lens[r]), int(lens[r] + creal[r])
+        for j in range(tbl.shape[1]):
+            if j * pg + pg > ln and j * pg < tot:
+                touched.add(int(tbl[r, j]))
+    touched.add(0)  # the trash page eats the no-write flushes
+    for p in range(kp_np.shape[0]):
+        if p in touched:
+            continue
+        np.testing.assert_array_equal(np.asarray(got_kp)[p], kp_np[p])
+        np.testing.assert_array_equal(np.asarray(got_vp)[p], vp_np[p])
+
+
+def test_prefill_kernel_zero_chunk_mid_page_flush(rng):
+    """chunk_real=0 on a row whose length ends MID-page: ``kv_out_idx``'s
+    takes_write is true for the straddling page, so the kernel flushes
+    that LIVE page through the real-page path with zero tokens to write
+    — the ``written`` mask alone must reproduce its content
+    byte-identical (a regression here would corrupt already-written
+    prefix KV)."""
+    q, kc, vc, kp, vp, tbl, lens, creal = prefill_case(
+        rng, b=2, lens=(12, 4), reals=(0, 16)
+    )
+    kp_np, vp_np = np.asarray(kp), np.asarray(vp)
+    _, got_kp, got_vp = ragged_paged_prefill_attention(
+        q, kc, vc, kp, vp, tbl, lens, creal, interpret=True
+    )
+    pg = kp_np.shape[2]
+    # row 0's length 12 ends inside logical page 1 (pg=8): that page is
+    # the takes_write-with-nothing-written edge
+    p = int(tbl[0, 12 // pg])
+    np.testing.assert_array_equal(np.asarray(got_kp)[p], kp_np[p])
+    np.testing.assert_array_equal(np.asarray(got_vp)[p], vp_np[p])
+
+
+def test_prefill_kernel_one_trace_across_ragged_lengths(rng):
+    """One jit trace covers every (lengths, chunk_real) mix at a fixed
+    (b, c, W) layout — chunk interleaving can never retrace."""
+    q, kc, vc, kp, vp, tbl, _, _ = prefill_case(rng)
+
+    fn = jax.jit(
+        lambda q, kc, vc, kp, vp, tbl, ln, cr:
+        ragged_paged_prefill_attention(q, kc, vc, kp, vp, tbl, ln, cr,
+                                       interpret=True)
+    )
+    before = TRACE_COUNTS["ragged_prefill"]
+    for lens, reals in (([0, 0, 0], [16, 16, 16]),
+                        ([5, 40, 0], [16, 8, 0]),
+                        ([17, 3, 30], [16, 16, 16])):
+        out = fn(q, kc, vc, kp, vp, tbl,
+                 jnp.asarray(lens, jnp.int32), jnp.asarray(reals, jnp.int32))
+        jax.block_until_ready(out)
+    assert TRACE_COUNTS["ragged_prefill"] == before + 1
+
+
+def test_prefill_kernel_tpu_lowering(rng):
+    """The REAL Pallas->Mosaic lowering of the prefill kernel (no chip
+    needed), including the conditional trash-page output index map and
+    the aliased page-pool outputs."""
+    b, c, nh, nkv, hd, pg, W, P = 2, 128, 8, 2, 64, 16, 8, 33
+    q = jnp.zeros((b, c, nh, hd), jnp.bfloat16)
+    kc = jnp.zeros((b, c, nkv, hd), jnp.bfloat16)
+    kp = jnp.zeros((P, nkv, pg, hd), jnp.bfloat16)
+    tbl = jnp.zeros((b, W), jnp.int32)
+    ln = jnp.zeros((b,), jnp.int32)
+
+    def f(q, kc, vc, kp, vp, tbl, ln, cr):
+        return ragged_paged_prefill_attention(q, kc, vc, kp, vp, tbl, ln,
+                                              cr, interpret=False)
+
+    exp = jax.export.export(jax.jit(f), platforms=["tpu"])(
+        q, kc, kc, kp, kp, tbl, ln, ln
+    )
+    assert exp.platforms == ("tpu",)
+
+
+def test_attention_chunk_kernel_path_matches_lax(rng):
+    """attn_impl='pallas' routes attention_mixer_chunk through the fused
+    prefill kernel and reproduces the lax scatter+gather path — outputs
+    AND the resulting page pools (the fused write is the write)."""
+    from mamba_distributed_tpu.config import ModelConfig
+    from mamba_distributed_tpu.models.attention import (
+        attention_mixer_chunk,
+        init_attention_params,
+        init_attention_state,
+        attention_page_meta,
+    )
+
+    kw = dict(d_model=64, n_layer=2, vocab_size=64, ssm_layer="mamba2",
+              headdim=32, d_state=32, chunk_size=16,
+              compute_dtype="float32", attn_layer_idx=(1,),
+              attn_num_heads=4, attn_num_kv_heads=2, remat=False,
+              prefill_chunk_tokens=16, kv_page_tokens=8, kv_slot_tokens=64)
+    cfg_x = ModelConfig(**kw)
+    cfg_p = ModelConfig(**kw, attn_impl="pallas")
+    params = init_attention_params(rng, cfg_x)
+    b, c = 3, 16
+    kv = init_attention_state(cfg_x, b, 64)
+    tbl, _ = attention_page_meta(cfg_x, b, 64)
+    lengths = jnp.asarray([0, 5, 12], jnp.int32)
+    u = jax.random.normal(jax.random.fold_in(rng, 1), (b, c, 64),
+                          jnp.float32)
+    # ragged per-row masks: row 0 half-pad, row 1 full, row 2 full
+    mask = jnp.asarray(
+        [[0.0] * 8 + [1.0] * 8, [1.0] * 16, [1.0] * 16], jnp.float32
+    )
+    # seed the pool through one lax chunk first (both paths identically)
+    _, kv = attention_mixer_chunk(params, cfg_x, u, kv, tbl, lengths,
+                                  token_mask=None)
+    lengths = lengths + c
+    y_ref, kv_ref = attention_mixer_chunk(params, cfg_x, u + 1.0, kv, tbl,
+                                          lengths, token_mask=mask)
+    y_pal, kv_pal = attention_mixer_chunk(params, cfg_p, u + 1.0, kv, tbl,
+                                          lengths, token_mask=mask)
+    pad = np.asarray(c - mask.sum(axis=1), np.int32)
+    for r in range(b):
+        np.testing.assert_allclose(
+            np.asarray(y_pal)[r, pad[r]:], np.asarray(y_ref)[r, pad[r]:],
+            atol=1e-5, rtol=1e-5,
+        )
+    # identity tables never touch the trash page, so the pools must agree
+    # everywhere except page 0 (the kernel's no-write flush target)
+    for a, c_ in zip(kv_pal, kv_ref):
+        np.testing.assert_allclose(np.asarray(a)[1:], np.asarray(c_)[1:],
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_page_recycle_no_alias_head_major(rng):
+    """Page-recycle aliasing under the head-major layout: a page freed
+    by one row and handed to another must read back exactly what the new
+    owner wrote — decode over recycled pages matches a fresh pool."""
+    S, nh, nkv, hd, pg, W, P = 2, 4, 2, 32, 8, 2, 5
+    ks = jax.random.split(rng, 4)
+    q = jax.random.normal(ks[0], (S, nh, hd))
+    kv_len = jnp.asarray([14, 0], jnp.int32)
+
+    # row 0 owned pages {1, 2}; it was evicted and row 1 recycled them —
+    # then wrote 14 tokens of its own K/V through the chunk writer
+    fresh_k = jax.random.normal(ks[1], (P, nkv, pg, hd))
+    fresh_v = jax.random.normal(ks[2], (P, nkv, pg, hd))
+    kc = jax.random.normal(ks[3], (1, 16, nkv, hd))
+    tbl_new = jnp.asarray([[1, 2], [0, 0]], jnp.int32)
+
+    def write(pages, chunk):
+        pos = jnp.arange(16)
+        phys = jnp.where(pos < 14, tbl_new[0][jnp.clip(pos // pg, 0, 1)], 0)
+        off = jnp.where(pos < 14, pos % pg, 0)
+        return pages.at[phys, :, off].set(chunk[0])
+
+    # stale pool: pages 1/2 still hold the EVICTED row's garbage under
+    # the new writes at positions >= 14 — exactly the recycle state
+    stale_k = write(fresh_k, kc)
+    stale_v = write(fresh_v, kc * 0.5)
+    clean_k = write(jnp.zeros_like(fresh_k), kc)
+    clean_v = write(jnp.zeros_like(fresh_v), kc * 0.5)
+
+    got_stale = ragged_paged_decode_attention(
+        q, stale_k, stale_v, tbl_new, kv_len, interpret=True
+    )
+    got_clean = ragged_paged_decode_attention(
+        q, clean_k, clean_v, tbl_new, kv_len, interpret=True
+    )
+    # positions < 14 were overwritten by the new owner; >= 14 are masked
+    # by kv_len — stale residue is invisible
+    np.testing.assert_array_equal(np.asarray(got_stale),
+                                  np.asarray(got_clean))

@@ -87,8 +87,10 @@ def test_evict_frees_slot_only(setup):
 
 def test_pool_admits_hybrid_with_paged_kv():
     """Hybrid configs build a pool whose attention KV is a PAGE pool
-    (per-layer (P, page, nkv, hd) arrays, page 0 reserved as trash) —
-    the ragged/paged-attention pattern that unlocked hybrid serving."""
+    (per-layer HEAD-MAJOR (P, nkv, page, hd) arrays, page 0 reserved as
+    trash) — the ragged/paged-attention pattern that unlocked hybrid
+    serving, stored kernel-native so the Pallas page walk needs no
+    transpose."""
     cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer="mamba2",
                       headdim=8, chunk_size=16, d_state=16,
                       compute_dtype="float32", attn_layer_idx=(1,),
@@ -99,7 +101,7 @@ def test_pool_admits_hybrid_with_paged_kv():
     k_pages, v_pages = pool["state"]["attn_blocks"]
     n_pages = state_cache.hybrid_pool_pages(cfg, 2)   # 2 slots * 8 pages
     assert n_pages == 16
-    assert k_pages.shape == (1, n_pages + 1, 8, 2, 8)  # (A, P+trash, pg, nkv, hd)
+    assert k_pages.shape == (1, n_pages + 1, 2, 8, 8)  # (A, P+trash, nkv, pg, hd)
     assert v_pages.shape == k_pages.shape
     # hybrid serving requires the chunk path (it writes the pages)
     import dataclasses
