@@ -467,6 +467,16 @@ class TelemetryConfig:
     # grad_norm > threshold (or non-finite), accumulated host-side —
     # the on-device global-norm overflow counter.  0 disables.
     overflow_threshold: float = 0.0
+    # --- serving SLO targets (obs/slo.py): rolling-window p95 targets
+    # in milliseconds over the last `slo_window_requests` finished
+    # requests; 0 leaves a metric untargeted.  Crossing a target emits
+    # one `slo_breach` event record (and `slo_recovered` on the way
+    # back); scripts/obs_report.py renders the attainment table.  All
+    # host-side — no device syncs, no extra jit traces. ---
+    slo_ttft_p95_ms: float = 0.0
+    slo_itl_p95_ms: float = 0.0
+    slo_queue_wait_p95_ms: float = 0.0
+    slo_window_requests: int = 64
 
     def __post_init__(self):
         if self.flight_recorder_len < 1:
@@ -484,6 +494,18 @@ class TelemetryConfig:
                 "overflow_threshold > 0 needs sentinel=True — the host-"
                 "side accumulator and flight record that consume the "
                 "on-device flag live on the sentinel"
+            )
+        for name in ("slo_ttft_p95_ms", "slo_itl_p95_ms",
+                     "slo_queue_wait_p95_ms"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0 (0 disables), got "
+                    f"{getattr(self, name)}"
+                )
+        if self.slo_window_requests < 1:
+            raise ValueError(
+                f"slo_window_requests must be >= 1, got "
+                f"{self.slo_window_requests}"
             )
 
 

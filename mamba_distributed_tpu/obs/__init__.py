@@ -6,11 +6,22 @@ and the serving engine, and consumed by ``scripts/obs_report.py``:
 
   kind="span"          tracer.py     timed host-side phase (data_load,
                                      train_step, serving_tick, ...)
-  kind="event"         tracer.py     point-in-time marker (divergence, ...)
+  kind="event"         tracer.py     point-in-time marker (divergence,
+                                     slo_breach, ...)
+  kind="trace_header"  tracer.py     wall-clock epoch of a stream's t=0
+                                     (what lets export.py merge streams)
   kind="train"/"val"   utils/metrics MetricsLogger step records
   kind="serving_tick"  utils/metrics ServingMetrics per-tick records
+                                     (+ goodput/MFU + live trace ids)
   kind="request"       utils/metrics per-request latency record
-                                     (queue-wait, TTFT, ITL histogram)
+                                     (queue-wait, TTFT, ITL histogram,
+                                     trace_id)
+
+Request-flow tracing rides the same records: ``context.py`` mints one
+trace id per request journey, the serving fabric stamps it everywhere,
+``export.py`` merges N streams into one Perfetto-loadable trace with
+flow arrows per request, and ``slo.py`` watches rolling-window p95
+targets over the finished-request stream.
 
 Everything here is strictly host-side: no device syncs, nothing traced
 by jit — enabling telemetry cannot change what XLA compiles (pinned by
@@ -18,7 +29,13 @@ tests/test_obs.py trace-count tests).  docs/OBSERVABILITY.md has the
 schema and span taxonomy.
 """
 
+from mamba_distributed_tpu.obs.context import mint_trace_id
+from mamba_distributed_tpu.obs.export import (
+    export_chrome_trace,
+    to_chrome_trace,
+)
 from mamba_distributed_tpu.obs.histogram import StreamingHistogram
+from mamba_distributed_tpu.obs.slo import SLOMonitor
 from mamba_distributed_tpu.obs.sentinel import (
     DivergenceError,
     DivergenceSentinel,
@@ -36,8 +53,12 @@ __all__ = [
     "DivergenceSentinel",
     "FlightRecorder",
     "NULL_TRACER",
+    "SLOMonitor",
     "SpanTracer",
     "StreamingHistogram",
     "append_jsonl",
+    "export_chrome_trace",
     "jsonable",
+    "mint_trace_id",
+    "to_chrome_trace",
 ]

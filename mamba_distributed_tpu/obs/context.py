@@ -1,0 +1,55 @@
+"""Trace context: one id for one request's journey across the fabric.
+
+A request placed by the router travels router -> replica -> engine ->
+chunked prefill -> decode ticks, and (after a failover) may restart on
+a different replica.  ``mint_trace_id()`` issues the id that ties all
+of those host-side records together: the router (or a solo engine's
+scheduler) mints it once per request, every span and ``serving_tick``/
+``request`` jsonl record stamps it, and ``obs/export.py`` turns the
+stamps into Perfetto flow arrows so one request's path is a single
+clickable chain across N replica streams.
+
+Ids are strings, unique across processes (a per-process random nonce)
+and ordered within one (a monotone counter), so two replicas in two
+OS processes — or two routers in one — can never collide.  Everything
+here is host-side bookkeeping: no jax import, no device work.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import secrets
+
+# process-unique prefix: pid (readable in ps/trace UIs) + random salt
+# (pids recycle; two runs on one box must not collide in a merged trace)
+_PROCESS_NONCE = ""
+_COUNTER = itertools.count()  # atomic under the GIL — no lock for next()
+
+
+def _reseed() -> None:
+    """(Re)derive the process nonce and reset the counter — run at
+    import AND after fork: a fork-spawned replica worker inherits the
+    parent's module state, and continuing from the same nonce+counter
+    would mint colliding ids across processes."""
+    global _PROCESS_NONCE, _COUNTER
+    _PROCESS_NONCE = f"{os.getpid():x}-{secrets.token_hex(3)}"
+    _COUNTER = itertools.count()
+
+
+_reseed()
+if hasattr(os, "register_at_fork"):  # absent on non-POSIX
+    os.register_at_fork(after_in_child=_reseed)
+
+
+def mint_trace_id() -> str:
+    """A fresh fabric-unique trace id (one per request journey).
+
+    The id is deliberately a bare string, not a context object: the
+    propagation convention is one ``trace=<id>`` attr per span /
+    ``trace_id`` field per request record / ``traces=[...]`` set per
+    tick record, and every writer spells it inline.  Cross-host
+    propagation (the ROADMAP's disaggregated prefill/decode item) can
+    introduce a richer context type when a process boundary actually
+    needs one."""
+    return f"{_PROCESS_NONCE}-{next(_COUNTER):04x}"

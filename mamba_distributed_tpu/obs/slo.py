@@ -1,0 +1,166 @@
+"""Rolling-window SLO monitor for the serving fabric.
+
+Latency SLOs are attained or breached over *recent* traffic, not the
+whole run — a p95 over a million requests hides an hour-long brownout.
+``SLOMonitor`` watches every finished request's host-side latency
+scalars (the ``"request"`` record the engine already builds: TTFT,
+queue-wait, and the per-request ITL histogram) and keeps a rolling
+window of the last N per targeted metric:
+
+  * the rolling p95 is recomputed on each arrival (N is small — a
+    sort of <= ``window`` floats is host noise);
+  * crossing a target emits ONE ``slo_breach`` event record through
+    the tracer (and ``slo_recovered`` on the way back) — a state
+    transition, not a per-request alarm flood;
+  * per-request attainment (did THIS request meet the target) is
+    counted for the run-level attainment table
+    (``scripts/obs_report.py``).
+
+Targets live on ``TelemetryConfig`` (``slo_ttft_p95_ms`` /
+``slo_itl_p95_ms`` / ``slo_queue_wait_p95_ms``, 0 = not targeted;
+``slo_window_requests`` sizes the window) — ``from_config`` builds the
+monitor, and a ``slo_config`` event stamps the targets into the stream
+so the report can compute attainment offline.
+
+Strictly host-side, like everything in obs/: the inputs are scalars
+the engine already fetched, so enabling SLO monitoring adds zero
+device syncs and zero jit traces (pinned by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+
+from mamba_distributed_tpu.obs.histogram import StreamingHistogram
+from mamba_distributed_tpu.obs.tracer import NULL_TRACER
+
+# metric key in the request record -> the target's name on TelemetryConfig
+_METRICS = ("ttft_ms", "itl_ms", "queue_wait_ms")
+
+
+def _p95(window) -> float:
+    xs = sorted(window)
+    return xs[max(0, math.ceil(0.95 * len(xs)) - 1)]
+
+
+class SLOMonitor:
+    """Rolling-window p95 targets over finished-request latency.
+
+    Args:
+      ttft_p95_ms / itl_p95_ms / queue_wait_p95_ms: targets in
+        milliseconds; 0 leaves a metric untargeted.
+      window: rolling window length in requests (the last N finished
+        requests, fabric-wide when one monitor is shared by every
+        replica — the router wiring).
+      tracer: where ``slo_config``/``slo_breach``/``slo_recovered``
+        event records land (an ``obs.SpanTracer``; default off).
+    """
+
+    def __init__(self, *, ttft_p95_ms: float = 0.0, itl_p95_ms: float = 0.0,
+                 queue_wait_p95_ms: float = 0.0, window: int = 64,
+                 tracer=NULL_TRACER):
+        targets = {"ttft_ms": ttft_p95_ms, "itl_ms": itl_p95_ms,
+                   "queue_wait_ms": queue_wait_p95_ms}
+        for name, t in targets.items():
+            if t < 0:
+                raise ValueError(f"{name} p95 target must be >= 0 "
+                                 f"(0 disables), got {t}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = window
+        self.tracer = tracer
+        self.targets = {m: t for m, t in targets.items() if t > 0}
+        self._windows = {m: deque(maxlen=window) for m in self.targets}
+        self._met = {m: 0 for m in self.targets}
+        self._seen = {m: 0 for m in self.targets}
+        self._in_breach = {m: False for m in self.targets}
+        self.breaches = {m: 0 for m in self.targets}
+        if self.targets:
+            # stamp the targets into the stream so obs_report.py can
+            # compute attainment from the request records offline
+            tracer.event(
+                "slo_config", window=window,
+                **{f"{m}_p95_target": t for m, t in self.targets.items()},
+            )
+
+    @classmethod
+    def from_config(cls, telemetry, tracer=NULL_TRACER) -> "SLOMonitor | None":
+        """Build from a ``TelemetryConfig``; None when nothing is
+        targeted (the monitor-off fast path costs literally nothing)."""
+        if not (telemetry.slo_ttft_p95_ms or telemetry.slo_itl_p95_ms
+                or telemetry.slo_queue_wait_p95_ms):
+            return None
+        return cls(
+            ttft_p95_ms=telemetry.slo_ttft_p95_ms,
+            itl_p95_ms=telemetry.slo_itl_p95_ms,
+            queue_wait_p95_ms=telemetry.slo_queue_wait_p95_ms,
+            window=telemetry.slo_window_requests,
+            tracer=tracer,
+        )
+
+    # --------------------------------------------------------------- feed
+
+    def observe_request(self, record: dict, replica=None) -> None:
+        """One finished request (the engine's ``"request"`` record dict).
+        ITL is judged on the request's own p95 (from its streaming
+        histogram — the record already carries it)."""
+        values = {
+            "ttft_ms": record.get("ttft_ms"),
+            "queue_wait_ms": record.get("queue_wait_ms"),
+        }
+        if "itl_ms" in self.targets:
+            hist = record.get("itl_hist")
+            if hist:
+                if isinstance(hist, dict):
+                    hist = StreamingHistogram.from_dict(hist)
+                values["itl_ms"] = hist.percentile(95)
+        for metric, target in self.targets.items():
+            value = values.get(metric)
+            if value is None:
+                continue  # e.g. a 1-token request has no ITL
+            self._seen[metric] += 1
+            if value <= target:
+                self._met[metric] += 1
+            win = self._windows[metric]
+            win.append(value)
+            rolling = _p95(win)
+            breached = rolling > target
+            if breached != self._in_breach[metric]:
+                self._in_breach[metric] = breached
+                attrs = dict(metric=metric, target=target,
+                             p95=round(rolling, 3), window=len(win))
+                if replica is not None:
+                    attrs["replica"] = replica
+                if breached:
+                    self.breaches[metric] += 1
+                    self.tracer.event("slo_breach", **attrs)
+                else:
+                    self.tracer.event("slo_recovered", **attrs)
+
+    # ------------------------------------------------------------ roll-up
+
+    def summary(self) -> dict:
+        """Attainment + breach state per targeted metric (rendered next
+        to the goodput numbers by scripts/obs_report.py)."""
+        return {
+            "window": self.window,
+            "metrics": {
+                m: {
+                    "target_p95_ms": t,
+                    "requests": self._seen[m],
+                    "met": self._met[m],
+                    "attainment": (
+                        round(self._met[m] / self._seen[m], 4)
+                        if self._seen[m] else None
+                    ),
+                    "breaches": self.breaches[m],
+                    "in_breach": self._in_breach[m],
+                    "rolling_p95_ms": (
+                        round(_p95(self._windows[m]), 3)
+                        if self._windows[m] else None
+                    ),
+                }
+                for m, t in self.targets.items()
+            },
+        }

@@ -56,6 +56,12 @@ class SpanTracer:
     attributes given at the call site.  Writes are lock-serialized,
     open-append-close per record — crash-safe, and these are O(ms+)
     host phases so the syscall pair is noise.
+
+    The first write additionally stamps one ``trace_header`` record
+    (``wall_t0_s``: the wall clock paired with the tracer's t=0, plus
+    the pid), which is what lets ``obs/export.py`` merge streams from
+    different replicas/processes onto one timeline — ``t_ms`` alone is
+    a process-local perf_counter offset and not comparable.
     """
 
     enabled = True
@@ -67,20 +73,44 @@ class SpanTracer:
         self.jsonl_path = jsonl_path
         self._clock = _clock
         self._t0 = _clock()
+        # wall clock paired with _t0 at the same instant: t_ms offsets
+        # are perf_counter deltas (monotonic, but process-local), so
+        # streams from different replicas/processes — or a post-resume
+        # rebuilt tracer — are only comparable through this epoch.  The
+        # first write stamps it as a "trace_header" record, and
+        # obs/export.py aligns N streams on their headers' wall clocks.
+        self.wall_t0 = time.time()
         self._lock = threading.Lock()
         self._local = threading.local()
+        # small stable per-tracer thread index, stamped as ``tid`` on
+        # span/event records: spans from different host threads (the
+        # async checkpoint thread vs the trainer loop) overlap in wall
+        # time without nesting, so the exporter must give each thread
+        # its own track — overlapping slices on one track are invalid
+        # trace-event JSON that Perfetto drops
+        self._tids: dict[int, int] = {}
         # truncation is deferred to the first write (same contract as
         # MetricsLogger) so a checkpoint resume / --auto-restart rebuild
         # can preserve the pre-crash span history — which is exactly the
         # stream a post-mortem needs.  NB ``t_ms`` offsets restart from 0
-        # for the new tracer's records.
+        # for the new tracer's records (under a fresh header, so the
+        # exporter still places them correctly on the shared timeline).
         self._truncate_pending = True
+        self._header_pending = True
 
     def _stack(self) -> list[str]:
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
         return stack
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+        return tid
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
@@ -100,6 +130,7 @@ class SpanTracer:
                 "t_ms": round((t_start - self._t0) * 1000, 3),
                 "dur_ms": round(dur * 1000, 3),
                 "depth": len(stack),
+                "tid": self._tid(),
             }
             if parent is not None:
                 record["parent"] = parent
@@ -113,6 +144,7 @@ class SpanTracer:
             "kind": "event",
             "name": name,
             "t_ms": round((self._clock() - self._t0) * 1000, 3),
+            "tid": self._tid(),
         }
         if attrs:
             record.update(attrs)
@@ -124,6 +156,16 @@ class SpanTracer:
 
     def write(self, record: dict) -> None:
         with self._lock:
+            if self._header_pending:
+                self._header_pending = False
+                append_jsonl(
+                    self.jsonl_path,
+                    {"kind": "trace_header",
+                     "wall_t0_s": round(self.wall_t0, 6),
+                     "pid": os.getpid()},
+                    truncate=self._truncate_pending,
+                )
+                self._truncate_pending = False
             append_jsonl(self.jsonl_path, record,
                          truncate=self._truncate_pending)
             self._truncate_pending = False

@@ -242,9 +242,17 @@ class ServingEngine:
       metrics: a ServingMetrics, or None to create one.  Give it a
         ``jsonl_path`` to stream per-tick and per-request records.
       tracer: an obs.SpanTracer for host-side phase spans
-        (``serving_admit`` / ``serving_tick``); default NULL_TRACER
-        (off).  Strictly host-side: enabling it adds zero device syncs
-        and zero jit traces (pinned by tests/test_obs.py).
+        (``serving_admit`` / ``serving_prefill`` /
+        ``serving_prefill_chunk`` / ``serving_tick``); default
+        NULL_TRACER (off).  Per-request spans carry the request's
+        ``trace`` id and tick spans/records the live trace-id set, so
+        ``scripts/trace_export.py`` can flow-link one request's journey
+        across streams.  Strictly host-side: enabling it adds zero
+        device syncs and zero jit traces (pinned by tests/test_obs.py).
+      slo: an obs.SLOMonitor fed every finished request's latency
+        record (rolling-window p95 targets -> breach events); None
+        (default) off.  The router shares ONE monitor across replicas
+        so the window is fabric-wide.
       mesh: a ``parallel/mesh.serving_mesh`` — the shard_slots path.
         Slot/page state and the tick's batch axis partition over the
         mesh's data axis via NamedSharding (params replicated), so one
@@ -272,6 +280,7 @@ class ServingEngine:
         retain_results: bool = True,
         metrics: ServingMetrics | None = None,
         tracer=NULL_TRACER,
+        slo=None,
         mesh=None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
@@ -324,6 +333,31 @@ class ServingEngine:
         self.scheduler = FCFSScheduler()
         self.metrics = metrics or ServingMetrics(capacity)
         self.tracer = tracer
+        self.slo = slo
+        # goodput: analytic FLOPs rates (utils/flops.py, the "model"
+        # convention — parameter matmuls + recurrent state math, no
+        # device counters, no syncs) so every serving_tick record can
+        # carry a host-computed serving_mfu.  Decode rates are per
+        # sampled token; chunk-prefill rates per real prompt token at
+        # the chunk's sequence length.
+        from mamba_distributed_tpu.utils.flops import (
+            flops_per_token,
+            peak_flops_per_chip,
+        )
+
+        # with chunking disabled (one-shot only) price prefill at the
+        # DEFAULT chunk width rather than seq_len=1: the length only
+        # moves the O(t) attention terms, and charging a hybrid's
+        # one-shot prefill at decode-length rates would systematically
+        # understate serving_mfu in exactly that config
+        prefill_seq = cfg.effective_prefill_chunk_tokens or 256
+        self.metrics.configure_goodput(
+            flops_per_decode_token=flops_per_token(
+                cfg, 1, training=False, convention="model"),
+            flops_per_prefill_token=flops_per_token(
+                cfg, prefill_seq, training=False, convention="model"),
+            peak_flops=peak_flops_per_chip() * self.num_shards,
+        )
         self._free: list[int] = list(range(capacity))
         self._slots: dict[int, _Tracked] = {}
         # slots holding a partial chunked prefill, in admission order;
@@ -352,7 +386,14 @@ class ServingEngine:
         # serving_tick stream never drops work (obs_report.py totals)
         self._pending_stall_ms = 0.0
         self._pending_chunk_tokens = 0
+        self._pending_chunk_real_tokens = 0  # non-pad (goodput useful)
         self._pending_chunk_ms = 0.0
+        # one-shot (unchunked) admissions in the window: real prompt
+        # tokens vs padded bucket lanes — without these the goodput
+        # fields would credit a 33-token (chunked) prompt but not a
+        # 32-token (one-shot) one over the same wall window
+        self._pending_oneshot_real_tokens = 0
+        self._pending_oneshot_lanes = 0
         self.results: dict[int, GenerationResult] = {}
 
     # ------------------------------------------------------------- admission
@@ -475,21 +516,28 @@ class ServingEngine:
         t0 = time.perf_counter()
         try:
             if plan is None:
-                prompt = jnp.asarray(r.prompt_ids, jnp.int32)[None, :]
-                padded, mask = pad_to_bucket(
-                    prompt, next_pow2_bucket(prompt.shape[1])
-                )
-                # async dispatch: admitting k queued requests between ticks
-                # queues k prefills+inserts without a host sync each — the
-                # next tick's token fetch is the one synchronization point
-                logits, state = _prefill(
-                    self._params, padded, mask, cfg=self.cfg
-                )
-                self.pool = state_cache.insert(
-                    self.pool, slot, state, logits, r.resolve_key(),
-                    r.max_new_tokens, r.top_k, r.temperature,
-                    -1 if r.eos_id is None else r.eos_id,
-                )
+                # one per-request span (trace-stamped) so even a short
+                # prompt's journey has an anchor in this replica's
+                # stream for the exporter's flow arrows
+                with self.tracer.span("serving_prefill", slot=slot,
+                                      request=tracked.request_id,
+                                      trace=tracked.trace_id):
+                    prompt = jnp.asarray(r.prompt_ids, jnp.int32)[None, :]
+                    padded, mask = pad_to_bucket(
+                        prompt, next_pow2_bucket(prompt.shape[1])
+                    )
+                    # async dispatch: admitting k queued requests between
+                    # ticks queues k prefills+inserts without a host sync
+                    # each — the next tick's token fetch is the one
+                    # synchronization point
+                    logits, state = _prefill(
+                        self._params, padded, mask, cfg=self.cfg
+                    )
+                    self.pool = state_cache.insert(
+                        self.pool, slot, state, logits, r.resolve_key(),
+                        r.max_new_tokens, r.top_k, r.temperature,
+                        -1 if r.eos_id is None else r.eos_id,
+                    )
             else:
                 tracked.plan = plan
                 tracked.chunks_done = 0
@@ -522,6 +570,13 @@ class ServingEngine:
         t_admit = time.perf_counter()
         if plan is None:
             self.metrics.record_prefill(int(len(r.prompt_ids)), t_admit - t0)
+            # goodput: the one-shot prefill's real tokens vs the padded
+            # bucket lanes it computed, attributed to the next tick's
+            # window (its dispatch time is already in the stall)
+            self._pending_oneshot_real_tokens += int(len(r.prompt_ids))
+            self._pending_oneshot_lanes += next_pow2_bucket(
+                len(r.prompt_ids)
+            )
         # lifecycle stamps: queue-wait is submit -> slot granted; the
         # per-request ITL histogram rides in the finish record so
         # obs_report.py can merge per-token percentiles across requests
@@ -560,7 +615,8 @@ class ServingEngine:
             ids, mask = chunk_inputs(r.prompt_ids, plan, i)
             t0 = time.perf_counter()
             with self.tracer.span("serving_prefill_chunk", slot=slot,
-                                  chunk=i, of=plan.n_chunks):
+                                  chunk=i, of=plan.n_chunks,
+                                  trace=tracked.trace_id):
                 logits, state = prefill_chunk(
                     self._params, ids, mask, state, cfg=self.cfg
                 )
@@ -573,14 +629,15 @@ class ServingEngine:
                     # this chunk's REAL tokens (the left pad of chunk 0
                     # is never written)
                     self.pool["state"]["attn_blocks"] = state["attn_blocks"]
-                    self._kv_len[slot] += (
-                        plan.chunk - (plan.pad if i == 0 else 0)
-                    )
+                    self._kv_len[slot] += plan.real_tokens(i)
             dt = time.perf_counter() - t0  # host dispatch time
             tracked.chunks_done += 1
             tracked.prefill_dt += dt
             budget_left -= plan.chunk
             self.metrics.record_prefill_chunk(plan.chunk, dt)
+            # goodput: real (non-pad) chunk tokens are the useful share
+            # of this window's prefill lanes
+            self._pending_chunk_real_tokens += plan.real_tokens(i)
             state = {"blocks": state["blocks"]}
             if tracked.chunks_done == plan.n_chunks:
                 self.pool = state_cache.finish_prefill(
@@ -727,8 +784,17 @@ class ServingEngine:
             # granting chunk budget until a slot turns decodable
             return []
         occupied = len(self._slots)
+        # live trace-id set: the requests this tick actually advances
+        # (mid-prefill residents are masked out of sampling) — stamped
+        # on the span AND the jsonl record so host-side attribution can
+        # apportion tick_ms / analytic FLOPs across residents
+        live_traces = sorted(
+            t.trace_id for t in self._slots.values()
+            if t.status is RequestStatus.DECODE
+        )
         t0 = time.perf_counter()
-        with self.tracer.span("serving_tick", occupied=occupied):
+        with self.tracer.span("serving_tick", occupied=occupied,
+                              traces=live_traces):
             tick_kv = ()
             if self.hybrid:
                 # page-count BUCKET: pow2 of the largest resident
@@ -807,8 +873,9 @@ class ServingEngine:
             self._release_pages(slot, tracked)
             self._free.append(slot)
             r = tracked.request
-            self.metrics.record_request({
+            request_record = {
                 "request_id": tracked.request_id,
+                "trace_id": tracked.trace_id,
                 "prompt_tokens": int(len(r.prompt_ids)),
                 "new_tokens": len(tracked.new_tokens),
                 "finish_reason": tracked.finish_reason,
@@ -818,7 +885,11 @@ class ServingEngine:
                     (tracked.t_first_token - tracked.t_submit) * 1000, 3),
                 "e2e_ms": round((t_now - tracked.t_submit) * 1000, 3),
                 "itl_hist": tracked.itl_hist.to_dict(),
-            })
+            }
+            self.metrics.record_request(request_record)
+            if self.slo is not None:
+                self.slo.observe_request(request_record,
+                                         replica=self.metrics.replica)
             if self.retain_results:
                 self.results[tracked.request_id] = GenerationResult(
                     request_id=tracked.request_id,
@@ -846,11 +917,19 @@ class ServingEngine:
             prefill_stall_ms=self._pending_stall_ms,
             prefill_chunk_tokens=self._pending_chunk_tokens,
             prefill_chunk_ms=self._pending_chunk_ms,
+            prefill_real_tokens=self._pending_chunk_real_tokens,
+            prefill_oneshot_tokens=self._pending_oneshot_real_tokens,
+            prefill_oneshot_lanes=self._pending_oneshot_lanes,
+            slot_lanes=self.capacity * self.tokens_per_tick,
+            traces=live_traces,
             **kv_gauges,
         )
         self._pending_stall_ms = 0.0
         self._pending_chunk_tokens = 0
+        self._pending_chunk_real_tokens = 0
         self._pending_chunk_ms = 0.0
+        self._pending_oneshot_real_tokens = 0
+        self._pending_oneshot_lanes = 0
         return events
 
     # ------------------------------------------------------------- frontends

@@ -80,6 +80,13 @@ class ChunkPlan:
         """Left-pad tokens (all inside chunk 0)."""
         return self.bucket - self.prompt_len
 
+    def real_tokens(self, i: int) -> int:
+        """Non-pad prompt tokens in chunk ``i`` — what advances the
+        hybrid KV length mirror, and the chunk's share of USEFUL work
+        in the goodput accounting (``chunk - real`` lanes are padding
+        waste; utils/metrics.record_tick)."""
+        return self.chunk - (self.pad if i == 0 else 0)
+
 
 def plan_chunks(prompt_len: int, chunk_tokens: int,
                 force: bool = False) -> ChunkPlan | None:

@@ -12,6 +12,15 @@ already submitted, accept nothing new) or -> DEAD (failover: the
 ROUTER requeues the replica's unfinished requests elsewhere — a dead
 replica is never trusted to report anything, and is never stepped
 again).
+
+Telemetry: every serving_tick/request record the replica's engine
+emits is stamped with its ``replica`` id, and every per-request span
+with the router-minted ``trace`` id — give each replica its OWN
+``SpanTracer`` (``RequestRouter(replica_tracers=[...])``) and
+``scripts/trace_export.py`` merges the streams into one Perfetto
+timeline with a process track per replica, a request's spans
+flow-linked from the router's ``serving_route`` through to its first
+decode tick here.
 """
 
 from __future__ import annotations

@@ -39,7 +39,7 @@ import dataclasses
 
 import numpy as np
 
-from mamba_distributed_tpu.obs import NULL_TRACER
+from mamba_distributed_tpu.obs import NULL_TRACER, mint_trace_id
 from mamba_distributed_tpu.serving.replica import EngineReplica
 from mamba_distributed_tpu.serving.scheduler import (
     GenerationRequest,
@@ -57,6 +57,12 @@ class _Routed:
 
     request: GenerationRequest
     global_id: int
+    # fabric-wide trace id (obs/context.py), minted ONCE at first
+    # placement and kept HERE (request.trace_id is only stamped for
+    # the duration of each replica submit) — a failover re-placement
+    # continues the same trace on the new replica, while resubmitting
+    # the same request object starts a new one
+    trace_id: str = ""
     replica_id: int | None = None
     local_id: int | None = None
     emitted: int = 0  # tokens already streamed to the consumer
@@ -81,7 +87,17 @@ class RequestRouter:
         per-replica table).  The router truncates it once at
         construction; the replicas append.
       tracer: obs.SpanTracer shared by the router (``serving_route``
-        placement spans) and every replica's engine.
+        placement spans) and — unless ``replica_tracers`` is given —
+        every replica's engine.
+      replica_tracers: optional per-replica SpanTracer list (len ==
+        num_replicas): each replica writes its OWN span stream while
+        the router keeps ``tracer`` — the multi-stream layout
+        ``scripts/trace_export.py`` merges into one Perfetto timeline
+        with per-replica process tracks and per-request flow arrows
+        (trace ids minted here at placement link them).
+      slo: pass an ``obs.SLOMonitor`` via engine kwargs to watch
+        rolling-window latency SLOs — ONE monitor shared by every
+        replica, so the window and breach events are fabric-wide.
       retain_results: keep finished GenerationResults in ``.results``
         (what ``run()`` reads); a long-lived streaming server should
         pass False and consume TokenEvents.
@@ -91,12 +107,17 @@ class RequestRouter:
 
     def __init__(self, params: dict, cfg, num_replicas: int | None = None,
                  capacity: int = 8, *, jsonl_path: str | None = None,
-                 tracer=NULL_TRACER, retain_results: bool = True,
-                 **engine_kw):
+                 tracer=NULL_TRACER, replica_tracers=None,
+                 retain_results: bool = True, **engine_kw):
         if num_replicas is None:
             num_replicas = cfg.serving_replicas
         if num_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {num_replicas}")
+        if replica_tracers is not None and len(replica_tracers) != num_replicas:
+            raise ValueError(
+                f"replica_tracers has {len(replica_tracers)} tracer(s) "
+                f"for {num_replicas} replica(s) — need one per replica"
+            )
         self.cfg = cfg
         self.tracer = tracer
         self.retain_results = retain_results
@@ -109,7 +130,8 @@ class RequestRouter:
             if jsonl_path:
                 metrics.preserve_history()  # router already truncated
             self.replicas.append(EngineReplica(
-                i, params, cfg, metrics=metrics, tracer=tracer,
+                i, params, cfg, metrics=metrics,
+                tracer=(replica_tracers[i] if replica_tracers else tracer),
                 capacity=capacity, retain_results=False, **engine_kw,
             ))
         self._routed: dict[int, _Routed] = {}
@@ -124,7 +146,14 @@ class RequestRouter:
         replica.  Returns the ROUTER-global request id (TokenEvents and
         ``results`` use it).  Raises if the request is invalid (any
         replica would reject it) or no replica is accepting."""
-        routed = _Routed(request=request, global_id=self._next_id)
+        # the trace context is minted HERE, at the fabric's front door,
+        # and lives on the _Routed entry — NOT written back onto the
+        # caller's object — so a failover re-placement (same entry)
+        # continues the same trace while resubmitting the same
+        # GenerationRequest object later starts a fresh one (one
+        # request journey = one trace)
+        routed = _Routed(request=request, global_id=self._next_id,
+                         trace_id=request.trace_id or mint_trace_id())
         self._place(routed)  # raises before the id is ever registered
         self._next_id += 1
         self._routed[routed.global_id] = routed
@@ -142,12 +171,20 @@ class RequestRouter:
         cost, rep = min(((r.place_cost(routed.request), r) for r in cands),
                         key=lambda cr: (cr[0], cr[1].replica_id))
         attrs = dict(request_id=routed.global_id, replica=rep.replica_id,
-                     cost=round(cost, 4),
+                     trace=routed.trace_id, cost=round(cost, 4),
                      queue_depth=rep.engine.scheduler.depth)
         if rep.engine.hybrid:
             attrs["free_pages"] = rep.engine.page_pool.free_pages
-        with self.tracer.span("serving_route", **attrs):
-            local_id = rep.submit(routed.request)
+        # propagate the entry's trace id through the request object only
+        # for the duration of the submit (the scheduler copies it onto
+        # its tracker), then restore the caller's value
+        prev_trace = routed.request.trace_id
+        routed.request.trace_id = routed.trace_id
+        try:
+            with self.tracer.span("serving_route", **attrs):
+                local_id = rep.submit(routed.request)
+        finally:
+            routed.request.trace_id = prev_trace
         routed.replica_id, routed.local_id = rep.replica_id, local_id
         self._by_local[(rep.replica_id, local_id)] = routed
 

@@ -26,6 +26,8 @@ from typing import Iterator
 import jax
 import numpy as np
 
+from mamba_distributed_tpu.obs.context import mint_trace_id
+
 
 class RequestStatus(enum.Enum):
     QUEUED = "queued"
@@ -51,6 +53,12 @@ class GenerationRequest:
     # object (the authoritative id lives on the scheduler's tracker, so
     # resubmission is safe); submit()/TokenEvents carry the real one
     request_id: int | None = None
+    # fabric-wide trace id (obs/context.py).  None => the scheduler
+    # mints a fresh one per submit; the ROUTER sets it at placement so
+    # a failover re-placement continues the SAME trace — one request,
+    # one flow chain in the exported timeline, however many replicas
+    # it visited.
+    trace_id: str | None = None
 
     def resolve_key(self) -> jax.Array:
         key = self.key if self.key is not None else jax.random.PRNGKey(self.seed)
@@ -93,6 +101,10 @@ class _Tracked:
 
     request: GenerationRequest
     request_id: int = -1
+    # the trace id every span/record of this request's journey carries
+    # (request.trace_id when the router propagated one, else minted at
+    # submit — see GenerationRequest.trace_id)
+    trace_id: str = ""
     status: RequestStatus = RequestStatus.QUEUED
     slot: int | None = None
     new_tokens: list[int] = dataclasses.field(default_factory=list)
@@ -143,7 +155,10 @@ class FCFSScheduler:
         request.prompt_ids = prompt
         # the scheduler's counter is authoritative: every submit gets a
         # fresh id, so resubmitting an object can't collide two streams
+        # (a router-propagated trace_id is deliberately reused though —
+        # failover re-placement is the same request's journey)
         tracked = _Tracked(request=request, request_id=self._next_id,
+                           trace_id=request.trace_id or mint_trace_id(),
                            t_submit=time.perf_counter())
         self._next_id += 1
         request.request_id = tracked.request_id  # convenience echo

@@ -128,6 +128,14 @@ class ServingMetrics:
     id, so one shared jsonl stream splits back into per-replica tables
     (scripts/obs_report.py renders queue depth, occupancy, and
     free-page gauges per replica).
+
+    Goodput: every tick record also carries ``useful_tokens`` /
+    ``wasted_token_lanes`` / ``goodput_tokens_per_sec`` /
+    ``serving_mfu`` — raw tok/s with the static-shape waste (empty
+    slot lanes, chunk padding) made visible, and a host-computed MFU
+    from the analytic FLOPs rates the engine installs via
+    ``configure_goodput`` (utils/flops.py "model" convention; no
+    device counters).  ``summary()["goodput"]`` is the roll-up.
     """
 
     def __init__(self, capacity: int, jsonl_path: str | None = None,
@@ -161,6 +169,17 @@ class ServingMetrics:
         self.kv_page_frees = 0
         self.peak_kv_pages_used = 0
         self.finished_requests = 0
+        # goodput accounting (serving/engine.py passes the lane counts):
+        # useful tokens vs token lanes actually computed (padded slots +
+        # chunk padding), plus host-computed serving MFU from the
+        # analytic FLOPs rates configure_goodput() installs
+        self.useful_tokens = 0
+        self.computed_token_lanes = 0
+        self._goodput_window_s = 0.0
+        self._goodput_flops = 0.0
+        self._fpt_decode: float | None = None
+        self._fpt_prefill: float | None = None
+        self._peak_flops: float | None = None
         self.queue_wait_ms = StreamingHistogram()
         self.ttft_ms = StreamingHistogram()
         self.itl_ms = StreamingHistogram()
@@ -172,6 +191,18 @@ class ServingMetrics:
     def preserve_history(self) -> None:
         """Keep an existing jsonl stream (append instead of truncating)."""
         self._truncate_pending = False
+
+    def configure_goodput(self, flops_per_decode_token: float,
+                          flops_per_prefill_token: float,
+                          peak_flops: float) -> None:
+        """Install the analytic FLOPs rates (utils/flops.py, "model"
+        convention — no device counters involved) that turn each tick's
+        useful-token counts into a host-computed ``serving_mfu``.  The
+        engine calls this once at construction; unconfigured metrics
+        still emit the goodput token fields with ``serving_mfu=None``."""
+        self._fpt_decode = flops_per_decode_token
+        self._fpt_prefill = flops_per_prefill_token
+        self._peak_flops = peak_flops
 
     def _write_jsonl(self, record: dict) -> None:
         append_jsonl(self.jsonl_path, record, truncate=self._truncate_pending)
@@ -233,6 +264,10 @@ class ServingMetrics:
         self, occupied: int, queue_depth: int, tokens_emitted: int,
         dt_s: float, prefill_stall_ms: float = 0.0,
         prefill_chunk_tokens: int = 0, prefill_chunk_ms: float = 0.0,
+        prefill_real_tokens: int = 0,
+        prefill_oneshot_tokens: int = 0, prefill_oneshot_lanes: int = 0,
+        slot_lanes: int = 0,
+        traces: list | None = None,
         kv_pages_used: int | None = None,
         kv_pages_capacity: int | None = None,
         kv_page_allocs: int = 0, kv_page_frees: int = 0,
@@ -243,7 +278,24 @@ class ServingMetrics:
         next tick's record — the jsonl stream never drops any);
         ``prefill_chunk_tokens``/``prefill_chunk_ms`` are the chunked-
         prefill tokens dispatched in that window and their dispatch
-        time.  ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
+        time, ``prefill_real_tokens`` the non-pad subset (the chunk-
+        padding half of the goodput waste accounting);
+        ``prefill_oneshot_tokens``/``prefill_oneshot_lanes`` the same
+        real-vs-computed pair for UNCHUNKED admissions in the window
+        (real prompt tokens vs the pow2-padded bucket lanes the
+        one-shot prefill ran), so goodput/MFU stay comparable across
+        the chunking threshold.  ``slot_lanes``
+        is the token lanes the compiled tick computed (capacity x
+        sub-steps — live or not, the static shape runs them all); with
+        the emitted/real counts it yields the per-tick goodput fields:
+        ``useful_tokens``, ``wasted_token_lanes``,
+        ``goodput_tokens_per_sec`` (useful work over the tick + its
+        prefill window) and ``serving_mfu`` (analytic FLOPs of the
+        useful tokens over peak — see ``configure_goodput``).
+        ``traces`` is the live request trace-id set, stamped into the
+        record so host-side attribution can apportion ``tick_ms`` and
+        FLOPs across resident requests (obs/context.py).
+        ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
         engines) gauge the page pool at this tick, with
         ``kv_page_allocs``/``kv_page_frees`` the allocator churn in the
         window — rendered by scripts/obs_report.py."""
@@ -253,6 +305,22 @@ class ServingMetrics:
         self._occupied_sum += occupied
         self._queue_depth_sum += queue_depth
         self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
+        # --- goodput: useful tokens vs computed lanes over the window
+        # (the tick plus the prefill work attributed to it)
+        window_s = dt_s + prefill_stall_ms / 1000.0
+        useful = (tokens_emitted + prefill_real_tokens
+                  + prefill_oneshot_tokens)
+        lanes = slot_lanes + prefill_chunk_tokens + prefill_oneshot_lanes
+        self.useful_tokens += useful
+        self.computed_token_lanes += lanes
+        self._goodput_window_s += window_s
+        mfu = None
+        if self._fpt_decode is not None and self._peak_flops and window_s > 0:
+            flops = (tokens_emitted * self._fpt_decode
+                     + (prefill_real_tokens + prefill_oneshot_tokens)
+                     * self._fpt_prefill)
+            self._goodput_flops += flops
+            mfu = flops / (window_s * self._peak_flops)
         record = {
             "kind": "serving_tick", "tick": self.ticks,
             "occupied": occupied, "capacity": self.capacity,
@@ -263,7 +331,16 @@ class ServingMetrics:
             "prefill_stall_ms": round(prefill_stall_ms, 3),
             "prefill_chunk_tokens": prefill_chunk_tokens,
             "prefill_chunk_ms": round(prefill_chunk_ms, 3),
+            "prefill_oneshot_tokens": prefill_oneshot_tokens,
+            "useful_tokens": useful,
+            "wasted_token_lanes": max(lanes - useful, 0),
+            "goodput_tokens_per_sec": (
+                round(useful / window_s, 1) if window_s > 0 else None
+            ),
+            "serving_mfu": None if mfu is None else round(mfu, 6),
         }
+        if traces is not None:
+            record["traces"] = list(traces)
         if kv_pages_used is not None:
             self.kv_pages_used = kv_pages_used
             self.kv_pages_capacity = kv_pages_capacity
@@ -317,6 +394,26 @@ class ServingMetrics:
             "prefill_stall_s": round(self.prefill_stall_s, 4),
             "prefill_stall_ms": self.prefill_stall_ms.summary(),
             "finished_requests": self.finished_requests,
+            "goodput": {
+                "useful_tokens": self.useful_tokens,
+                "wasted_token_lanes": max(
+                    self.computed_token_lanes - self.useful_tokens, 0
+                ),
+                "useful_fraction": (
+                    round(self.useful_tokens / self.computed_token_lanes, 4)
+                    if self.computed_token_lanes else None
+                ),
+                "goodput_tokens_per_sec": (
+                    round(self.useful_tokens / self._goodput_window_s, 1)
+                    if self._goodput_window_s else None
+                ),
+                "serving_mfu": (
+                    round(self._goodput_flops
+                          / (self._goodput_window_s * self._peak_flops), 6)
+                    if (self._peak_flops and self._goodput_window_s
+                        and self._fpt_decode is not None) else None
+                ),
+            },
             "kv_pages": (
                 None if self.kv_pages_used is None else {
                     "used": self.kv_pages_used,

@@ -1,0 +1,128 @@
+"""Bench regression gate: fresh bench record vs its baseline row.
+
+Compares a fresh ``bench_serving --json`` / ``bench_decode --json``
+record against the matching row of BENCH_SERVING.json (matched by the
+record's ``metric`` name, or pinned with ``--case``) and exits nonzero
+when a higher-is-better field fell below ``baseline * (1 - band)``:
+
+  JAX_PLATFORMS=cpu python scripts/bench_serving.py --json fresh.json
+  python scripts/bench_gate.py fresh.json --band 0.25
+
+The band is the noise allowance — CPU smoke points on shared cores
+need a generous one (the BENCH_SERVING.json notes call out which rows
+are trajectory markers rather than absolute claims); TPU rows can run
+tight.  ``--field`` adds more higher-is-better fields beyond ``value``
+(e.g. ``--field speedup_vs_sequential``).  Exit codes: 0 pass, 1
+regression, 2 no matching baseline row (0 instead with
+``--missing-ok`` — a new metric has no history yet).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_record(path: str) -> dict:
+    """The fresh bench record: last JSON line of the file (the format
+    ``emit_bench_record`` writes)."""
+    with open(path) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    if not lines:
+        raise SystemExit(f"{path} is empty — run the bench with --json first")
+    return json.loads(lines[-1])
+
+
+def find_baseline(cases: list[dict], fresh: dict,
+                  case_name: str | None) -> dict | None:
+    """The baseline case to gate against: ``--case`` by name, else the
+    LAST case whose record.metric matches the fresh record's (the most
+    recent trajectory point wins when a metric has several rows)."""
+    if case_name:
+        matches = [c for c in cases if c.get("name") == case_name]
+    else:
+        matches = [c for c in cases
+                   if c.get("record", {}).get("metric") == fresh.get("metric")]
+    return matches[-1] if matches else None
+
+
+def gate(fresh: dict, baseline: dict, fields: list[str],
+         band: float) -> list[tuple[str, float, float, bool]]:
+    """Compare higher-is-better ``fields``; returns (field, fresh,
+    floor, ok) rows.  Fields absent or null on either side are skipped
+    — a baseline row predating a field must not fail the gate."""
+    rows = []
+    for field in fields:
+        base, new = baseline.get(field), fresh.get(field)
+        if not isinstance(base, (int, float)) or not isinstance(new, (int, float)):
+            continue
+        floor = base * (1.0 - band)
+        rows.append((field, float(new), floor, new >= floor))
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="compare a fresh bench --json record against its "
+                    "BENCH_SERVING.json baseline row; exit nonzero on "
+                    "regression"
+    )
+    p.add_argument("fresh", help="fresh bench record (the --json output)")
+    p.add_argument("--baseline",
+                   default=os.path.join(REPO, "BENCH_SERVING.json"),
+                   help="baseline artifact (default: repo "
+                        "BENCH_SERVING.json)")
+    p.add_argument("--case", default=None,
+                   help="baseline case name to gate against (default: "
+                        "last case whose record.metric matches the "
+                        "fresh record)")
+    p.add_argument("--band", type=float, default=0.25,
+                   help="fractional noise band: fail when a field "
+                        "drops below baseline * (1 - band) (default "
+                        "0.25)")
+    p.add_argument("--field", action="append", default=[],
+                   help="additional higher-is-better record field(s) "
+                        "to gate beyond 'value' (repeatable)")
+    p.add_argument("--missing-ok", action="store_true",
+                   help="exit 0 when no baseline row matches (new "
+                        "metric, no history yet)")
+    args = p.parse_args(argv)
+    if not 0.0 <= args.band < 1.0:
+        p.error(f"--band must be in [0, 1), got {args.band}")
+
+    fresh = load_record(args.fresh)
+    with open(args.baseline) as f:
+        cases = json.load(f).get("cases", [])
+    case = find_baseline(cases, fresh, args.case)
+    if case is None:
+        msg = (f"no baseline case matches "
+               f"{'--case ' + args.case if args.case else 'metric ' + repr(fresh.get('metric'))}")
+        if args.missing_ok:
+            print(f"{msg} — passing (--missing-ok)")
+            return 0
+        print(msg, file=sys.stderr)
+        return 2
+
+    rows = gate(fresh, case["record"], ["value"] + args.field, args.band)
+    if not rows:
+        print(f"no comparable numeric fields between fresh record and "
+              f"baseline case {case.get('name')!r}", file=sys.stderr)
+        return 2
+    failed = False
+    print(f"gate: fresh {args.fresh} vs baseline case "
+          f"{case.get('name')!r} (band {args.band * 100:.0f}%)")
+    for field, new, floor, ok in rows:
+        base = case["record"].get(field)
+        verdict = "ok" if ok else "REGRESSION"
+        print(f"  {field}: {new} vs baseline {base} "
+              f"(floor {floor:.3f}) {verdict}")
+        failed |= not ok
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,11 +9,19 @@ serving_tick/request) — and prints:
     its time: data_load vs train_step vs eval vs checkpoint_save, or
     serving_admit vs serving_tick);
   * train-step statistics (steps, loss movement, step time, tokens/sec);
-  * serving tick statistics (occupancy, tick time, decode tokens/sec);
+  * serving tick statistics (occupancy, tick time, decode tokens/sec)
+    plus goodput: useful tokens vs computed-but-wasted token lanes,
+    goodput tokens/sec and the host-computed serving MFU the engine
+    stamps on every tick record;
   * per-request latency percentiles: queue-wait / TTFT / end-to-end
     exactly (the scalars are in the records), inter-token latency by
     merging the per-request streaming histograms each record carries
-    (obs/histogram.py — p50/p95/p99 without any stored samples).
+    (obs/histogram.py — p50/p95/p99 without any stored samples) — per
+    replica AND merged fabric-wide when the records are
+    replica-stamped;
+  * SLO attainment: when an obs/slo.py monitor stamped its targets
+    (slo_config event) into the stream, the per-metric attainment
+    table plus the breach/recovery transitions.
 
 Usage:
   python scripts/obs_report.py log/events.jsonl log/metrics.jsonl
@@ -31,6 +39,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from mamba_distributed_tpu.obs.export import load_jsonl  # noqa: E402
 from mamba_distributed_tpu.obs.histogram import StreamingHistogram  # noqa: E402
 
 
@@ -38,22 +47,12 @@ def load_events(paths: list[str]) -> list[dict]:
     """All parseable records from all files, in file order.  Unparseable
     lines are counted, not fatal — a crashed writer can leave a torn
     final line, and the report must still come out."""
-    events, bad = [], 0
+    events, bad = [], []
     for path in paths:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    bad += 1
-                    continue
-                if isinstance(rec, dict):
-                    events.append(rec)
+        events.extend(load_jsonl(path, bad_lines=bad))
     if bad:
-        print(f"warning: skipped {bad} unparseable line(s)", file=sys.stderr)
+        print(f"warning: skipped {len(bad)} unparseable line(s)",
+              file=sys.stderr)
     return events
 
 
@@ -166,6 +165,38 @@ def build_report(events: list[dict]) -> dict:
                 "allocs": sum(e.get("kv_page_allocs", 0) for e in kv_ticks),
                 "frees": sum(e.get("kv_page_frees", 0) for e in kv_ticks),
             }
+        # goodput accounting (absent in pre-goodput streams): useful
+        # tokens vs computed token lanes per tick window, plus the
+        # host-computed serving MFU (window-weighted mean, so long
+        # ticks count for what they cost)
+        gticks = [e for e in ticks if e.get("useful_tokens") is not None]
+        goodput = None
+        if gticks:
+            window = lambda e: ((e.get("tick_ms") or 0.0)
+                                + (e.get("prefill_stall_ms") or 0.0))
+            useful = sum(e["useful_tokens"] for e in gticks)
+            wasted = sum(e.get("wasted_token_lanes", 0) for e in gticks)
+            window_ms = sum(window(e) for e in gticks)
+            mfu_ticks = [e for e in gticks
+                         if e.get("serving_mfu") is not None]
+            mfu_den = sum(window(e) for e in mfu_ticks)
+            goodput = {
+                "useful_tokens": useful,
+                "wasted_token_lanes": wasted,
+                "useful_fraction": (
+                    round(useful / (useful + wasted), 4)
+                    if useful + wasted else None
+                ),
+                "goodput_tokens_per_sec": (
+                    round(useful / (window_ms / 1000), 1)
+                    if window_ms else None
+                ),
+                "serving_mfu": (
+                    round(sum(e["serving_mfu"] * window(e)
+                              for e in mfu_ticks) / mfu_den, 6)
+                    if mfu_den else None
+                ),
+            }
         report["serving"] = {
             "ticks": len(ticks),
             "decode_tokens": tokens,
@@ -184,6 +215,7 @@ def build_report(events: list[dict]) -> dict:
                 round(chunk_tokens / (chunk_total_ms / 1000), 1)
                 if chunk_tokens and chunk_total_ms else None
             ),
+            "goodput": goodput,
             "kv_pages": kv_pages,
         }
 
@@ -209,9 +241,30 @@ def build_report(events: list[dict]) -> dict:
                     (e.get("kv_pages_capacity") or 0) - e["kv_pages_used"]
                 )
         req_by_rep: dict[int, int] = {}
+        # per-replica ITL: each replica's request records carry
+        # mergeable streaming histograms — merge them per replica AND
+        # across the whole fabric, so the per-replica split and the
+        # fabric-wide latency view come from the same bounded state
+        itl_by_rep: dict[int, StreamingHistogram] = {}
+        fabric_itl: StreamingHistogram | None = None
         for e in events:
             if e.get("kind") == "request" and e.get("replica") is not None:
-                req_by_rep[e["replica"]] = req_by_rep.get(e["replica"], 0) + 1
+                rid = e["replica"]
+                req_by_rep[rid] = req_by_rep.get(rid, 0) + 1
+                h = e.get("itl_hist")
+                if h:
+                    h = StreamingHistogram.from_dict(h)
+                    if rid in itl_by_rep:
+                        itl_by_rep[rid].merge(h)
+                    else:
+                        itl_by_rep[rid] = h
+                    # the fabric view accumulates into its OWN (empty,
+                    # same-geometry) histogram — seeding it with h would
+                    # alias a per-replica view's state
+                    if fabric_itl is None:
+                        fabric_itl = StreamingHistogram(h.lo, h.hi,
+                                                        h.growth)
+                    fabric_itl.merge(h)
         report["replicas"] = {
             rid: {
                 "ticks": d["ticks"],
@@ -225,9 +278,17 @@ def build_report(events: list[dict]) -> dict:
                 "min_kv_free_pages": (
                     min(d["kv_free"]) if d["kv_free"] else None
                 ),
+                "itl_ms": (
+                    itl_by_rep[rid].summary() if rid in itl_by_rep else None
+                ),
             }
             for rid, d in sorted(per.items())
         }
+        if fabric_itl is not None:
+            report["fabric"] = {
+                "requests": sum(req_by_rep.values()),
+                "itl_ms": fabric_itl.summary(),
+            }
 
     # --- per-request latency (the serving stream's "request" records)
     reqs = [e for e in events if e.get("kind") == "request"]
@@ -257,8 +318,59 @@ def build_report(events: list[dict]) -> dict:
             "itl_ms": itl.summary() if itl is not None else None,
         }
 
-    # --- point events (divergence markers etc.)
+    # --- SLO attainment (obs/slo.py): the monitor stamps its targets
+    # into the stream as an slo_config event, so attainment is
+    # recomputable offline from the request records; breach/recovery
+    # transitions are their own event records
     marks = [e for e in events if e.get("kind") == "event"]
+    slo_cfgs = [e for e in marks if e.get("name") == "slo_config"]
+    if slo_cfgs:
+        cfg_ev = slo_cfgs[-1]
+        breaches = [e for e in marks if e.get("name") == "slo_breach"]
+        recoveries = [e for e in marks if e.get("name") == "slo_recovered"]
+        metrics_out: dict[str, dict] = {}
+        for metric in ("ttft_ms", "itl_ms", "queue_wait_ms"):
+            target = cfg_ev.get(f"{metric}_p95_target")
+            if not target:
+                continue
+            if metric == "itl_ms":
+                # per-request judgement: the request's own ITL p95
+                vals = []
+                for e in reqs:
+                    h = e.get("itl_hist")
+                    if h and h.get("count"):
+                        vals.append(
+                            StreamingHistogram.from_dict(h).percentile(95)
+                        )
+            else:
+                vals = [e[metric] for e in reqs
+                        if e.get(metric) is not None]
+            met = sum(1 for v in vals if v <= target)
+            metrics_out[metric] = {
+                "target_p95_ms": target,
+                "requests": len(vals),
+                "met": met,
+                "attainment": (
+                    round(met / len(vals), 4) if vals else None
+                ),
+                "breaches": sum(
+                    1 for e in breaches if e.get("metric") == metric
+                ),
+            }
+        report["slo"] = {
+            "window": cfg_ev.get("window"),
+            "metrics": metrics_out,
+            # chronological, so list order IS the breach timeline
+            # (breach -> recovered -> breach must not read as ended-
+            # recovered)
+            "breach_events": [
+                {k: v for k, v in e.items() if k != "kind"}
+                for e in sorted(breaches + recoveries,
+                                key=lambda e: e.get("t_ms", 0.0))
+            ],
+        }
+
+    # --- point events (divergence markers etc.)
     if marks:
         report["events"] = [
             {k: v for k, v in e.items() if k != "kind"} for e in marks
@@ -327,6 +439,17 @@ def format_report(report: dict) -> str:
                 f"   prefill chunk tokens: {s['prefill_chunk_tokens']}"
                 f" (dispatch tok/s: {_fmt(s['prefill_chunk_tokens_per_sec'])})"
             )
+        if s.get("goodput"):
+            g = s["goodput"]
+            mfu = g["serving_mfu"]
+            head += (
+                f"\ngoodput: {g['useful_tokens']} useful tokens / "
+                f"{g['wasted_token_lanes']} wasted lanes "
+                f"(useful {_fmt(g['useful_fraction'])})   "
+                f"goodput tok/s: {_fmt(g['goodput_tokens_per_sec'])}   "
+                f"serving MFU: "
+                f"{'-' if mfu is None else f'{mfu * 100:.2f}%'}"
+            )
         if s.get("kv_pages"):
             kv = s["kv_pages"]
             head += (
@@ -341,15 +464,39 @@ def format_report(report: dict) -> str:
             rows, ["metric", "count", "mean", "p50", "p95", "p99", "max"],
         ))
     if "replicas" in report:
+        def _itl(d):
+            itl = d.get("itl_ms")
+            return ("-" if not itl
+                    else f"{_fmt(itl['p50'])}/{_fmt(itl['p95'])}")
+
         rows = [
             [rid, d["requests"], d["ticks"], d["decode_tokens"],
              _fmt(d["mean_occupancy"]), d["peak_queue_depth"],
-             _fmt(d["min_kv_free_pages"])]
+             _fmt(d["min_kv_free_pages"]), _itl(d)]
             for rid, d in report["replicas"].items()
         ]
+        if "fabric" in report:
+            f = report["fabric"]
+            rows.append(["all", f["requests"], "-", "-", "-", "-", "-",
+                         _itl(f)])
         out.append("== per-replica (serving fabric) ==\n" + _table(
             rows, ["replica", "requests", "ticks", "decode_tokens",
-                   "mean_occ", "peak_queue", "min_kv_free"]
+                   "mean_occ", "peak_queue", "min_kv_free",
+                   "itl_p50/p95"]
+        ))
+    if "slo" in report:
+        s = report["slo"]
+        rows = [
+            [m, d["target_p95_ms"], d["requests"], d["met"],
+             "-" if d["attainment"] is None
+             else f"{d['attainment'] * 100:.1f}%",
+             d["breaches"]]
+            for m, d in s["metrics"].items()
+        ]
+        head = f"== SLO attainment (rolling window {_fmt(s['window'])}) =="
+        out.append(head + "\n" + _table(
+            rows, ["metric", "target_p95_ms", "requests", "met",
+                   "attainment", "breaches"]
         ))
     if "requests" in report:
         r = report["requests"]

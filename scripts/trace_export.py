@@ -1,0 +1,64 @@
+"""Merge span jsonl streams into one Perfetto-loadable Chrome trace.
+
+Takes any number of SpanTracer streams — the router's plus one per
+replica (``RequestRouter(replica_tracers=[...])``), or a trainer's
+events.jsonl — aligns them on their ``trace_header`` wall-clock epochs,
+and writes one Chrome trace-event JSON file:
+
+  python scripts/trace_export.py run1.jsonl run2.jsonl -o trace.json
+
+Open the output at https://ui.perfetto.dev or in ``chrome://tracing``:
+each input stream is a process track, spans are slices, and one
+request's journey (router placement -> replica prefill/chunks -> first
+decode tick) is a flow-arrow chain keyed on its ``trace`` id
+(obs/context.py) — click a slice, follow the arrows.
+
+Streams without a header (pre-PR-7 files) still export but sit at
+epoch 0 on their own clock; the script warns.  docs/OBSERVABILITY.md
+documents the stream schema; mamba_distributed_tpu/obs/export.py is
+the library half.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mamba_distributed_tpu.obs.export import export_chrome_trace  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge span jsonl streams into one Chrome "
+                    "trace-event file (loads in Perfetto / "
+                    "chrome://tracing)"
+    )
+    p.add_argument("files", nargs="+",
+                   help="span jsonl stream(s): router + replica tracer "
+                        "files, trainer events.jsonl — any mix")
+    p.add_argument("-o", "--output", default="trace.json",
+                   help="output trace-event JSON path (default "
+                        "trace.json)")
+    args = p.parse_args(argv)
+    meta = export_chrome_trace(args.files, args.output)
+    if meta["unaligned_streams"]:
+        print(
+            f"warning: {meta['unaligned_streams']} stream(s) have no "
+            f"trace_header record (pre-header stream?) — placed at "
+            f"epoch 0, NOT aligned to the others",
+            file=sys.stderr,
+        )
+    print(
+        f"wrote {args.output}: {meta['streams']} stream(s), "
+        f"{meta['linked_requests']} flow-linked request(s), "
+        f"{meta['flow_events']} flow event(s) — load it in Perfetto "
+        f"(ui.perfetto.dev) or chrome://tracing"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -131,3 +131,62 @@ def test_bench_serving_long_prompt_smoke(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "prefill_stall_ms" in r.stdout
     assert "prefill chunk tokens" in r.stdout
+
+
+@pytest.mark.serving
+def test_bench_gate_smoke(tmp_path, monkeypatch):
+    """CI smoke for the bench regression gate (ISSUE 7 satellite): a
+    fresh tiny ``bench_serving --json`` run passes against a baseline
+    row inside the noise band, fails against an inflated one, and the
+    goodput/SLO-era record still gates cleanly against the committed
+    BENCH_SERVING.json (--missing-ok covers a metric with no history)."""
+    import json
+
+    fresh = str(tmp_path / "fresh.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="2", SERVE_CAPACITY="2",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="6",
+               SERVE_MAX_NEW="3", SERVE_TOKENS_PER_TICK="3")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--json", fresh],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(open(fresh).read().strip())
+
+    def gate(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+             fresh, *args],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+
+    def baseline(value, speedup=None):
+        path = str(tmp_path / "baseline.json")
+        record = {"metric": rec["metric"], "value": value}
+        if speedup is not None:
+            record["speedup_vs_sequential"] = speedup
+        json.dump({"cases": [{"name": "tiny_smoke", "record": record}]},
+                  open(path, "w"))
+        return path
+
+    # within the band: fresh value sits well above baseline * (1 - band)
+    r = gate("--baseline", baseline(rec["value"] * 0.9), "--band", "0.25")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ok" in r.stdout
+    # regression on an extra higher-is-better field: value passes, the
+    # unreachable speedup floor fails the gate
+    r = gate("--baseline", baseline(rec["value"] * 0.9, speedup=1e9),
+             "--band", "0.1", "--field", "speedup_vs_sequential")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+    # the committed artifact: tiny mamba2 smoke has no baseline row
+    # (only hybrid/router rows) — rc 2 reports "no baseline" distinctly
+    # unless --missing-ok opts into the new-metric path (in-process to
+    # keep the smoke cheap; the CLI surface is exercised above)
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_gate
+
+    assert bench_gate.main([fresh, "--band", "0.99"]) == 2
+    assert bench_gate.main([fresh, "--band", "0.99", "--missing-ok"]) == 0

@@ -12,6 +12,7 @@ import json
 import os
 import subprocess
 import sys
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -160,6 +161,8 @@ def test_span_tracer_nesting_and_attrs(tmp_path):
             pass
     t.event("mark", loss=float("nan"))
     ev = load_events([path])
+    header = ev.pop(0)  # first write stamps the wall-clock epoch
+    assert header["kind"] == "trace_header" and header["wall_t0_s"] > 0
     inner, outer, mark = ev
     assert inner["name"] == "inner" and inner["depth"] == 1
     assert inner["parent"] == "outer"
@@ -175,7 +178,8 @@ def test_span_tracer_records_on_exception(tmp_path):
     with pytest.raises(RuntimeError):
         with t.span("dies"):
             raise RuntimeError("boom")
-    (rec,) = load_events([str(tmp_path / "e.jsonl")])
+    (rec,) = [e for e in load_events([str(tmp_path / "e.jsonl")])
+              if e["kind"] == "span"]
     assert rec["name"] == "dies"
 
 
@@ -185,18 +189,28 @@ def test_span_tracer_resume_preserves_history(tmp_path):
     ran (the checkpoint-resume / --auto-restart path, same contract as
     MetricsLogger) — the pre-crash spans are the post-mortem artifact."""
     path = str(tmp_path / "events.jsonl")
+
+    def span_names():
+        return [e["name"] for e in load_events([path])
+                if e["kind"] == "span"]
+
     t = SpanTracer(path)
     with t.span("before_crash"):
         pass
     t2 = SpanTracer(path)  # fresh run: truncates on first write
     with t2.span("fresh"):
         pass
-    assert [e["name"] for e in load_events([path])] == ["fresh"]
+    assert span_names() == ["fresh"]
     t3 = SpanTracer(path)  # resumed run: appends
     t3.preserve_history()
     with t3.span("after_resume"):
         pass
-    assert [e["name"] for e in load_events([path])] == ["fresh", "after_resume"]
+    assert span_names() == ["fresh", "after_resume"]
+    # each tracer stamped its own wall-clock epoch header, so the
+    # resumed tracer's restarted t_ms offsets stay alignable
+    headers = [e for e in load_events([path])
+               if e["kind"] == "trace_header"]
+    assert len(headers) == 2
     NULL_TRACER.preserve_history()  # must exist on the disabled tracer too
 
 
@@ -311,7 +325,7 @@ def test_trainer_telemetry_zero_extra_traces(tmp_path):
     assert delta == {"train_step": 1, "eval_step": 1}, delta
 
     ev = load_events([os.path.join(t.cfg.log_dir, "events.jsonl")])
-    names = {e["name"] for e in ev}
+    names = {e["name"] for e in ev if e["kind"] == "span"}
     assert {"data_load", "train_step", "eval"} <= names
     # sentinel saw every step, nothing diverged, no dump
     assert len(t.sentinel.flight) >= 2
@@ -411,7 +425,8 @@ def test_engine_request_telemetry_and_stream(tmp_path):
     for r in reqs:
         assert r["queue_wait_ms"] <= r["ttft_ms"] <= r["e2e_ms"]
         assert r["itl_hist"]["count"] == r["new_tokens"] - 1
-    spans = {e["name"] for e in load_events([str(tmp_path / "events.jsonl")])}
+    spans = {e["name"] for e in load_events([str(tmp_path / "events.jsonl")])
+             if e["kind"] == "span"}
     assert {"serving_admit", "serving_tick"} <= spans
 
 
@@ -530,6 +545,490 @@ def test_obs_report_survives_torn_lines(tmp_path):
     )
     report = build_report(load_events([str(path)]))
     assert report["train"]["steps"] == 1
+
+
+# --------------------------------------- request-flow tracing (ISSUE 7)
+
+
+@pytest.mark.fast
+def test_trace_ids_unique_and_context():
+    from mamba_distributed_tpu.obs import mint_trace_id
+
+    ids = {mint_trace_id() for _ in range(100)}
+    assert len(ids) == 100  # monotone counter under the process nonce
+
+
+@pytest.mark.fast
+def test_tracer_wall_clock_header(tmp_path):
+    """Satellite: t_ms is a per-process perf_counter offset; the header
+    record stamps the wall-clock epoch that makes streams mergeable."""
+    import time
+
+    path = str(tmp_path / "e.jsonl")
+    before = time.time()
+    t = SpanTracer(path)
+    after = time.time()
+    t.event("mark")
+    header = load_events([path])[0]
+    assert header["kind"] == "trace_header"
+    assert before - 1e-3 <= header["wall_t0_s"] <= after + 1e-3
+    assert header["pid"] == os.getpid()
+
+
+@pytest.mark.fast
+def test_tracer_stamps_per_thread_tids(tmp_path):
+    """Spans from different host threads (async checkpoint vs trainer)
+    overlap un-nested in wall time — each thread needs its own tid or
+    the exported track holds invalid overlapping slices."""
+    import threading
+
+    path = str(tmp_path / "e.jsonl")
+    t = SpanTracer(path)
+    with t.span("main_phase"):
+        th = threading.Thread(target=lambda: t.event("worker_mark"))
+        th.start()
+        th.join()
+    recs = [r for r in load_events([path]) if r["kind"] != "trace_header"]
+    tids = {r["name"]: r["tid"] for r in recs}
+    assert tids["main_phase"] != tids["worker_mark"]
+    assert sorted(tids.values()) == [0, 1]  # small stable indices
+
+
+@pytest.mark.fast
+def test_trace_ids_fork_safe():
+    """A fork-spawned worker must reseed the process nonce: inheriting
+    the parent's nonce+counter would mint colliding ids fabric-wide."""
+    from mamba_distributed_tpu.obs import mint_trace_id
+
+    if not hasattr(os, "fork"):
+        pytest.skip("no fork on this platform")
+    parent_id = mint_trace_id()
+    r, w = os.pipe()
+    with warnings.catch_warnings():
+        # jax warns that fork + threads may deadlock; the child only
+        # mints an id, writes a pipe and _exits — no locks touched
+        warnings.simplefilter("ignore", RuntimeWarning)
+        pid = os.fork()
+    if pid == 0:  # child: mint under the reseeded nonce, report, exit
+        os.write(w, mint_trace_id().encode())
+        os._exit(0)
+    os.close(w)
+    child_id = os.read(r, 256).decode()
+    os.close(r)
+    os.waitpid(pid, 0)
+    assert child_id and child_id != parent_id
+    # nonce differs, not just the counter suffix
+    assert child_id.rsplit("-", 1)[0] != parent_id.rsplit("-", 1)[0]
+
+
+def test_engine_stamps_traces_and_goodput(tmp_path):
+    """Acceptance pins: every request record carries trace_id, every
+    serving_tick record carries useful_tokens / goodput_tokens_per_sec /
+    serving_mfu plus the live trace-id set, and per-request spans carry
+    the trace attr."""
+    cfg, params = _tiny_serving()
+    jsonl = str(tmp_path / "serving.jsonl")
+    events = str(tmp_path / "events.jsonl")
+    metrics = ServingMetrics(capacity=2, jsonl_path=jsonl)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        metrics=metrics, tracer=SpanTracer(events))
+    eng.run([GenerationRequest(prompt_ids=np.ones(4 + i, np.int32),
+                               max_new_tokens=4, key=jax.random.PRNGKey(i))
+             for i in range(3)])
+    recs = load_events([jsonl])
+    reqs = [r for r in recs if r["kind"] == "request"]
+    ticks = [r for r in recs if r["kind"] == "serving_tick"]
+    traces = {r["trace_id"] for r in reqs}
+    assert len(traces) == 3  # one trace per request journey
+    seen_live = set()
+    for t in ticks:
+        assert t["useful_tokens"] >= 0
+        assert t["wasted_token_lanes"] >= 0
+        # lanes computed = capacity * tokens_per_tick (+ chunk lanes)
+        assert t["useful_tokens"] + t["wasted_token_lanes"] >= 2 * 2
+        assert t["goodput_tokens_per_sec"] is not None
+        assert "serving_mfu" in t and t["serving_mfu"] >= 0
+        seen_live.update(t["traces"])
+    assert seen_live == traces  # every request decoded under its trace
+    total_emitted = sum(t["tokens_emitted"] for t in ticks)
+    assert total_emitted == 12
+    # ONE-SHOT prefills count toward goodput too (4+5+6 prompt tokens)
+    # — useful work must be comparable across the chunking threshold
+    assert sum(t["prefill_oneshot_tokens"] for t in ticks) == 15
+    assert sum(t["useful_tokens"] for t in ticks) == total_emitted + 15
+    # per-request spans in the tracer stream carry the trace attr
+    spans = [e for e in load_events([events]) if e["kind"] == "span"]
+    prefill_traces = {s["trace"] for s in spans
+                      if s["name"] == "serving_prefill"}
+    assert prefill_traces == traces
+    g = metrics.summary()["goodput"]
+    assert g["useful_tokens"] == 12 + 15
+    assert g["goodput_tokens_per_sec"] > 0
+    assert g["serving_mfu"] is not None and g["serving_mfu"] >= 0
+    assert g["useful_fraction"] is not None and 0 < g["useful_fraction"] <= 1
+
+
+def test_oneshot_only_config_prices_prefill_flops():
+    """With chunking disabled (prefill_chunk_tokens=0, one-shot only)
+    the prefill FLOPs rate must be priced at a representative prompt
+    length, not seq_len=1.  (Hybrid engines — where the O(t) attention
+    terms make the length matter most — reject chunking-disabled
+    configs outright, so this pins the defensive non-hybrid path.)"""
+    from mamba_distributed_tpu.utils.flops import flops_per_token
+
+    cfg, params = _tiny_serving()
+    cfg = dataclasses.replace(cfg, prefill_chunk_tokens=0)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    expect = flops_per_token(cfg, 256, training=False, convention="model")
+    assert eng.metrics._fpt_prefill == expect
+
+
+def test_router_resubmission_mints_fresh_trace(tmp_path):
+    """Submitting the SAME GenerationRequest object twice is two
+    journeys: the router keeps the minted trace on its routing entry
+    (so a failover re-placement continues it) without writing it back
+    onto the caller's object — the second submission gets a new
+    trace id, not a replay of the first one's."""
+    cfg, params = _tiny_serving()
+    from mamba_distributed_tpu.serving import RequestRouter
+
+    jsonl = str(tmp_path / "serve.jsonl")
+    router = RequestRouter(params, cfg, num_replicas=1, capacity=2,
+                           tokens_per_tick=2, jsonl_path=jsonl)
+    req = GenerationRequest(prompt_ids=np.ones(4, np.int32),
+                            max_new_tokens=3, key=jax.random.PRNGKey(0))
+    router.run([req])
+    assert req.trace_id is None  # caller's object never mutated
+    router.run([req])
+    recs = [r for r in load_events([jsonl]) if r["kind"] == "request"]
+    assert len(recs) == 2
+    assert recs[0]["trace_id"] != recs[1]["trace_id"]
+
+
+def _tiny_chunked_serving():
+    """The tiny serving model with chunked prefill on — ONE shared
+    shape for every chunk-path test in this file, so the tier-1 run
+    compiles its chunk step/tick once."""
+    cfg, params = _tiny_serving()
+    cfg = dataclasses.replace(cfg, prefill_chunk_tokens=16,
+                              prefill_tokens_per_tick=16)
+    return cfg, params
+
+
+def test_chunked_prefill_goodput_counts_padding_waste(tmp_path):
+    """Chunk padding is waste: a prompt that left-pads inside chunk 0
+    contributes chunk-minus-real wasted lanes to the tick stream."""
+    cfg, params = _tiny_chunked_serving()
+    jsonl = str(tmp_path / "serving.jsonl")
+    metrics = ServingMetrics(capacity=2, jsonl_path=jsonl)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        metrics=metrics)
+    # 40-token prompt -> 48-token bucket (3 chunks), 8 pad lanes
+    eng.run([GenerationRequest(prompt_ids=np.arange(40, dtype=np.int32) % 7,
+                               max_new_tokens=3,
+                               key=jax.random.PRNGKey(0))])
+    ticks = [r for r in load_events([jsonl])
+             if r["kind"] == "serving_tick"]
+    assert sum(t["prefill_chunk_tokens"] for t in ticks) == 48
+    real = sum(t["useful_tokens"] - t["tokens_emitted"] for t in ticks)
+    assert real == 40  # non-pad prompt tokens counted useful
+    assert metrics.summary()["goodput"]["useful_tokens"] == 40 + 3
+
+
+# ------------------------------------------------------------ SLO monitor
+
+
+@pytest.mark.fast
+def test_slo_monitor_breach_and_recovery(tmp_path):
+    from mamba_distributed_tpu.obs import SLOMonitor
+
+    tracer = SpanTracer(str(tmp_path / "e.jsonl"))
+    mon = SLOMonitor(ttft_p95_ms=100.0, window=4, tracer=tracer)
+
+    def req(ttft):
+        return {"ttft_ms": ttft, "queue_wait_ms": 1.0}
+
+    for _ in range(4):
+        mon.observe_request(req(50.0))
+    assert mon.breaches["ttft_ms"] == 0
+    for _ in range(4):  # window fills with breaching samples
+        mon.observe_request(req(500.0))
+    assert mon.breaches["ttft_ms"] == 1  # ONE transition, not 4 alarms
+    for _ in range(4):  # recover
+        mon.observe_request(req(10.0))
+    ev = [e for e in load_events([str(tmp_path / "e.jsonl")])
+          if e["kind"] == "event"]
+    names = [e["name"] for e in ev]
+    assert names.count("slo_breach") == 1
+    assert names.count("slo_recovered") == 1
+    assert names[0] == "slo_config"  # targets stamped into the stream
+    s = mon.summary()["metrics"]["ttft_ms"]
+    assert s["requests"] == 12 and s["met"] == 8
+    assert s["attainment"] == pytest.approx(8 / 12, abs=1e-4)
+    assert not s["in_breach"]
+
+
+@pytest.mark.fast
+def test_slo_monitor_itl_uses_request_histogram():
+    from mamba_distributed_tpu.obs import SLOMonitor
+
+    mon = SLOMonitor(itl_p95_ms=20.0, window=8)
+    h = StreamingHistogram()
+    for v in [5.0] * 19 + [100.0]:  # p95 == 5ms -> meets target
+        h.record(v)
+    mon.observe_request({"itl_hist": h.to_dict()})
+    mon.observe_request({"itl_hist": None})  # 1-token request: no ITL
+    s = mon.summary()["metrics"]["itl_ms"]
+    assert s["requests"] == 1 and s["met"] == 1
+
+
+@pytest.mark.fast
+def test_slo_config_knobs_validate():
+    from mamba_distributed_tpu.obs import SLOMonitor
+
+    with pytest.raises(ValueError, match=">= 0"):
+        TelemetryConfig(slo_ttft_p95_ms=-1.0)
+    with pytest.raises(ValueError, match="slo_window_requests"):
+        TelemetryConfig(slo_window_requests=0)
+    with pytest.raises(ValueError, match="window"):
+        SLOMonitor(ttft_p95_ms=1.0, window=0)
+    # from_config: None when nothing is targeted, a live monitor else
+    assert SLOMonitor.from_config(TelemetryConfig()) is None
+    mon = SLOMonitor.from_config(
+        TelemetryConfig(slo_ttft_p95_ms=50.0, slo_window_requests=16)
+    )
+    assert mon is not None and mon.window == 16
+    assert mon.targets == {"ttft_ms": 50.0}
+
+
+# --------------------------------------------- trace export (tentpole)
+
+
+@pytest.mark.fast
+def test_chrome_trace_aligns_streams_on_wall_clock():
+    """Two streams whose t_ms offsets overlap but whose wall epochs
+    differ must land disjoint on the merged timeline."""
+    from mamba_distributed_tpu.obs import to_chrome_trace
+
+    a = [{"kind": "trace_header", "wall_t0_s": 100.0, "pid": 1},
+         {"kind": "span", "name": "x", "t_ms": 10.0, "dur_ms": 5.0}]
+    b = [{"kind": "trace_header", "wall_t0_s": 200.0, "pid": 2},
+         {"kind": "span", "name": "y", "t_ms": 10.0, "dur_ms": 5.0}]
+    doc = to_chrome_trace([a, b], labels=["a", "b"])
+    spans = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert spans["x"]["ts"] == pytest.approx(100.0 * 1e6 + 10_000)
+    assert spans["y"]["ts"] == pytest.approx(200.0 * 1e6 + 10_000)
+    assert spans["x"]["pid"] != spans["y"]["pid"]
+    assert doc["metadata"]["unaligned_streams"] == 0
+    # headerless stream: exported, but counted unaligned
+    doc2 = to_chrome_trace([[{"kind": "span", "name": "z", "t_ms": 1.0,
+                              "dur_ms": 1.0}]])
+    assert doc2["metadata"]["unaligned_streams"] == 1
+
+
+def test_trace_export_flow_links_router_to_replica(tmp_path):
+    """Acceptance criterion: one command turns a 2-replica router run's
+    streams into a single Perfetto-loadable trace in which a request's
+    spans are flow-linked across router -> replica -> engine — verified
+    by parsing the trace-event JSON."""
+    cfg, params = _tiny_serving()
+    from mamba_distributed_tpu.serving import RequestRouter
+
+    paths = [str(tmp_path / n)
+             for n in ("router.jsonl", "rep0.jsonl", "rep1.jsonl")]
+    router = RequestRouter(
+        params, cfg, num_replicas=2, capacity=2, tokens_per_tick=2,
+        jsonl_path=str(tmp_path / "serve.jsonl"),
+        tracer=SpanTracer(paths[0]),
+        replica_tracers=[SpanTracer(paths[1]), SpanTracer(paths[2])],
+    )
+    router.run([GenerationRequest(prompt_ids=np.ones(4 + i, np.int32),
+                                  max_new_tokens=4,
+                                  key=jax.random.PRNGKey(i))
+                for i in range(4)])
+    out = str(tmp_path / "trace.json")
+    # the one command from the acceptance criterion
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_export.py"),
+         *paths, "-o", out],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    doc = json.load(open(out))
+    events = doc["traceEvents"]
+    # three process tracks, named after the streams
+    names = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert names == {"router.jsonl", "rep0.jsonl", "rep1.jsonl"}
+    # every request's flow chain starts on the ROUTER track (pid 0,
+    # the serving_route span) and finishes on a REPLICA track
+    flows = [e for e in events if e.get("cat") == "request"]
+    # flow ids are the trace ids themselves (strings) — hashing to an
+    # int would reintroduce cross-linking collisions
+    assert all(isinstance(f["id"], str) for f in flows)
+    by_id: dict = {}
+    for f in flows:
+        by_id.setdefault(f["id"], []).append(f)
+    assert len(by_id) == 4  # all four requests linked
+    for chain in by_id.values():
+        chain.sort(key=lambda e: e["ts"])
+        assert chain[0]["ph"] == "s" and chain[0]["pid"] == 0
+        assert chain[-1]["ph"] == "f" and chain[-1]["pid"] in (1, 2)
+        # arrows bind inside real slices on their tracks
+        for f in chain:
+            assert any(
+                e.get("ph") == "X" and e["pid"] == f["pid"]
+                and e["ts"] <= f["ts"] <= e["ts"] + e["dur"]
+                for e in events
+            )
+    assert doc["metadata"]["unaligned_streams"] == 0
+    assert "4 flow-linked request(s)" in p.stdout
+
+
+def test_router_full_telemetry_zero_extra_traces(tmp_path):
+    """Satellite (acceptance pin, fabric half): a multi-replica router
+    serve() with trace propagation, goodput accounting and the SLO
+    monitor ALL enabled adds zero jit compilations over the bare run —
+    the whole PR-7 surface stays host-side."""
+    from mamba_distributed_tpu.obs import SLOMonitor
+    from mamba_distributed_tpu.serving import RequestRouter
+    from mamba_distributed_tpu.serving.engine import (
+        TRACE_COUNTS as ENGINE_TRACES,
+    )
+    from mamba_distributed_tpu.serving.prefill import (
+        TRACE_COUNTS as CHUNK_TRACES,
+    )
+
+    cfg, params = _tiny_chunked_serving()
+
+    def reqs():
+        # short mix plus one chunked long prompt, so the chunk step is
+        # on the traced surface too
+        out = [GenerationRequest(prompt_ids=np.ones(4, np.int32),
+                                 max_new_tokens=3,
+                                 key=jax.random.PRNGKey(i))
+               for i in range(3)]
+        out.append(GenerationRequest(
+            prompt_ids=np.arange(20, dtype=np.int32) % 5,
+            max_new_tokens=3, key=jax.random.PRNGKey(9)))
+        return out
+
+    kw = dict(num_replicas=2, capacity=2, tokens_per_tick=2)
+    RequestRouter(params, cfg, **kw).run(reqs())
+    base = dict(ENGINE_TRACES), dict(CHUNK_TRACES)
+
+    tracer = SpanTracer(str(tmp_path / "events.jsonl"))
+    slo = SLOMonitor(ttft_p95_ms=0.001, queue_wait_p95_ms=1000.0,
+                     itl_p95_ms=1000.0, window=4, tracer=tracer)
+    router = RequestRouter(
+        params, cfg, jsonl_path=str(tmp_path / "serve.jsonl"),
+        tracer=tracer, slo=slo, **kw,
+    )
+    consumed = sum(1 for _ in router.serve(reqs()))
+    assert consumed == 12
+    assert (dict(ENGINE_TRACES), dict(CHUNK_TRACES)) == base
+    # the full surface actually ran: goodput on every tick, traces
+    # propagated, SLO breach recorded
+    recs = load_events([str(tmp_path / "serve.jsonl")])
+    ticks = [r for r in recs if r["kind"] == "serving_tick"]
+    assert ticks and all("serving_mfu" in t and "traces" in t
+                         for t in ticks)
+    req_recs = [r for r in recs if r["kind"] == "request"]
+    assert len({r["trace_id"] for r in req_recs}) == 4
+    assert mon_breached(slo)
+
+
+def mon_breached(slo) -> bool:
+    return any(m["breaches"] for m in slo.summary()["metrics"].values())
+
+
+# ------------------------------------- obs_report: SLO/goodput/replicas
+
+
+@pytest.mark.fast
+def test_obs_report_merges_replica_itl_histograms():
+    """Satellite: per-replica request records merge into per-replica
+    AND fabric-wide ITL views — exercised on histograms with disjoint
+    and overlapping bucket sets."""
+
+    def req(rid, replica, values):
+        h = StreamingHistogram()
+        for v in values:
+            h.record(v)
+        return {"kind": "request", "request_id": rid, "replica": replica,
+                "new_tokens": len(values) + 1, "finish_reason": "length",
+                "queue_wait_ms": 1.0, "ttft_ms": 2.0, "e2e_ms": 3.0,
+                "itl_hist": h.to_dict()}
+
+    def tick(replica):
+        return {"kind": "serving_tick", "tick": 1, "occupied": 1,
+                "capacity": 2, "replica": replica, "queue_depth": 0,
+                "tokens_emitted": 2, "tick_ms": 10.0}
+
+    # replica 0: ~10ms, replica 1: ~10s — DISJOINT buckets; the two
+    # replica-0 requests overlap each other's buckets exactly
+    events = [tick(0), tick(1),
+              req(0, 0, [10.0] * 8), req(1, 0, [12.0] * 8),
+              req(2, 1, [10_000.0] * 8)]
+    rep = build_report(events)
+    r0 = rep["replicas"][0]["itl_ms"]
+    r1 = rep["replicas"][1]["itl_ms"]
+    fab = rep["fabric"]["itl_ms"]
+    assert r0["count"] == 16 and r1["count"] == 8
+    assert fab["count"] == 24
+    g = StreamingHistogram().growth
+    assert 10.0 / g <= r0["p50"] <= 12.0 * g
+    assert 10_000.0 / g <= r1["p50"] <= 10_000.0 * g
+    # fabric merge == one histogram fed the combined stream
+    both = StreamingHistogram()
+    for v in [10.0] * 8 + [12.0] * 8 + [10_000.0] * 8:
+        both.record(v)
+    for q in ("p50", "p95", "p99"):
+        assert fab[q] == both.summary()[q]
+    # the merged view is visibly worse than replica 0's own p95 —
+    # exactly what the per-replica split exists to show
+    assert fab["p99"] > r0["p99"]
+    text = format_report(rep)
+    assert "itl_p50/p95" in text and "all" in text
+
+
+@pytest.mark.fast
+def test_obs_report_slo_and_goodput_sections():
+    events = [
+        {"kind": "event", "name": "slo_config", "t_ms": 0.0, "window": 8,
+         "ttft_ms_p95_target": 100.0, "queue_wait_ms_p95_target": 50.0},
+        {"kind": "event", "name": "slo_breach", "t_ms": 5.0,
+         "metric": "ttft_ms", "target": 100.0, "p95": 300.0, "window": 8},
+    ]
+    for i in range(10):
+        events.append({"kind": "request", "request_id": i,
+                       "prompt_tokens": 4, "new_tokens": 4,
+                       "finish_reason": "length",
+                       "queue_wait_ms": 10.0,
+                       "ttft_ms": 50.0 if i < 7 else 500.0,
+                       "e2e_ms": 600.0})
+        events.append({"kind": "serving_tick", "tick": i + 1,
+                       "occupied": 2, "capacity": 4, "queue_depth": 0,
+                       "tokens_emitted": 4, "tick_ms": 100.0,
+                       "prefill_stall_ms": 0.0, "useful_tokens": 4,
+                       "wasted_token_lanes": 12,
+                       "goodput_tokens_per_sec": 40.0,
+                       "serving_mfu": 0.25})
+    rep = build_report(events)
+    slo = rep["slo"]
+    assert slo["window"] == 8
+    assert slo["metrics"]["ttft_ms"]["attainment"] == 0.7
+    assert slo["metrics"]["ttft_ms"]["breaches"] == 1
+    assert slo["metrics"]["queue_wait_ms"]["attainment"] == 1.0
+    assert "itl_ms" not in slo["metrics"]  # untargeted
+    g = rep["serving"]["goodput"]
+    assert g["useful_tokens"] == 40 and g["wasted_token_lanes"] == 120
+    assert g["useful_fraction"] == 0.25
+    assert g["goodput_tokens_per_sec"] == 40.0
+    assert g["serving_mfu"] == 0.25
+    text = format_report(rep)
+    assert "SLO attainment" in text and "70.0%" in text
+    assert "goodput" in text and "serving MFU: 25.00%" in text
 
 
 @pytest.mark.fast
