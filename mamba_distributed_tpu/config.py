@@ -191,6 +191,17 @@ class ModelConfig:
     # mesh.  1 => single-device pool (the pre-fabric behavior).
     # capacity must divide evenly across the shards.
     serving_data_shards: int = 1
+    # Tensor-parallel shards of the serving WEIGHTS over `mesh.model`
+    # (the 2-D serving mesh's second axis): Mamba d_inner channels,
+    # attention heads and the embedding/head vocab axis split across
+    # devices (parallel/sharding.serving_param_specs), so one engine
+    # can serve a model bigger than a single device and each device
+    # reads 1/N of the weights per decode tick (decode's binding
+    # resource).  1 => weights replicated (the exact pre-TP layout:
+    # same shardings, same trace counts).  d_inner, padded vocab and
+    # (hybrid) head counts must divide evenly — checked with a clear
+    # error at engine construction.
+    serving_model_shards: int = 1
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
@@ -253,6 +264,11 @@ class ModelConfig:
             raise ValueError(
                 f"serving_data_shards must be >= 1, got "
                 f"{self.serving_data_shards}"
+            )
+        if self.serving_model_shards < 1:
+            raise ValueError(
+                f"serving_model_shards must be >= 1, got "
+                f"{self.serving_model_shards}"
             )
         if self.kv_page_tokens < 8 or self.kv_page_tokens % 8:
             raise ValueError(

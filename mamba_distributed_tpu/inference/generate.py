@@ -150,9 +150,25 @@ def _decode_scan(
     return jnp.moveaxis(new_tokens, 0, 1)
 
 
+def _constrain_tp(params: dict, mesh):
+    """Pin the decode-cast params to their serving tensor-parallel
+    layout (``mesh`` a 2-D serving_mesh with model > 1; None = no-op).
+    Delegates to the ONE shared constraint the serving engine's tick/
+    prefill/chunk step also apply, so a solo ``generate(mesh=)``
+    partitions its math identically — the engine==generate()
+    bit-parity contract at ``model > 1``."""
+    if mesh is None:
+        return params
+    from mamba_distributed_tpu.parallel.sharding import (
+        constrain_serving_params,
+    )
+
+    return constrain_serving_params(params, mesh)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "top_k", "temperature"),
+    static_argnames=("cfg", "max_new_tokens", "top_k", "temperature", "mesh"),
 )
 def _generate_impl(
     params: dict,
@@ -164,6 +180,7 @@ def _generate_impl(
     top_k: int,
     temperature: float,
     eos_id: jax.Array,
+    mesh=None,
 ) -> jax.Array:
     """(b, T_bucket) padded prompt -> (b, T_bucket + max_new_tokens).
 
@@ -172,7 +189,7 @@ def _generate_impl(
     recompiles."""
     TRACE_COUNTS["generate"] += 1  # python side effect: runs once per trace
     b, t = prompt_ids.shape
-    params = _decode_params(params, cfg)
+    params = _constrain_tp(_decode_params(params, cfg), mesh)
     # parallel prefill: one full-sequence forward builds the decode state
     # (the reference re-ran the whole prefix per token instead)
     last_logits, state = lm_prefill(
@@ -188,7 +205,7 @@ def _generate_impl(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "top_k", "temperature"),
+    static_argnames=("cfg", "max_new_tokens", "top_k", "temperature", "mesh"),
 )
 def _decode_impl(
     params: dict,
@@ -200,6 +217,7 @@ def _decode_impl(
     top_k: int,
     temperature: float,
     eos_id: jax.Array,
+    mesh=None,
 ) -> jax.Array:
     """Decode from an externally built prefill state (the chunked-prefill
     path, serving/prefill.chunked_prefill) -> (b, max_new_tokens).
@@ -207,7 +225,7 @@ def _decode_impl(
     One trace per (cfg, budget, sampling statics) regardless of prompt
     length — the prompt's shape never enters this function."""
     TRACE_COUNTS["decode"] += 1  # python side effect: runs once per trace
-    params = _decode_params(params, cfg)
+    params = _constrain_tp(_decode_params(params, cfg), mesh)
     return _decode_scan(
         params, cfg, state, last_logits, key, max_new_tokens, top_k,
         temperature, eos_id,
@@ -224,8 +242,16 @@ def generate(
     temperature: float = 1.0,
     eos_id: int | None = None,
     length_bucketing: bool = True,
+    mesh=None,
 ) -> jax.Array:
     """prompt_ids (b, t) int32 -> (b, t + max_new_tokens) sampled tokens.
+
+    ``mesh`` (a 2-D ``parallel/mesh.serving_mesh``) runs the prefill +
+    decode with the weights tensor-parallel over the mesh's ``model``
+    axis — the SAME per-parameter constraint the serving engine
+    applies, so a solo call with an engine's mesh stays bit-identical
+    to the engine's streams at ``serving_model_shards > 1``.  None
+    (default) is the unsharded path, unchanged.
 
     ``eos_id=None``: EOT stopping is a host-side concern (the full budget
     is generated; truncate at the tokenizer's EOT afterwards, as the
@@ -247,6 +273,11 @@ def generate(
     b, t = prompt_ids.shape
     hybrid = bool(cfg.attn_layer_idx)
     chunk = cfg.effective_prefill_chunk_tokens
+    if mesh is not None and dict(mesh.shape).get("model", 1) <= 1:
+        # a data-only serving mesh shards slots, not weights — nothing
+        # for generate() to constrain; dropping it keeps the TP-off jit
+        # signatures (and pinned trace counts) identical to pre-TP
+        mesh = None
     if length_bucketing and (
         (chunk > 0) if hybrid else use_chunked_prefill(t, chunk)
     ):
@@ -260,11 +291,12 @@ def generate(
 
         last_logits, state = chunked_prefill(
             params, cfg, prompt_ids,
-            max_len=(t + max_new_tokens) if hybrid else 0,
+            max_len=(t + max_new_tokens) if hybrid else 0, mesh=mesh,
         )
         new_tokens = _decode_impl(
             params, cfg, state, last_logits, key, max_new_tokens, top_k,
             temperature, jnp.int32(-1 if eos_id is None else eos_id),
+            mesh=mesh,
         )
         return jnp.concatenate([prompt_ids, new_tokens], axis=1)
     if length_bucketing and not cfg.attn_layer_idx:
@@ -273,7 +305,7 @@ def generate(
         padded, mask = prompt_ids, None
     out = _generate_impl(
         params, cfg, padded, mask, key, max_new_tokens, top_k, temperature,
-        jnp.int32(-1 if eos_id is None else eos_id),
+        jnp.int32(-1 if eos_id is None else eos_id), mesh=mesh,
     )
     if padded.shape[1] == t:
         return out

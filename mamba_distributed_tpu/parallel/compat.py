@@ -1,0 +1,35 @@
+"""JAX API compatibility shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (kwarg
+``check_rep``) to top-level ``jax.shard_map`` (kwarg ``check_vma``)
+around jax 0.6/0.7; the 0.4.x line this repo pins only ships the
+experimental spelling.  Every in-tree call site imports ``shard_map``
+from HERE with the modern signature (``check_vma=``) and the shim
+translates for older jax — one place to delete when the floor moves
+past the rename, instead of seven call sites in ``seq_parallel.py`` /
+``ulysses.py`` / ``ring_attention.py`` / ``pipeline.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    # modern jax: the top-level API already speaks check_vma
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    @functools.wraps(_shard_map_experimental)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        """``jax.shard_map``'s signature on top of the experimental API
+        (``check_vma`` was named ``check_rep`` there; same meaning)."""
+        return _shard_map_experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kw,
+        )
+
+
+__all__ = ["shard_map"]

@@ -42,24 +42,37 @@ def single_device_mesh() -> Mesh:
     return build_mesh(MeshConfig(), devices=jax.devices()[:1])
 
 
-def serving_mesh(num_shards: int, devices=None) -> Mesh:
-    """One-axis ``("data",)`` mesh for the serving fabric: the slot
-    pool's batch axis (and the paged-KV page axis) partition over it,
-    weights replicate (parallel/sharding.slot_pool_shardings).
+def serving_mesh(num_shards: int, devices=None, model_shards: int = 1) -> Mesh:
+    """2-D ``("data", "model")`` mesh for the serving fabric.
 
-    Serving never shards params — decode is weight-bandwidth-bound and
-    the model fits one replica by assumption — so the full 6-axis
-    training mesh collapses to the one axis the slot pool needs.  On a
-    CPU host, force a multi-device platform first
+    The slot pool's batch axis (and the paged-KV page axis) partition
+    over ``data`` (parallel/sharding.slot_pool_shardings); the WEIGHTS
+    partition over ``model`` (parallel/sharding.serving_param_shardings
+    — Mamba d_inner channels, attention heads, the vocab axis of the
+    embedding/head).  Decode is weight-bandwidth-bound, so the model
+    axis splits the binding resource — per-device weight traffic —
+    and is also what lets one engine serve a model bigger than a
+    single device.  ``model_shards=1`` (the default) keeps the exact
+    pre-TP behavior: every param spec is ``P()`` and the data axis is
+    all that partitions anything, so shardings and trace counts match
+    the one-axis mesh byte for byte.  On a CPU host, force a
+    multi-device platform first
     (``XLA_FLAGS=--xla_force_host_platform_device_count=N``, as the
     test harness does) to exercise the same GSPMD path as a pod slice.
     """
     if num_shards < 1:
         raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if model_shards < 1:
+        raise ValueError(f"model_shards must be >= 1, got {model_shards}")
     if devices is None:
         devices = jax.devices()
-    if num_shards > len(devices):
+    want = num_shards * model_shards
+    if want > len(devices):
         raise ValueError(
-            f"serving mesh wants {num_shards} devices, have {len(devices)}"
+            f"serving mesh wants {num_shards} x {model_shards} = {want} "
+            f"devices, have {len(devices)}"
         )
-    return Mesh(np.asarray(devices[:num_shards]), ("data",))
+    # model innermost: a slot's weight-shard all-reduces ride the
+    # fastest (most adjacent) links, like `tensor` in the training mesh
+    dev_array = np.asarray(devices[:want]).reshape(num_shards, model_shards)
+    return Mesh(dev_array, ("data", "model"))

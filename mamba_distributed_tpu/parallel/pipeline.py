@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mamba_distributed_tpu.parallel.compat import shard_map
+
 
 def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
@@ -136,7 +138,7 @@ def pipelined_layers(
         xs_specs = jax.tree.map(
             lambda x: P(None, batch_axes, *(None,) * (jnp.ndim(x) - 2)), xs
         )
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, xs_specs),

@@ -175,6 +175,129 @@ def opt_state_shardings(opt_shapes, params, param_sharding_tree, mesh: Mesh):
     return jtu.tree_map_with_path(leaf_shard, opt_shapes)
 
 
+# ------------------------------------------- serving tensor parallelism
+
+
+def serving_param_specs(params, model_shards: int):
+    """Per-parameter PartitionSpec pytree for SERVING weights over the
+    2-D serving mesh's ``model`` axis (parallel/mesh.serving_mesh).
+
+    The rules are the training ``_TP_RULES`` (every mixer weight's
+    d_inner/head axis: Mamba in/out projections column/row-parallel,
+    conv + SSM channel blocks over d_inner, attention wqkv/out_proj
+    over heads, MLP/MoE inner axes) plus the two params training TP
+    leaves replicated because the optimizer owns them there: the
+    embedding and (untied) lm_head shard their VOCAB axis — the
+    column-parallel head, the single biggest weight read of a decode
+    tick.  Norm scales and anything whose rule axis doesn't divide
+    evenly replicate.  ``model_shards == 1`` returns all-``P()``:
+    byte-identical to the replicated pre-TP layout, so the knob's off
+    position is the exact status quo.
+
+    Slot/page state is NOT covered here — it partitions over ``data``
+    only (``slot_pool_specs``); the two spec families compose because
+    they name disjoint mesh axes.
+    """
+    def leaf_spec(path, leaf):
+        names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
+        shape = np.shape(leaf)
+        spec: list = [None] * len(shape)
+        if model_shards > 1 and shape:
+            stacked = "blocks" in names or "attn_blocks" in names
+            ax = _tp_axis(names, len(shape), stacked)
+            if ax is None:
+                if names[-1] == "embedding":
+                    ax = 0  # (V, d): vocab axis
+                elif names[-2:] == ["lm_head", "kernel"]:
+                    ax = len(shape) - 1  # (d, V): vocab axis
+            if ax is not None and shape[ax] % model_shards == 0:
+                spec[ax] = "model"
+        if all(s is None for s in spec):
+            return P()  # the literal pre-TP replicated spec
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def serving_param_shardings(params, mesh: Mesh):
+    """NamedSharding pytree for serving weights on a ``serving_mesh``
+    (device_put at engine init / ``generate(mesh=)``; the compiled tick
+    and chunk step re-assert it via sharding constraints so the layout
+    can never decay mid-flight)."""
+    specs = serving_param_specs(params, dict(mesh.shape).get("model", 1))
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def constrain_serving_params(params, mesh):
+    """``with_sharding_constraint`` the (decode-cast) params to their
+    serving tensor-parallel layout — THE one constraint every compiled
+    consumer applies (engine tick / one-shot prefill / chunk step /
+    ``generate(mesh=)``), kept in a single place so the four call sites
+    can never drift apart and break the engine==generate() bit-parity
+    contract.  ``mesh=None`` is a no-op (the unsharded paths)."""
+    if mesh is None:
+        return params
+    return jax.lax.with_sharding_constraint(
+        params, serving_param_shardings(params, mesh)
+    )
+
+
+def validate_serving_model_shards(cfg, model_shards: int) -> None:
+    """Reject a ``serving_model_shards`` the model's dimensions cannot
+    tile — at ENGINE CONSTRUCTION, with the offending dimension named,
+    instead of an opaque GSPMD error (or a silently replicated weight)
+    mid-flight.  The checks mirror the axes ``serving_param_specs``
+    actually shards — including the mamba2 PACKED projection widths
+    (z|xBC|dt on one axis), which can be indivisible even when
+    ``d_inner`` divides.  ``cfg`` is a ModelConfig."""
+    if model_shards <= 1:
+        return
+    problems = []
+    if cfg.d_inner % model_shards:
+        problems.append(
+            f"d_inner={cfg.d_inner} (expand * d_model — the Mamba "
+            f"in/out projection and conv/SSM channel axis)"
+        )
+    if cfg.ssm_layer == "mamba2":
+        g, ds, nh = cfg.ngroups, cfg.effective_d_state, cfg.nheads
+        d_in_proj = 2 * cfg.d_inner + 2 * g * ds + nh
+        conv_dim = cfg.d_inner + 2 * g * ds
+        if nh % model_shards:
+            problems.append(
+                f"nheads={nh} (d_inner/headdim — the per-head "
+                f"A_log/dt_bias/D axis and the dt segment of in_proj)"
+            )
+        if d_in_proj % model_shards:
+            problems.append(
+                f"in_proj width {d_in_proj} (the packed "
+                f"2*d_inner + 2*ngroups*d_state + nheads column axis)"
+            )
+        if conv_dim % model_shards:
+            problems.append(
+                f"conv width {conv_dim} (d_inner + 2*ngroups*d_state)"
+            )
+    if cfg.vocab_size_padded % model_shards:
+        problems.append(
+            f"padded vocab={cfg.vocab_size_padded} (the embedding/"
+            f"lm_head vocab axis)"
+        )
+    if cfg.attn_layer_idx:
+        nh = cfg.effective_attn_num_heads
+        nkv = cfg.effective_attn_num_kv_heads
+        if nh % model_shards:
+            problems.append(f"attn_num_heads={nh}")
+        if nkv % model_shards:
+            problems.append(f"attn_num_kv_heads={nkv}")
+    if problems:
+        raise ValueError(
+            f"serving_model_shards={model_shards} does not divide "
+            + "; ".join(problems)
+            + " — pick a divisor of every listed dimension (or 1 to "
+              "replicate weights)"
+        )
+
+
 # --------------------------------------------------- serving slot pool
 
 

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from mamba_distributed_tpu.parallel.compat import shard_map
+
 
 def ulysses_attention(seq_ctx, q, k, v, impl: str = "xla"):
     """q (b, t, nh, hd), k/v (b, t, nkv, hd), t sharded over seq_ctx.axis.
@@ -74,7 +76,7 @@ def ulysses_attention(seq_ctx, q, k, v, impl: str = "xla"):
             out, ctx.axis, split_axis=1, concat_axis=2, tiled=True
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=ctx.mesh, in_specs=(bat4, bat4, bat4), out_specs=bat4,
         check_vma=False,
     )

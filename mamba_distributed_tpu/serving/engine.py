@@ -89,10 +89,22 @@ from mamba_distributed_tpu.utils.metrics import ServingMetrics
 TRACE_COUNTS = {"prefill": 0, "tick": 0}
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig):
-    """Bucketed batch-1 prompt prefill -> (last_logits (1, V), state)."""
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig,
+             mesh=None):
+    """Bucketed batch-1 prompt prefill -> (last_logits (1, V), state).
+
+    ``mesh`` (static; only passed when the serving mesh has a model
+    axis > 1) re-asserts the tensor-parallel weight layout so this
+    prefill partitions exactly like ``generate(mesh=)``'s — an input to
+    the engine==generate() parity argument at ``model > 1``."""
     TRACE_COUNTS["prefill"] += 1
+    if mesh is not None:
+        from mamba_distributed_tpu.parallel.sharding import (
+            constrain_serving_params,
+        )
+
+        params = constrain_serving_params(params, mesh)
     return lm_prefill(params, cfg, ids, token_mask=mask)
 
 
@@ -136,12 +148,20 @@ def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
         # the slot/page state — and the host-owned per-slot tick inputs
         # — to their data-axis layout so the batched lm_step partitions
         # its batch axis instead of decaying to one device, whatever
-        # the between-ticks insert/evict propagation concluded
+        # the between-ticks insert/evict propagation concluded.  With a
+        # model axis > 1 the WEIGHTS get the same treatment on their
+        # tensor-parallel axis (serving_param_shardings): GSPMD then
+        # runs every slot's lm_step as d_inner/head-sharded matmuls
+        # with compiler-inserted all-reduces — 2-D parallelism, slots
+        # over data x weights over model.
         from mamba_distributed_tpu.parallel.sharding import (
+            constrain_serving_params,
             slot_axis_sharding,
             slot_pool_shardings,
         )
 
+        if dict(mesh.shape).get("model", 1) > 1:
+            params = constrain_serving_params(params, mesh)
         pool = jax.lax.with_sharding_constraint(
             pool, slot_pool_shardings(pool, mesh)
         )
@@ -253,15 +273,22 @@ class ServingEngine:
         record (rolling-window p95 targets -> breach events); None
         (default) off.  The router shares ONE monitor across replicas
         so the window is fabric-wide.
-      mesh: a ``parallel/mesh.serving_mesh`` — the shard_slots path.
+      mesh: a ``parallel/mesh.serving_mesh`` — the 2-D sharded path.
         Slot/page state and the tick's batch axis partition over the
-        mesh's data axis via NamedSharding (params replicated), so one
-        engine's pool spans every device in the mesh; ``capacity`` must
-        divide over the shards.  None (default) builds one from
-        ``cfg.serving_data_shards`` when that knob is > 1, else the
-        pool stays single-device.  Host bookkeeping follows the device
-        layout: a slot resident in data-shard d draws KV pages only
-        from shard d's contiguous page range (state_cache.PagePool).
+        mesh's DATA axis; the weights partition over its MODEL axis
+        (tensor parallel: Mamba d_inner channels, attention heads,
+        embedding/head vocab — parallel/sharding.serving_param_specs;
+        ``model=1`` replicates them, the exact pre-TP layout).  One
+        engine's pool and weights span every device in the mesh;
+        ``capacity`` must divide over the data shards and d_inner/
+        heads/vocab over the model shards (checked here, loudly).
+        None (default) builds a mesh from ``cfg.serving_data_shards``
+        x ``cfg.serving_model_shards`` when either knob is > 1, else
+        everything stays single-device.  Host bookkeeping follows the
+        device layout: a slot resident in data-shard d draws KV pages
+        only from shard d's contiguous page range
+        (state_cache.PagePool); the model axis never touches page
+        accounting — pages tile over data only.
 
     Prefill buckets are the module defaults of inference/bucketing.py —
     deliberately not a knob, so the engine and a solo ``generate()``
@@ -294,18 +321,31 @@ class ServingEngine:
         if prefill_tokens_per_tick < 0:
             raise ValueError("prefill_tokens_per_tick must be >= 0 "
                              "(0 => unbounded)")
-        if mesh is None and cfg.serving_data_shards > 1:
+        if mesh is None and (cfg.serving_data_shards > 1
+                             or cfg.serving_model_shards > 1):
             from mamba_distributed_tpu.parallel.mesh import serving_mesh
 
-            mesh = serving_mesh(cfg.serving_data_shards)
+            mesh = serving_mesh(cfg.serving_data_shards,
+                                model_shards=cfg.serving_model_shards)
         self.mesh = mesh
         self.num_shards = 1 if mesh is None else int(mesh.shape["data"])
+        self.model_shards = (
+            1 if mesh is None else int(dict(mesh.shape).get("model", 1))
+        )
         if capacity % self.num_shards:
             raise ValueError(
                 f"capacity={capacity} must divide over "
                 f"serving_data_shards={self.num_shards} (each data shard "
                 f"holds capacity/shards slot rows)"
             )
+        if self.model_shards > 1:
+            # clear rejection at CONSTRUCTION (d_inner/heads/vocab must
+            # tile over the model axis), not a GSPMD error mid-flight
+            from mamba_distributed_tpu.parallel.sharding import (
+                validate_serving_model_shards,
+            )
+
+            validate_serving_model_shards(cfg, self.model_shards)
         self.cfg = cfg
         self.capacity = capacity
         self.max_top_k = max_top_k
@@ -318,18 +358,25 @@ class ServingEngine:
         self._params = cast_decode_params(params, cfg=cfg)
         if mesh is not None:
             from mamba_distributed_tpu.parallel.sharding import (
+                serving_param_shardings,
                 slot_pool_shardings,
             )
 
-            # weights replicated, slot/page state partitioned over the
-            # data axis — the layout every subsequent insert/evict/tick
-            # inherits (and the tick re-asserts via its constraints)
+            # weights tensor-parallel over the model axis (replicated
+            # when model=1 — serving_param_specs degenerates to P()),
+            # slot/page state partitioned over the data axis — the
+            # layout every subsequent insert/evict/tick inherits (and
+            # the tick re-asserts via its constraints)
             self._params = jax.device_put(
-                self._params, NamedSharding(mesh, P())
+                self._params, serving_param_shardings(self._params, mesh)
             )
             self.pool = jax.device_put(
                 self.pool, slot_pool_shardings(self.pool, mesh)
             )
+        # the mesh the chunk step / one-shot prefill need for weight
+        # constraints — None below model=2 so the TP-off jit signatures
+        # (and trace counts) are byte-identical to the pre-TP engine
+        self._tp_mesh = mesh if self.model_shards > 1 else None
         self.scheduler = FCFSScheduler()
         self.metrics = metrics or ServingMetrics(capacity)
         self.tracer = tracer
@@ -356,7 +403,8 @@ class ServingEngine:
                 cfg, 1, training=False, convention="model"),
             flops_per_prefill_token=flops_per_token(
                 cfg, prefill_seq, training=False, convention="model"),
-            peak_flops=peak_flops_per_chip() * self.num_shards,
+            peak_flops=peak_flops_per_chip() * self.num_shards
+            * self.model_shards,
         )
         self._free: list[int] = list(range(capacity))
         self._slots: dict[int, _Tracked] = {}
@@ -531,7 +579,8 @@ class ServingEngine:
                     # each — the next tick's token fetch is the one
                     # synchronization point
                     logits, state = _prefill(
-                        self._params, padded, mask, cfg=self.cfg
+                        self._params, padded, mask, cfg=self.cfg,
+                        mesh=self._tp_mesh,
                     )
                     self.pool = state_cache.insert(
                         self.pool, slot, state, logits, r.resolve_key(),
@@ -618,7 +667,8 @@ class ServingEngine:
                                   chunk=i, of=plan.n_chunks,
                                   trace=tracked.trace_id):
                 logits, state = prefill_chunk(
-                    self._params, ids, mask, state, cfg=self.cfg
+                    self._params, ids, mask, state, cfg=self.cfg,
+                    mesh=self._tp_mesh,
                 )
                 if self.hybrid:
                     # pages were written in place (donated): swap the
@@ -922,6 +972,8 @@ class ServingEngine:
             prefill_oneshot_lanes=self._pending_oneshot_lanes,
             slot_lanes=self.capacity * self.tokens_per_tick,
             traces=live_traces,
+            model_shards=(self.model_shards if self.model_shards > 1
+                          else None),
             **kv_gauges,
         )
         self._pending_stall_ms = 0.0

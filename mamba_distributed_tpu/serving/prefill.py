@@ -137,9 +137,11 @@ def chunk_inputs(
     return jnp.asarray(out), jnp.asarray(mask)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"),
+                   donate_argnums=(3,))
 def prefill_chunk(
-    params: dict, ids: jax.Array, mask: jax.Array, state, cfg: ModelConfig
+    params: dict, ids: jax.Array, mask: jax.Array, state, cfg: ModelConfig,
+    mesh=None,
 ):
     """The compiled chunk step: (ids, mask, carry) -> (last logits, carry').
 
@@ -149,14 +151,29 @@ def prefill_chunk(
     stacks it carries the (large) paged KV pool through every chunk, and
     the donation lets XLA write pages in place instead of copying the
     pool per chunk.
+
+    ``mesh`` (static; a 2-D ``serving_mesh`` with ``model > 1``, else
+    None) re-asserts the tensor-parallel weight layout inside the jit —
+    the same constraint the engine's tick applies — so the engine's
+    chunk dispatches and ``generate(mesh=)``'s run ONE partitioning and
+    the chunk-step parity argument survives weight sharding.  None (the
+    default, and everything below ``serving_model_shards=2``) keeps the
+    signature — and the trace counts tests pin — byte-identical to the
+    pre-TP step.
     """
     TRACE_COUNTS["chunk"] += 1
+    if mesh is not None:
+        from mamba_distributed_tpu.parallel.sharding import (
+            constrain_serving_params,
+        )
+
+        params = constrain_serving_params(params, mesh)
     return lm_prefill_chunk(params, cfg, ids, state, token_mask=mask)
 
 
 def chunked_prefill(
     params: dict, cfg: ModelConfig, prompt_ids,
-    plan: ChunkPlan | None = None, max_len: int = 0,
+    plan: ChunkPlan | None = None, max_len: int = 0, mesh=None,
 ):
     """Drive a whole prompt through the chunk step (the solo-`generate()`
     driver; the serving engine paces the same loop itself, against its
@@ -168,8 +185,11 @@ def chunked_prefill(
     the downstream decode trace count stays O(log pages) across prompt/
     budget mixes (page-width differences never perturb the token stream
     — masked attention is bit-stable across page-bucket widths, see
-    models/attention.py).  Returns (last_logits (b, V) fp32, state), the
-    ``lm_prefill`` contract, ready for the decode loop.
+    models/attention.py).  ``mesh`` (a 2-D serving_mesh with model > 1,
+    else None) threads the tensor-parallel weight constraint into every
+    chunk call — pass the serving engine's mesh to reproduce its chunk
+    computation bit-for-bit.  Returns (last_logits (b, V) fp32, state),
+    the ``lm_prefill`` contract, ready for the decode loop.
     """
     prompt = np.asarray(prompt_ids, np.int32)
     if prompt.ndim == 1:
@@ -209,5 +229,6 @@ def chunked_prefill(
     logits = None
     for i in range(plan.n_chunks):
         ids, mask = chunk_inputs(prompt, plan, i)
-        logits, state = prefill_chunk(dparams, ids, mask, state, cfg=cfg)
+        logits, state = prefill_chunk(dparams, ids, mask, state, cfg=cfg,
+                                      mesh=mesh)
     return logits, state

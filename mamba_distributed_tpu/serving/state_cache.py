@@ -117,7 +117,11 @@ class PagePool:
     boundaries as the page arrays' NamedSharding over the data axis
     (``page_shard_ranges``): a slot resident in data-shard d allocates
     only from shard d's pages, so every slot's KV reads and writes stay
-    on the devices that hold its rows of the pool."""
+    on the devices that hold its rows of the pool.  The 2-D serving
+    mesh's MODEL axis is invisible here — weights shard over it, pages
+    never do (parallel/sharding.serving_param_specs vs
+    slot_pool_specs), so this accounting is identical at any
+    ``serving_model_shards``."""
 
     def __init__(self, num_pages: int, num_shards: int = 1):
         if num_pages < 1:

@@ -268,6 +268,7 @@ class ServingMetrics:
         prefill_oneshot_tokens: int = 0, prefill_oneshot_lanes: int = 0,
         slot_lanes: int = 0,
         traces: list | None = None,
+        model_shards: int | None = None,
         kv_pages_used: int | None = None,
         kv_pages_capacity: int | None = None,
         kv_page_allocs: int = 0, kv_page_frees: int = 0,
@@ -295,6 +296,10 @@ class ServingMetrics:
         ``traces`` is the live request trace-id set, stamped into the
         record so host-side attribution can apportion ``tick_ms`` and
         FLOPs across resident requests (obs/context.py).
+        ``model_shards`` (tensor-parallel serving engines, i.e. > 1)
+        stamps the mesh's model-axis width on the record so per-tick
+        rates are attributable to their weight layout; None (the
+        replicated default) leaves the record unchanged.
         ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
         engines) gauge the page pool at this tick, with
         ``kv_page_allocs``/``kv_page_frees`` the allocator churn in the
@@ -341,6 +346,8 @@ class ServingMetrics:
         }
         if traces is not None:
             record["traces"] = list(traces)
+        if model_shards is not None:
+            record["model_shards"] = model_shards
         if kv_pages_used is not None:
             self.kv_pages_used = kv_pages_used
             self.kv_pages_capacity = kv_pages_capacity

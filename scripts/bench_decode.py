@@ -9,7 +9,9 @@ O(1); this script measures that as sampled tokens/sec/chip.
 Prints one JSON line; ``--json PATH`` also writes it to PATH (the
 machine-readable bench artifact BENCH_SERVING.json collects).  Env
 knobs: DECODE_B (default 8), DECODE_PROMPT (default 128), DECODE_NEW
-(default 256), BENCH_PRESET, BENCH_PLATFORM.
+(default 256), BENCH_PRESET, BENCH_PLATFORM.  ``--model-shards N``
+decodes with the weights tensor-parallel over a 2-D serving mesh's
+model axis (``generate(mesh=)``; docs/SERVING.md "2-D serving mesh").
 
 ``--hybrid-paged`` benches the RAGGED PAGED attention decode instead
 (BENCH_PRESET defaults to hybrid-tiny there): a serving-style slot pool
@@ -213,6 +215,12 @@ def main() -> None:
                          "fractions (e.g. 0.25,0.5,1.0 => live slots = "
                          "fraction * DECODE_SLOTS) and record a "
                          "paged-vs-dense row per fill level")
+    ap.add_argument("--model-shards", type=int, default=0, metavar="N",
+                    help="decode with the weights tensor-parallel N-way "
+                         "over a 2-D serving mesh's model axis "
+                         "(generate(mesh=); on CPU combine with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=K)")
     args = ap.parse_args()
 
     import jax
@@ -244,10 +252,27 @@ def main() -> None:
     jax.block_until_ready(params)
     _progress("params initialized")
 
+    mesh = None
+    if args.model_shards > 1:
+        from mamba_distributed_tpu.parallel.mesh import serving_mesh
+        from mamba_distributed_tpu.parallel.sharding import (
+            serving_param_shardings,
+            validate_serving_model_shards,
+        )
+
+        validate_serving_model_shards(cfg, args.model_shards)
+        mesh = serving_mesh(1, model_shards=args.model_shards)
+        # commit the tp layout up front so the timed loop never pays a
+        # host->sharded transfer (the engine device_puts the same way)
+        params = jax.device_put(params, serving_param_shardings(params, mesh))
+        jax.block_until_ready(params)
+        _progress(f"weights tensor-parallel over {args.model_shards} shards")
+
     kp, kg = jax.random.split(jax.random.PRNGKey(1))
     prompt = jax.random.randint(kp, (B, prompt_len), 0, cfg.vocab_size, jnp.int32)
 
-    out = generate(params, cfg, prompt, kg, max_new_tokens=new_tokens)
+    out = generate(params, cfg, prompt, kg, max_new_tokens=new_tokens,
+                   mesh=mesh)
     jax.block_until_ready(out)
     _progress("generate compiled + warm run done")
 
@@ -256,25 +281,25 @@ def main() -> None:
     for i in range(iters):
         out = generate(
             params, cfg, prompt, jax.random.fold_in(kg, i),
-            max_new_tokens=new_tokens,
+            max_new_tokens=new_tokens, mesh=mesh,
         )
     jax.block_until_ready(out)
     dt = (time.time() - t0) / iters
 
     tok_per_sec = B * new_tokens / dt
-    emit_bench_record(
-        {
-            "metric": f"decode_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
-            "value": round(tok_per_sec, 1),
-            "unit": "sampled tokens/sec/chip",
-            "per_token_ms": round(1000 * dt / new_tokens, 3),
-            "batch": B,
-            "prompt_len": prompt_len,
-            "new_tokens": new_tokens,
-            "device": dev.device_kind,
-        },
-        args.json,
-    )
+    record = {
+        "metric": f"decode_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
+        "value": round(tok_per_sec, 1),
+        "unit": "sampled tokens/sec/chip",
+        "per_token_ms": round(1000 * dt / new_tokens, 3),
+        "batch": B,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "device": dev.device_kind,
+    }
+    if mesh is not None:
+        record["model_shards"] = args.model_shards
+    emit_bench_record(record, args.json)
 
 
 if __name__ == "__main__":

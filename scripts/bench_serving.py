@@ -39,6 +39,13 @@ against a single engine on the identical workload
 shards each replica's slot pool over a ``serving_mesh`` (on CPU,
 combine with ``XLA_FLAGS=--xla_force_host_platform_device_count=K``).
 
+``--model-shards N`` (or ``SERVE_MODEL_SHARDS``) tensor-parallels the
+serving WEIGHTS N-way over the 2-D serving mesh's model axis
+(``cfg.serving_model_shards``; docs/SERVING.md "2-D serving mesh").  In
+the default mode it also times a replicated-weights engine on the
+identical workload and reports ``tp_vs_replicated_speedup`` — the
+BENCH_SERVING.json ``tp_vs_replicated`` row.
+
 ``--long-prompt`` switches to the head-of-line-blocking workload: a few
 LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
 submitted AHEAD of the usual short mix, and the same workload runs
@@ -174,6 +181,14 @@ def main() -> None:
                          "router vs single-engine aggregate decode rate "
                          "(SERVE_DATA_SHARDS additionally shards each "
                          "replica's slot pool over a serving_mesh)")
+    ap.add_argument("--model-shards", type=int, default=0, metavar="N",
+                    help="tensor-parallel the serving weights N-way over "
+                         "the 2-D serving mesh's model axis "
+                         "(cfg.serving_model_shards; on CPU combine with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=K).  In the default mode this also times "
+                         "a replicated-weights engine on the identical "
+                         "workload and reports tp_vs_replicated_speedup")
     args = ap.parse_args()
     if args.long_prompt and args.replicas:
         ap.error("--long-prompt and --replicas are separate bench modes; "
@@ -221,6 +236,13 @@ def main() -> None:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, serving_data_shards=data_shards)
+    model_shards = args.model_shards or int(
+        os.environ.get("SERVE_MODEL_SHARDS", "0")
+    )
+    if model_shards:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, serving_model_shards=model_shards)
     params = jax.jit(lambda k: init_lm_params(k, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     _progress("params initialized")
@@ -379,6 +401,7 @@ def main() -> None:
             "router_vs_single_speedup": round(dt_single / dt_router, 2),
             "replicas": args.replicas,
             "serving_data_shards": cfg.serving_data_shards,
+            "serving_model_shards": cfg.serving_model_shards,
             "capacity_per_replica": capacity,
             "tokens_per_tick": tokens_per_tick,
             "requests": len(requests),
@@ -473,6 +496,33 @@ def main() -> None:
     _progress(f"engine: {served_tokens} tokens in {dt_serve:.2f}s")
     _progress(f"sequential: {total_new} tokens in {dt_seq:.2f}s")
 
+    tp_fields = {}
+    if cfg.serving_model_shards > 1:
+        # tp vs replicated: the SAME workload through an engine whose
+        # weights replicate (model=1) — isolates what the tensor-
+        # parallel weight split buys (or costs: on a shared-core CPU
+        # host the all-reduces are pure overhead, the row is a
+        # trajectory marker like router_vs_single)
+        import dataclasses
+
+        rep_cfg = dataclasses.replace(cfg, serving_model_shards=1)
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        ServingEngine(params, rep_cfg, **kw).run(requests)  # warm
+        t0 = time.perf_counter()
+        rep_results = ServingEngine(params, rep_cfg, **kw).run(requests)
+        dt_rep = time.perf_counter() - t0
+        rep_tokens = sum(len(r.new_tokens) for r in rep_results)
+        # the row is only meaningful if both layouts did the same work
+        assert rep_tokens == served_tokens, (rep_tokens, served_tokens)
+        tp_fields = {
+            "serving_model_shards": cfg.serving_model_shards,
+            "replicated_tokens_per_sec": round(rep_tokens / dt_rep, 1),
+            "tp_vs_replicated_speedup": round(dt_rep / dt_serve, 2),
+        }
+        _progress(f"replicated weights: {served_tokens} tokens in "
+                  f"{dt_rep:.2f}s "
+                  f"({tp_fields['tp_vs_replicated_speedup']}x tp speedup)")
+
     record = {
         "metric": f"serving_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
         "value": round(served_tokens / dt_serve, 1),
@@ -492,6 +542,7 @@ def main() -> None:
         "prefill_tokens_per_sec": summary["prefill_tokens_per_sec"],
         "latency": summary["latency"],
         "device": dev.device_kind,
+        **tp_fields,
     }
     if summary.get("kv_pages"):
         record["kv_pages"] = summary["kv_pages"]

@@ -177,9 +177,16 @@ def test_expert_parallel_matches_single_device(tmp_path):
 @pytest.mark.slow
 def test_expert_x_data_parallel_matches_single_device(tmp_path):
     """mesh (data=2, expert=2) composes: both act as batch axes for the
-    dense layers, experts shard over the expert axis."""
+    dense layers, experts shard over the expert axis.
+
+    rtol covers the (data x expert) layout's gradient-psum
+    re-association: the 2-D mesh reduces microbatch partials in a
+    different order than one device, and after 3 optimizer steps the
+    divergence compounds to ~5e-4 relative on the loss (measured
+    standalone; a shared-process run can land closer and did, which is
+    why the old 2e-4 passed in the full tier and failed alone)."""
     ref, _ = _trainer_losses(tmp_path / "a", MeshConfig(), micro=8)
     ep, _ = _trainer_losses(
         tmp_path / "b", MeshConfig(data=2, expert=2), micro=2
     )
-    np.testing.assert_allclose(ref, ep, rtol=2e-4)
+    np.testing.assert_allclose(ref, ep, rtol=2e-3)

@@ -1,0 +1,274 @@
+"""2-D serving mesh: tensor-parallel weights x data-parallel slots.
+
+The contract under test, per ISSUE 8's acceptance criteria:
+
+  * PARITY — with ``serving_model_shards > 1`` (weights split over the
+    mesh's model axis: Mamba d_inner channels, attention heads, the
+    embedding/head vocab axis) every engine token stream is
+    bit-identical to a solo ``generate(mesh=engine.mesh)`` call with
+    the same key — mamba1, mamba2, and the hybrid paged config,
+    short and chunked-long prompts, at (data=2, model=2) and
+    (data=1, model=4) on the conftest's forced 8-virtual-device host.
+  * LAYOUT — params carry NamedShardings partitioned over ``model``
+    exactly where the rules say (in/out projections, wqkv, embedding)
+    while slot/page state partitions over ``data`` ONLY — the two spec
+    families compose because they name disjoint axes.
+  * NO RETRACE — trace counts stay flat with tp on (the sharding
+    constraints add no jit signatures across a mixed workload or a
+    repeat run).
+  * REJECTION — a ``serving_model_shards`` that doesn't divide
+    d_inner / heads / vocab fails loudly at ENGINE CONSTRUCTION, not
+    as a GSPMD error mid-flight; ``serving_model_shards=1`` is the
+    exact pre-TP no-op (all-replicated param specs).
+
+Runnable standalone: ``pytest tests/test_tp_serving.py`` (also under
+``-m router`` with the rest of the fabric surface).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.serving import GenerationRequest, ServingEngine
+
+pytestmark = [pytest.mark.router, pytest.mark.serving, pytest.mark.fast]
+
+CHUNK = 16
+
+
+def tiny_cfg(layer="mamba2", **kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32", **kw)
+
+
+def hybrid_cfg(**kw):
+    """CPU-runnable hybrid: paged attention KV at layer 1 (4q/2kv)."""
+    return tiny_cfg(attn_layer_idx=(1,), attn_num_heads=4,
+                    attn_num_kv_heads=2, remat=False, kv_page_tokens=8,
+                    kv_slot_tokens=64, **kw)
+
+
+def make_cfg(layer, **kw):
+    return hybrid_cfg(**kw) if layer == "hybrid" else tiny_cfg(layer, **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def mixed_requests(n_short=3, n_long=1, max_new=6):
+    """Short prompts plus chunk-spanning longs (> 2 * CHUNK tokens)."""
+    reqs = []
+    for i in range(n_short):
+        reqs.append(GenerationRequest(
+            prompt_ids=rand_prompt(5 + 3 * i, seed=10 + i),
+            max_new_tokens=max_new, key=jax.random.PRNGKey(100 + i)))
+    for i in range(n_long):
+        reqs.append(GenerationRequest(
+            prompt_ids=rand_prompt(2 * CHUNK + 7 + i, seed=50 + i),
+            max_new_tokens=max_new, key=jax.random.PRNGKey(200 + i)))
+    return reqs
+
+
+def assert_parity(params, cfg, requests, results, mesh):
+    for r, res in zip(requests, results):
+        out = generate(params, cfg, jnp.asarray(r.prompt_ids)[None], r.key,
+                       max_new_tokens=r.max_new_tokens, mesh=mesh)
+        want = np.asarray(out)[0, len(r.prompt_ids):].tolist()
+        assert res.new_tokens.tolist() == want
+
+
+def _partitioned_axes(arr):
+    spec = arr.sharding.spec
+    return {ax for entry in spec if entry for ax in
+            (entry if isinstance(entry, tuple) else (entry,))}
+
+
+# ------------------------------------------------------------------ parity
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1", "hybrid"])
+def test_tp_engine_generate_parity_2x2(layer):
+    """(data=2, model=2): every engine stream — short and chunked-long
+    prompts — bit-matches solo generate() run with the same mesh."""
+    cfg = make_cfg(layer, serving_data_shards=2, serving_model_shards=2)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=4, tokens_per_tick=2)
+    assert dict(eng.mesh.shape) == {"data": 2, "model": 2}
+    reqs = mixed_requests()
+    results = eng.run(reqs)
+    assert_parity(params, cfg, reqs, results, eng.mesh)
+    if layer == "hybrid":
+        assert eng.page_pool.pages_in_use == 0  # full page recycle
+
+
+def test_tp_engine_generate_parity_pure_tp_1x4():
+    """(data=1, model=4): weights split 4-way with an unsharded slot
+    pool — the serve-a-model-bigger-than-one-device shape."""
+    cfg = tiny_cfg(serving_data_shards=1, serving_model_shards=4)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=4, tokens_per_tick=2)
+    assert dict(eng.mesh.shape) == {"data": 1, "model": 4}
+    reqs = mixed_requests()
+    results = eng.run(reqs)
+    assert_parity(params, cfg, reqs, results, eng.mesh)
+
+
+# ------------------------------------------------------------------ layout
+
+
+def test_tp_params_and_pool_shardings():
+    """Params partition over ``model`` exactly per the rules; slot/page
+    state stays partitioned over ``data`` ONLY (the model axis never
+    touches the pool)."""
+    from jax.sharding import NamedSharding
+
+    cfg = hybrid_cfg(serving_data_shards=2, serving_model_shards=2)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=4)
+    p = eng._params
+    assert isinstance(p["embedding"].sharding, NamedSharding)
+    # vocab column-parallel head: (V, d) axis 0
+    assert p["embedding"].sharding.spec[0] == "model"
+    # mamba in_proj column-parallel (…, d, d_in_proj): last axis
+    assert p["blocks"]["mixer"]["in_proj"]["kernel"].sharding.spec[-1] == "model"
+    # mamba out_proj row-parallel (…, d_inner, d): second-to-last axis
+    assert p["blocks"]["mixer"]["out_proj"]["kernel"].sharding.spec[-2] == "model"
+    # attention wqkv column-parallel over heads
+    assert p["attn_blocks"]["mixer"]["wqkv"]["kernel"].sharding.spec[-1] == "model"
+    # norm scales replicate
+    assert _partitioned_axes(p["norm_f"]["weight"]) == set()
+    assert _partitioned_axes(p["blocks"]["norm"]["weight"]) == set()
+    # slot/page state: data only — never the model axis
+    for leaf in jax.tree.leaves(eng.pool):
+        assert _partitioned_axes(leaf) <= {"data"}
+    assert _partitioned_axes(eng.pool["logits"]) == {"data"}
+    for leaf in jax.tree.leaves(eng.pool["state"]):
+        assert _partitioned_axes(leaf) == {"data"}
+
+
+def test_model_shards_one_is_exact_status_quo():
+    """serving_model_shards=1: every param spec is P() — byte-identical
+    to the pre-TP replicated layout — and the chunk/prefill steps see
+    mesh=None (same jit signatures as PR 7)."""
+    from mamba_distributed_tpu.parallel.sharding import serving_param_specs
+
+    cfg = tiny_cfg(serving_data_shards=2)  # model defaults to 1
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    specs = serving_param_specs(params, 1)
+    assert all(s == P() for s in jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)))
+    eng = ServingEngine(params, cfg, capacity=4)
+    assert eng.model_shards == 1 and eng._tp_mesh is None
+    for leaf in jax.tree.leaves(eng._params):
+        assert _partitioned_axes(leaf) == set()
+
+
+# -------------------------------------------------------------- no retrace
+
+
+def test_tp_trace_counts_stay_flat():
+    """With tp on, a mixed workload compiles ONE tick and ONE chunk
+    signature, and a repeat workload retraces nothing — the sharding
+    constraints add no signatures."""
+    from mamba_distributed_tpu.serving.engine import TRACE_COUNTS
+    from mamba_distributed_tpu.serving.prefill import (
+        TRACE_COUNTS as CHUNK_COUNTS,
+    )
+
+    cfg = tiny_cfg(serving_data_shards=2, serving_model_shards=2)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=4, tokens_per_tick=2)
+    t0, c0 = TRACE_COUNTS["tick"], CHUNK_COUNTS["chunk"]
+    eng.run(mixed_requests())
+    # at most ONE fresh signature each (exactly 0 when an earlier test
+    # in the process already compiled this mesh/cfg — equal meshes hash
+    # equal, so the jit cache is shared)
+    t1, c1 = TRACE_COUNTS["tick"], CHUNK_COUNTS["chunk"]
+    assert t1 - t0 <= 1 and c1 - c0 <= 1
+    eng.run(mixed_requests())  # identical workload: zero new signatures
+    assert TRACE_COUNTS["tick"] == t1
+    assert CHUNK_COUNTS["chunk"] == c1
+
+
+def test_tp_tick_records_stamp_model_shards(tmp_path):
+    """serving_tick records carry the model_shards stamp when tp is on
+    (and stay unchanged when it is off — docs/OBSERVABILITY.md)."""
+    import json
+
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    cfg = tiny_cfg(serving_data_shards=2, serving_model_shards=2)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    path = str(tmp_path / "ticks.jsonl")
+    eng = ServingEngine(params, cfg, capacity=4, tokens_per_tick=2,
+                        metrics=ServingMetrics(4, jsonl_path=path))
+    eng.run(mixed_requests(n_short=2, n_long=0))
+    ticks = [json.loads(l) for l in open(path)
+             if json.loads(l)["kind"] == "serving_tick"]
+    assert ticks and all(t["model_shards"] == 2 for t in ticks)
+    # tp off: the field is absent, records byte-stable vs PR 7
+    path2 = str(tmp_path / "ticks2.jsonl")
+    eng2 = ServingEngine(params, tiny_cfg(), capacity=4, tokens_per_tick=2,
+                         metrics=ServingMetrics(4, jsonl_path=path2))
+    eng2.run(mixed_requests(n_short=2, n_long=0))
+    ticks2 = [json.loads(l) for l in open(path2)
+              if json.loads(l)["kind"] == "serving_tick"]
+    assert ticks2 and all("model_shards" not in t for t in ticks2)
+
+
+# -------------------------------------------------------------- rejection
+
+
+def test_tp_divisibility_rejected_at_construction():
+    """A model width that doesn't tile fails at ENGINE CONSTRUCTION
+    with the offending dimension named — never a GSPMD error
+    mid-flight."""
+    # hybrid heads: nkv=2 cannot tile over model=4
+    cfg = hybrid_cfg(serving_model_shards=4)
+    params = init_lm_params(jax.random.PRNGKey(0), hybrid_cfg())
+    with pytest.raises(ValueError, match="attn_num_kv_heads=2"):
+        ServingEngine(params, cfg, capacity=4)
+    # d_inner: 2 * 36 = 72 tiles over 4 but vocab 64 and d_inner both
+    # fail at model=5 (no power-of-two escape hatch)
+    cfg2 = tiny_cfg(serving_model_shards=5)
+    params2 = init_lm_params(jax.random.PRNGKey(0), tiny_cfg())
+    with pytest.raises(ValueError, match="d_inner"):
+        ServingEngine(params2, cfg2, capacity=5)
+    # mamba2's PACKED projection axes: nheads (and so the packed
+    # in_proj width 2*di + 2*g*ds + nh) can be indivisible even when
+    # d_inner divides — must reject, not silently replicate the
+    # biggest weight (headdim=16 over d_inner=48 -> nh=3, odd)
+    from mamba_distributed_tpu.parallel.sharding import (
+        validate_serving_model_shards,
+    )
+
+    odd_heads = ModelConfig(d_model=24, n_layer=2, vocab_size=64,
+                            ssm_layer="mamba2", headdim=16, chunk_size=16,
+                            d_state=16, compute_dtype="float32")
+    assert odd_heads.d_inner % 2 == 0  # d_inner alone would pass
+    with pytest.raises(ValueError, match="nheads=3"):
+        validate_serving_model_shards(odd_heads, 2)
+    # the mesh itself still rejects nonsense widths
+    from mamba_distributed_tpu.parallel.mesh import serving_mesh
+
+    with pytest.raises(ValueError, match="model_shards"):
+        serving_mesh(1, model_shards=0)
+    with pytest.raises(ValueError, match="devices"):
+        serving_mesh(4, model_shards=4)  # 16 > the 8 forced devices
+
+
+def test_config_rejects_bad_model_shards():
+    with pytest.raises(ValueError, match="serving_model_shards"):
+        ModelConfig(serving_model_shards=0)
