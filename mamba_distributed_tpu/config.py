@@ -191,6 +191,31 @@ class ModelConfig:
     # mesh.  1 => single-device pool (the pre-fabric behavior).
     # capacity must divide evenly across the shards.
     serving_data_shards: int = 1
+    # --- prefix-state cache + preemption (serving/prefix_cache.py,
+    # serving/engine.py) ---
+    # Prefix-state cache entry cap: chunk-boundary conv/SSM carry
+    # snapshots (and full-prompt state+logits pairs) keyed by
+    # prompt-prefix hash, so requests sharing a system prompt / few-
+    # shot preamble skip the shared prefill work — near-zero TTFT on
+    # full hits.  0 disables (the default: the cache pins device
+    # buffers alive and — for hybrids — holds KV page refs past
+    # request eviction, so it is opt-in).  Hybrid caches are engine-
+    # private (entries pin the engine's own page pool).
+    prefix_cache_entries: int = 0
+    # Byte cap over cached state (carries + logits + pinned KV page
+    # bytes); LRU evicts over either cap.  0 => entry cap only.
+    prefix_cache_bytes: int = 0
+    # Promotion threshold: a prefix must MISS this many lookups before
+    # its snapshot is stored (1 = store on first sight; raise to keep
+    # one-off prompts from churning the LRU).
+    prefix_min_chunk_hits: int = 1
+    # Priority a request defaults to when GenerationRequest.priority
+    # is None (higher = more important).  When a higher-priority
+    # request is queued with no free slot, the engine preempts the
+    # lowest-priority DECODING slot: its carry swaps to host RAM (KV
+    # page refs held — no page churn) and it resumes later without
+    # re-prefill, mid-stream, bit-exactly.
+    serving_default_priority: int = 0
     # Tensor-parallel shards of the serving WEIGHTS over `mesh.model`
     # (the 2-D serving mesh's second axis): Mamba d_inner channels,
     # attention heads and the embedding/head vocab axis split across
@@ -269,6 +294,21 @@ class ModelConfig:
             raise ValueError(
                 f"serving_model_shards must be >= 1, got "
                 f"{self.serving_model_shards}"
+            )
+        if self.prefix_cache_entries < 0:
+            raise ValueError(
+                f"prefix_cache_entries must be >= 0 (0 disables the "
+                f"prefix-state cache), got {self.prefix_cache_entries}"
+            )
+        if self.prefix_cache_bytes < 0:
+            raise ValueError(
+                f"prefix_cache_bytes must be >= 0 (0 => entry cap only), "
+                f"got {self.prefix_cache_bytes}"
+            )
+        if self.prefix_min_chunk_hits < 1:
+            raise ValueError(
+                f"prefix_min_chunk_hits must be >= 1 (store on first "
+                f"sight), got {self.prefix_min_chunk_hits}"
             )
         if self.kv_page_tokens < 8 or self.kv_page_tokens % 8:
             raise ValueError(

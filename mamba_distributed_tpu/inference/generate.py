@@ -37,6 +37,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mamba_distributed_tpu.config import ModelConfig
 from mamba_distributed_tpu.inference.bucketing import (
@@ -243,6 +244,7 @@ def generate(
     eos_id: int | None = None,
     length_bucketing: bool = True,
     mesh=None,
+    prefix_cache=None,
 ) -> jax.Array:
     """prompt_ids (b, t) int32 -> (b, t + max_new_tokens) sampled tokens.
 
@@ -269,6 +271,17 @@ def generate(
     for any prompt length, and the exact computation the serving engine
     runs, which is what keeps engine-vs-generate() token parity exact
     for long prompts too.
+
+    ``prefix_cache`` (a serving/prefix_cache.PrefixCache; pure-SSM,
+    batch-1) reuses carry snapshots: an exact-prompt full hit skips the
+    prefill outright (one-shot AND chunked layouts), a chunked partial
+    hit resumes at the first uncached chunk, and chunked prefills store
+    their boundaries back.  Sharing an engine's cache (same params!)
+    makes warm engine==generate() parity directly testable — and warm
+    streams are bit-identical to cold ones regardless, because a
+    snapshot is the identical computation's literal output.  Hybrid
+    configs ignore the cache here (their entries pin a serving
+    engine's KV page pool).
     """
     b, t = prompt_ids.shape
     hybrid = bool(cfg.attn_layer_idx)
@@ -292,6 +305,7 @@ def generate(
         last_logits, state = chunked_prefill(
             params, cfg, prompt_ids,
             max_len=(t + max_new_tokens) if hybrid else 0, mesh=mesh,
+            prefix_cache=None if hybrid else prefix_cache,
         )
         new_tokens = _decode_impl(
             params, cfg, state, last_logits, key, max_new_tokens, top_k,
@@ -299,6 +313,24 @@ def generate(
             mesh=mesh,
         )
         return jnp.concatenate([prompt_ids, new_tokens], axis=1)
+    if (prefix_cache is not None and not hybrid and b == 1
+            and length_bucketing):
+        # one-shot full hit: decode straight off the cached snapshot
+        # (an engine's one-shot admission stores these — same pow2
+        # layout, same key — so an exact prompt repeat skips lm_prefill
+        # here too).  The one-shot path cannot STORE (its prefill state
+        # never leaves the fused _generate_impl jit), but misses still
+        # go through lookup() so hit/miss/promotion accounting matches
+        # the engine's on a shared cache.
+        hit = prefix_cache.lookup(np.asarray(prompt_ids[0]), None)
+        if hit is not None:
+            entry = hit[0]
+            new_tokens = _decode_impl(
+                params, cfg, {"blocks": entry.state["blocks"]},
+                entry.logits, key, max_new_tokens, top_k, temperature,
+                jnp.int32(-1 if eos_id is None else eos_id), mesh=mesh,
+            )
+            return jnp.concatenate([prompt_ids, new_tokens], axis=1)
     if length_bucketing and not cfg.attn_layer_idx:
         padded, mask = pad_to_bucket(prompt_ids, next_pow2_bucket(t))
     else:

@@ -16,11 +16,20 @@ docs/SERVING.md has the architecture; the short version:
   replica      one engine + lifecycle (active/draining/dead) — the
                router's placement unit
   router       data-parallel serving fabric front end: least-loaded
-               placement over N replicas, drain, failover with replay
+               placement over N replicas (prefix-cache affinity
+               discounts warm replicas), drain, failover with replay
                dedup (docs/SERVING.md "Multi-host serving")
+  prefix_cache host-side LRU of chunk-boundary carry snapshots keyed
+               by prompt-prefix hash — near-zero TTFT for shared
+               prompts; hybrid entries pin KV pages copy-on-write
+               (docs/SERVING.md "Prefix caching & preemption")
 """
 
 from mamba_distributed_tpu.serving.engine import ServingEngine
+from mamba_distributed_tpu.serving.prefix_cache import (
+    PrefixCache,
+    PrefixEntry,
+)
 from mamba_distributed_tpu.serving.replica import EngineReplica, ReplicaState
 from mamba_distributed_tpu.serving.router import RequestRouter
 from mamba_distributed_tpu.serving.prefill import (
@@ -35,7 +44,13 @@ from mamba_distributed_tpu.serving.scheduler import (
     RequestStatus,
     TokenEvent,
 )
-from mamba_distributed_tpu.serving.state_cache import evict, init_pool, insert
+from mamba_distributed_tpu.serving.state_cache import (
+    PagePool,
+    PagePoolError,
+    evict,
+    init_pool,
+    insert,
+)
 
 __all__ = [
     "ChunkPlan",
@@ -43,6 +58,10 @@ __all__ = [
     "FCFSScheduler",
     "GenerationRequest",
     "GenerationResult",
+    "PagePool",
+    "PagePoolError",
+    "PrefixCache",
+    "PrefixEntry",
     "ReplicaState",
     "RequestRouter",
     "RequestStatus",

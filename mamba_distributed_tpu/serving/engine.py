@@ -65,7 +65,9 @@ from mamba_distributed_tpu.models.lm import (
     lm_prefill,
     lm_step,
 )
+from mamba_distributed_tpu.serving import prefix_cache as prefix_cache_mod
 from mamba_distributed_tpu.serving import state_cache
+from mamba_distributed_tpu.serving.prefix_cache import PrefixCache
 from mamba_distributed_tpu.serving.prefill import (
     cast_decode_params,
     chunk_inputs,
@@ -289,6 +291,29 @@ class ServingEngine:
         only from shard d's contiguous page range
         (state_cache.PagePool); the model axis never touches page
         accounting — pages tile over data only.
+      prefix_cache: a serving/prefix_cache.PrefixCache, or None to
+        build one from ``cfg.prefix_cache_entries`` (> 0 enables; the
+        default 0 keeps the cache off).  Admission matches the longest
+        cached chunk-aligned prefix of each prompt and seeds the slot
+        from the snapshot — a FULL hit inserts the cached state+logits
+        outright (zero prefill compute, near-zero TTFT), a partial hit
+        resumes chunking at the first uncached chunk.  Warm streams
+        stay bit-identical to cold ones because a snapshot is the
+        literal output of the identical chunk computation.  Hybrid
+        entries pin KV pages in THIS engine's pool (copy-on-write
+        sharing across slots, refcounted) — hybrid caches are engine-
+        private; pure-SSM caches may be shared with
+        ``generate(prefix_cache=)`` under the same params.
+
+    Priority + preemption: requests carry a ``priority`` (higher wins;
+    default ``cfg.serving_default_priority``).  When the queue's best
+    request outranks a resident DECODING slot and no slot is free, the
+    engine preempts the lowest-priority victim — its carry + logits
+    swap to host RAM (``state_cache.restore`` puts them back with the
+    token counter intact, so the resumed stream continues bit-exactly),
+    its KV page refs ride along (no page churn, no re-prefill).  With
+    every request at one priority the scheduler is the FCFS queue it
+    always was.
 
     Prefill buckets are the module defaults of inference/bucketing.py —
     deliberately not a knob, so the engine and a solo ``generate()``
@@ -309,6 +334,7 @@ class ServingEngine:
         tracer=NULL_TRACER,
         slo=None,
         mesh=None,
+        prefix_cache: PrefixCache | None = None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -377,7 +403,9 @@ class ServingEngine:
         # constraints — None below model=2 so the TP-off jit signatures
         # (and trace counts) are byte-identical to the pre-TP engine
         self._tp_mesh = mesh if self.model_shards > 1 else None
-        self.scheduler = FCFSScheduler()
+        self.scheduler = FCFSScheduler(
+            default_priority=cfg.serving_default_priority
+        )
         self.metrics = metrics or ServingMetrics(capacity)
         self.tracer = tracer
         self.slo = slo
@@ -428,6 +456,34 @@ class ServingEngine:
             self._kv_len = np.zeros((capacity,), np.int32)
             self._page_allocs = 0  # per-step gauges -> serving_tick
             self._page_frees = 0
+        # --- prefix-state cache (serving/prefix_cache.py): host-side
+        # LRU of chunk-boundary carries + full-prompt snapshots keyed
+        # by prompt-prefix hash.  Off unless cfg.prefix_cache_entries
+        # > 0 or an explicit instance is passed.  Hybrid entries pin
+        # KV pages in THIS engine's pool (refcounts; the LRU's evict
+        # hook decrefs), so hybrid caches are engine-private.
+        if prefix_cache is None and cfg.prefix_cache_entries > 0:
+            prefix_cache = PrefixCache(
+                max_entries=cfg.prefix_cache_entries,
+                max_bytes=cfg.prefix_cache_bytes,
+                min_hits=cfg.prefix_min_chunk_hits,
+            )
+        self.prefix_cache = prefix_cache
+        if prefix_cache is not None:
+            if self.hybrid:
+                prefix_cache.evict_hook = self._drop_entry_pages
+                # bytes one physical page pins across every layer's K+V
+                # pool — the KV share of an entry's byte accounting
+                self._page_nbytes = int(sum(
+                    x.nbytes // x.shape[1]
+                    for x in jax.tree.leaves(
+                        self.pool["state"]["attn_blocks"])
+                ))
+            self.metrics.configure_prefix_cache()
+        self._pc_hits = 0  # per-window gauges -> serving_tick records
+        self._pc_misses = 0
+        self._pc_saved_tokens = 0
+        self._preemptions = 0
         # prefill accounting awaiting a tick record: tick-less steps
         # (everything resident still mid-prefill) roll their stall /
         # chunk counters into the NEXT tick's jsonl record so the
@@ -513,11 +569,35 @@ class ServingEngine:
         KV pool — register a chunk plan and park a zero carry, their
         chunks running in the budget phase (``_advance_prefill``).
 
+        With the prefix cache on, admission first matches the longest
+        cached chunk-aligned prefix of the prompt: a FULL hit inserts
+        the snapshot's state+logits outright (zero chunk steps — the
+        near-zero-TTFT path), a partial hit seeds the carry so prefill
+        resumes at the first uncached chunk.  Hybrid hits attach to the
+        cached prefix's KV pages copy-on-write (read-only refs on whole
+        pages, a fresh device copy of the boundary page the slot will
+        append into), confined to the prefix's data shard.
+
+        A preempted request (``tracked.snapshot``) re-admits through
+        ``_resume`` instead — host carry restored, no prefill at all.
+
         Returns False (request back at the queue head, admission stalls)
         when a hybrid request's page reservation doesn't fit the free
         pool yet — evictions recycle pages, never a mid-flight OOM."""
+        if tracked.snapshot is not None:
+            return self._resume(tracked)
         r = tracked.request
-        n_pages = 0
+        plan = plan_chunks(len(r.prompt_ids),
+                           self.cfg.effective_prefill_chunk_tokens,
+                           force=self.hybrid)
+        # PEEK: stats/recency/promotion commit only after a slot is
+        # secured (commit_lookup below) — a page-stalled request retries
+        # this every step and must not drift the cache's counters
+        hit = (None if self.prefix_cache is None
+               else self.prefix_cache.lookup(r.prompt_ids, plan,
+                                             peek=True))
+        n_pages = shared_n = fresh_n = 0
+        cow = False
         if self.hybrid:
             n_pages = attention_page_count(
                 self.cfg, len(r.prompt_ids) + r.max_new_tokens
@@ -542,28 +622,108 @@ class ServingEngine:
                     f"admitted and has been dropped from the queue; "
                     f"raise cfg.kv_pool_pages or split the request"
                 )
-            # first free slot whose shard can cover the reservation (a
-            # sharded pool confines each slot to its shard's pages;
-            # unsharded pools have one shard, preserving FCFS slot order)
-            slot = next(
-                (s for s in self._free
-                 if n_pages <= self.page_pool.free_pages_in(
-                     self._slot_shard(s))),
-                None,
-            )
-            if slot is None:
-                self.scheduler.requeue(tracked)
-                return False
+            if hit is not None:
+                # a cached prefix's pages live in ONE data shard (pages
+                # never cross shards); attaching needs a same-shard slot
+                # plus fresh pages for everything this slot will write —
+                # whole shared pages stay read-only, and a prefix ending
+                # mid-page costs one extra fresh page for the CoW copy
+                entry = hit[0]
+                page = self.cfg.kv_page_tokens
+                shared_n = entry.kv_len // page
+                cow = bool(entry.kv_len % page)
+                fresh_n = n_pages - shared_n
+                slot = next(
+                    (s for s in self._free
+                     if self._slot_shard(s) == entry.shard
+                     and fresh_n <= self.page_pool.free_pages_in(
+                         entry.shard)),
+                    None,
+                )
+                if slot is None:
+                    hit = None  # serve cold rather than wait on one
+                    # shard (commit_lookup below records the miss: the
+                    # work gets fully recomputed)
+            if hit is None:
+                # first free slot whose shard can cover the reservation
+                # (a sharded pool confines each slot to its shard's
+                # pages; unsharded pools have one shard, preserving FCFS
+                # slot order)
+                def _fits():
+                    return next(
+                        (s for s in self._free
+                         if n_pages <= self.page_pool.free_pages_in(
+                             self._slot_shard(s))),
+                        None,
+                    )
+
+                slot = _fits()
+                if slot is None and self._reclaim_cache_pages(n_pages):
+                    slot = _fits()
+                if slot is None:
+                    self.scheduler.requeue(tracked)
+                    return False
             self._free.remove(slot)
         else:
             slot = self._free.pop(0)
         tracked.status = RequestStatus.PREFILL
-        plan = plan_chunks(len(r.prompt_ids),
-                           self.cfg.effective_prefill_chunk_tokens,
-                           force=self.hybrid)
+        entry = hit[0] if hit is not None else None
+        seeded_chunks = hit[1] if hit is not None else 0
+        full_hit = entry is not None and entry.full
         t0 = time.perf_counter()
         try:
-            if plan is None:
+            if self.hybrid and entry is not None:
+                fresh = self.page_pool.alloc(fresh_n, entry.shard)
+                shared = list(entry.kv_pages[:shared_n])
+                self.page_pool.incref(shared)
+                # the gauges count page REFS acquired/released (incref
+                # included) so allocs == frees still closes the loop on
+                # cache-sharing engines — _release_pages decrefs every
+                # ref this slot holds, shared or fresh
+                self._page_allocs += fresh_n + len(shared)
+                tracked.pages = shared + fresh
+                if cow:
+                    # the slot's first KV write targets position kv_len,
+                    # inside the prefix's last (partial) page: append
+                    # into an owned copy, never the shared original
+                    self.pool["state"]["attn_blocks"] = \
+                        state_cache.copy_page(
+                            self.pool["state"]["attn_blocks"],
+                            int(entry.kv_pages[shared_n]), int(fresh[0]),
+                        )
+                self._page_tbl[slot] = 0
+                self._page_tbl[slot, :n_pages] = tracked.pages
+                self._kv_len[slot] = entry.kv_len
+            if full_hit:
+                # the snapshot IS the prefill's output: insert it and
+                # decode — zero chunk steps, zero prefill compute (the
+                # next tick's fetch is the one sync point, as ever)
+                with self.tracer.span("serving_prefill", slot=slot,
+                                      request=tracked.request_id,
+                                      trace=tracked.trace_id,
+                                      cache="full"):
+                    self.pool = state_cache.insert(
+                        self.pool, slot,
+                        {"blocks": entry.state["blocks"]}, entry.logits,
+                        r.resolve_key(), r.max_new_tokens, r.top_k,
+                        r.temperature,
+                        -1 if r.eos_id is None else r.eos_id,
+                    )
+            elif entry is not None:
+                # partial hit: seed the cached carry; chunking resumes
+                # at the first uncached chunk (the remaining chunks run
+                # the identical computation a cold admission would, so
+                # the warm stream is bit-identical to cold)
+                tracked.plan = plan
+                tracked.chunks_done = seeded_chunks
+                tracked.prefill_dt = 0.0
+                tracked.prefill_seeded_tokens = entry.tokens
+                self.pool = state_cache.stash_prefill(
+                    self.pool, slot, {"blocks": entry.state["blocks"]},
+                    r.resolve_key(), r.max_new_tokens, r.top_k,
+                    r.temperature, -1 if r.eos_id is None else r.eos_id,
+                )
+            elif plan is None:
                 # one per-request span (trace-stamped) so even a short
                 # prompt's journey has an anchor in this replica's
                 # stream for the exporter's flow arrows
@@ -587,6 +747,13 @@ class ServingEngine:
                         r.max_new_tokens, r.top_k, r.temperature,
                         -1 if r.eos_id is None else r.eos_id,
                     )
+                    if self.prefix_cache is not None:
+                        # snapshot the one-shot prefill's output (state
+                        # was NOT donated by insert — safe to retain):
+                        # an exact prompt repeat skips _prefill outright
+                        self.prefix_cache.maybe_store_full(
+                            r.prompt_ids, state, logits
+                        )
             else:
                 tracked.plan = plan
                 tracked.chunks_done = 0
@@ -614,14 +781,33 @@ class ServingEngine:
             self._free.insert(0, slot)
             self.scheduler.requeue(tracked)
             raise
+        if self.prefix_cache is not None:
+            # admission went through: commit the lookup outcome — cache
+            # lifetime stats/recency/promotion + the engine's window
+            # gauges.  AFTER the try block, so a failed (requeued +
+            # retried) admission can't double-count, and a shard-
+            # dropped hybrid hit commits as the miss it became.
+            self.prefix_cache.commit_lookup(r.prompt_ids, plan, hit)
+            kind = None if entry is None else (
+                "full" if full_hit else "partial")
+            tracked.cache_hit = kind
+            if kind is None:
+                self._pc_misses += 1
+            else:
+                self._pc_hits += 1
+                self._pc_saved_tokens += entry.tokens
+            self.metrics.record_prefix_lookup(
+                kind, 0 if entry is None else entry.tokens)
         # dt is host dispatch time (prefill runs async; the next tick's
         # fetch absorbs device completion)
         t_admit = time.perf_counter()
-        if plan is None:
+        if plan is None and entry is None:
             self.metrics.record_prefill(int(len(r.prompt_ids)), t_admit - t0)
             # goodput: the one-shot prefill's real tokens vs the padded
             # bucket lanes it computed, attributed to the next tick's
-            # window (its dispatch time is already in the stall)
+            # window (its dispatch time is already in the stall).  A
+            # full-hit admission ran NO prefill lanes, so it counts in
+            # neither side — its win shows up as prefix_saved_tokens.
             self._pending_oneshot_real_tokens += int(len(r.prompt_ids))
             self._pending_oneshot_lanes += next_pow2_bucket(
                 len(r.prompt_ids)
@@ -634,7 +820,7 @@ class ServingEngine:
         self.metrics.record_queue_wait(t_admit - tracked.t_submit)
         tracked.slot = slot
         self._slots[slot] = tracked
-        if plan is None:
+        if full_hit or plan is None:
             tracked.status = RequestStatus.DECODE
         else:
             self._prefill_queue.append(slot)
@@ -689,14 +875,30 @@ class ServingEngine:
             # of this window's prefill lanes
             self._pending_chunk_real_tokens += plan.real_tokens(i)
             state = {"blocks": state["blocks"]}
+            # prefix cache: snapshot this boundary's carry (the arrays
+            # are chunk-step OUTPUTS — the next grant resumes from the
+            # pool via read_state, so nothing ever donates them away).
+            # The LAST boundary is stored too: it seeds longer prompts
+            # with the same left-pad that extend this one.
+            self._store_prefix(r.prompt_ids, plan, i, state, slot)
             if tracked.chunks_done == plan.n_chunks:
+                # ...and the full-prompt entry (state + last logits):
+                # an exact repeat skips prefill entirely
+                self._store_prefix(r.prompt_ids, plan, i, state, slot,
+                                   logits=logits)
                 self.pool = state_cache.finish_prefill(
                     self.pool, slot, state, logits
                 )
                 self._prefill_queue.remove(slot)
                 tracked.status = RequestStatus.DECODE
+                # a partial hit seeded prefill_seeded_tokens of this
+                # prompt from the cache — report only the COMPUTED
+                # share (the seeded share is already accounted as
+                # prefix_saved_tokens; counting it here too would
+                # inflate prefill throughput on warm workloads)
                 self.metrics.record_prefill(
-                    plan.prompt_len, tracked.prefill_dt
+                    plan.prompt_len - tracked.prefill_seeded_tokens,
+                    tracked.prefill_dt,
                 )
             else:
                 self.pool = state_cache.stash_prefill(
@@ -732,6 +934,227 @@ class ServingEngine:
             self.scheduler.requeue(tracked)
             raise
         return budget_left
+
+    # ------------------------------------------------- prefix-state cache
+
+    def _drop_entry_pages(self, entry) -> None:
+        """Prefix-cache LRU evict hook: release the entry's pinned KV
+        page refs.  A page frees only when no slot still shares it
+        (PagePool refcounts) — eviction decrefs, never yanks."""
+        if entry.kv_pages:
+            self.page_pool.free(list(entry.kv_pages))
+            self._page_frees += len(entry.kv_pages)
+
+    def _store_prefix(self, prompt_ids, plan, i: int, state: dict, slot,
+                      logits=None) -> None:
+        """Snapshot chunk ``i``'s carry into the prefix cache (with
+        ``logits``: the full-prompt entry instead).  Hybrid snapshots
+        pin the KV pages covering the prefix (incref — the cache is a
+        holder like any slot; its evict hook decrefs).  ``state`` must
+        be retainable: batch-1 device arrays no later call donates."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        if logits is not None:
+            key = prefix_cache_mod.full_key(prompt_ids, plan.chunk)
+            tokens = plan.prompt_len
+        else:
+            key = prefix_cache_mod.boundary_key(prompt_ids, plan, i)
+            tokens = (i + 1) * plan.chunk - plan.pad
+        if not pc.wants(key):
+            return
+        kv_pages = None
+        kv_len = shard = page_bytes = 0
+        if self.hybrid:
+            kv_len = tokens
+            n = -(-kv_len // self.cfg.kv_page_tokens)
+            kv_pages = tuple(self._slots[slot].pages[:n])
+            self.page_pool.incref(list(kv_pages))
+            self._page_allocs += n  # ref acquired (balances the evict
+            # hook's decref in the kv_page_allocs/frees gauges)
+            shard = self._slot_shard(slot)
+            page_bytes = n * self._page_nbytes
+        nbytes = (prefix_cache_mod.state_nbytes(state) + page_bytes
+                  + (int(logits.nbytes) if logits is not None else 0))
+        pc.put(key, prefix_cache_mod.PrefixEntry(
+            state=state, tokens=tokens, chunks=i + 1, nbytes=nbytes,
+            logits=logits, kv_pages=kv_pages, kv_len=kv_len, shard=shard,
+        ))
+
+    def _reclaim_cache_pages(self, n_pages: int) -> bool:
+        """Admission pressure valve: the queue head needs KV pages that
+        prefix-cache entries are pinning.  Evict page-pinned entries
+        LRU-first (their hooks decref; a page actually frees only when
+        no slot still shares it) until some free slot's shard covers
+        the reservation.  Without this, non-resident holders could
+        starve hybrid admission forever — resident slots always finish
+        and release pages, cache entries never would.  Returns True
+        when the reservation now fits somewhere."""
+        pc = self.prefix_cache
+        if pc is None or not self._free:
+            return False
+
+        shards = {self._slot_shard(s) for s in self._free}
+
+        def satisfied():
+            return any(n_pages <= self.page_pool.free_pages_in(d)
+                       for d in shards)
+
+        while not satisfied():
+            if not pc.evict_one_pinned(shards):
+                return False
+        return True
+
+    def _resume_parked(self) -> None:
+        """Resume queued PREEMPTED requests into remaining free slots
+        even though the queue's best request is stalled on KV pages:
+        their swap-ins need no new pages (the refs ride on their
+        trackers), and running them to completion is the only way the
+        pages they pin ever release — without this, a stalled head
+        and a page-holding preempted request behind it deadlock each
+        other."""
+        while self._free:
+            parked = self.scheduler.pop_preempted()
+            if parked is None or not self._admit(parked):
+                return
+
+    def prefix_hit_fraction(self, prompt_ids) -> float:
+        """Fraction of ``prompt_ids`` whose prefill this engine's prefix
+        cache could skip right now (0.0 with the cache off) — a pure
+        probe: no stats bumped, no LRU recency touched.  The router's
+        placement cost subtracts it (cache affinity: a warm replica is
+        cheaper than an idle cold one for a shared-prefix prompt)."""
+        pc = self.prefix_cache
+        if pc is None or len(prompt_ids) == 0:
+            return 0.0
+        plan = plan_chunks(len(prompt_ids),
+                           self.cfg.effective_prefill_chunk_tokens,
+                           force=self.hybrid)
+        hit = pc.lookup(np.asarray(prompt_ids, np.int32), plan, peek=True)
+        if hit is None:
+            return 0.0
+        return min(1.0, hit[0].tokens / len(prompt_ids))
+
+    # ------------------------------------------------ priority preemption
+
+    def _victim_slot_admits(self, head: _Tracked, victim: _Tracked) -> bool:
+        """Would preempting ``victim`` actually let ``head`` admit?  A
+        swap-out that can't be followed by the head's admission is pure
+        loss — the victim's stream stalls behind a still-stuck head
+        (preemption frees a SLOT, never pages: the victim's KV refs
+        ride along).  Checks the COLD page reservation (conservative: a
+        same-shard cache hit could get by with fewer fresh pages, but
+        cold is the guaranteed fallback route), and a preempted head's
+        snapshot pins it to its own data shard."""
+        if not self.hybrid:
+            return True
+        shard = self._slot_shard(victim.slot)
+        if head.snapshot is not None:
+            return shard == head.snapshot.get("shard", shard)
+        r = head.request
+        n_pages = attention_page_count(
+            self.cfg, len(r.prompt_ids) + r.max_new_tokens
+        )
+        return n_pages <= self.page_pool.free_pages_in(shard)
+
+    def _pick_victim(self):
+        """The decoding slot to preempt for the queue's best request:
+        lowest priority strictly below the incoming one, restricted to
+        victims whose freed slot the head could actually occupy
+        (``_victim_slot_admits``); ties prefer the fewest generated
+        tokens (least latency already sunk), then the newest request.
+        None when nothing is outranked — with uniform priorities
+        preemption never triggers."""
+        head = self.scheduler.peek()
+        if head is None:
+            return None
+        victims = [t for t in self._slots.values()
+                   if t.status is RequestStatus.DECODE
+                   and t.priority < head.priority
+                   and self._victim_slot_admits(head, t)]
+        if not victims:
+            return None
+        return min(victims, key=lambda t: (t.priority, len(t.new_tokens),
+                                           -t.request_id))
+
+    def _preempt(self, tracked: _Tracked) -> None:
+        """Swap a decoding slot out to host RAM: copy its carry + last
+        logits off-device (the one deliberate sync on this path — a
+        swap-out IS a device->host move), keep its KV page refs riding
+        on the tracker (hybrid: zero page churn, the pages stay shard-
+        pinned for the resume), free the slot, requeue.  ``_resume``
+        restores via ``state_cache.restore`` with the token counter
+        intact, so the continued stream is bit-exactly the one the
+        swap-out interrupted — no re-prefill, no replayed token."""
+        slot = tracked.slot
+        with self.tracer.span("serving_preempt", slot=slot,
+                              request=tracked.request_id,
+                              trace=tracked.trace_id):
+            state = state_cache.read_state(self.pool, slot)
+            snap = {
+                "blocks": jax.device_get(state["blocks"]),
+                "logits": jax.device_get(self.pool["logits"][slot][None]),
+                "step": len(tracked.new_tokens),
+            }
+            if self.hybrid:
+                snap["kv_len"] = int(self._kv_len[slot])
+                snap["shard"] = self._slot_shard(slot)
+                self._page_tbl[slot] = 0
+                self._kv_len[slot] = 0
+            tracked.snapshot = snap
+            tracked.preempted += 1
+            self._preemptions += 1
+            self.metrics.record_preemption()
+            self.pool = state_cache.evict(self.pool, slot)
+            del self._slots[slot]
+            self._free.append(slot)
+            self._free.sort()
+            self.scheduler.requeue(tracked)
+
+    def _resume(self, tracked: _Tracked) -> bool:
+        """Re-admit a preempted request: restore its host snapshot into
+        a free slot — the same data shard for hybrids, where its pages
+        live — with ``step`` preserved.  Returns False (requeued) when
+        no compatible slot is free yet."""
+        snap = tracked.snapshot
+        if self.hybrid:
+            slot = next((s for s in self._free
+                         if self._slot_shard(s) == snap["shard"]), None)
+        else:
+            slot = self._free[0] if self._free else None
+        if slot is None:
+            self.scheduler.requeue(tracked)
+            return False
+        self._free.remove(slot)
+        r = tracked.request
+        try:
+            with self.tracer.span("serving_resume", slot=slot,
+                                  request=tracked.request_id,
+                                  trace=tracked.trace_id):
+                self.pool = state_cache.restore(
+                    self.pool, slot,
+                    {"blocks": jax.tree.map(jnp.asarray, snap["blocks"])},
+                    jnp.asarray(snap["logits"]), r.resolve_key(),
+                    snap["step"], r.max_new_tokens, r.top_k,
+                    r.temperature, -1 if r.eos_id is None else r.eos_id,
+                )
+                if self.hybrid:
+                    self._page_tbl[slot] = 0
+                    self._page_tbl[slot, :len(tracked.pages)] = tracked.pages
+                    self._kv_len[slot] = snap["kv_len"]
+        except Exception:
+            # slot back, request back — the snapshot survives requeue,
+            # so a retry restores instead of re-prefilling (a re-prefill
+            # would replay tokens the consumer already has)
+            self._free.insert(0, slot)
+            self._free.sort()
+            self.scheduler.requeue(tracked)
+            raise
+        tracked.snapshot = None
+        tracked.slot = slot
+        tracked.status = RequestStatus.DECODE
+        self._slots[slot] = tracked
+        return True
 
     # chunk grants a slot can be passed over in a row before it outranks
     # SRPT's shortest-remaining rule (the starvation guard)
@@ -782,19 +1205,49 @@ class ServingEngine:
         drain (FCFS head-of-line blocking on TTFT).  At least one
         chunk runs per step even when the budget is smaller than a
         chunk, so progress is guaranteed.
+
+        Priority pressure valve: when the queue's best request outranks
+        a resident decoding slot and no slot is free, the lowest-
+        priority victim is PREEMPTED (carry swapped to host, slot
+        freed, resumed later without re-prefill) so the high-priority
+        request admits this step instead of queueing behind it.
         Returns (host seconds spent — the tick's ``prefill_stall`` —
         and chunk tokens dispatched)."""
-        if not ((self._free and self.scheduler.depth) or self._prefill_queue):
+        # one victim scan serves both the gate and the loop's first
+        # iteration (peek + slot scan per engine step adds up)
+        next_victim = (self._pick_victim()
+                       if self.scheduler.depth and not self._free else None)
+        if not ((self._free and self.scheduler.depth) or self._prefill_queue
+                or next_victim is not None):
             return 0.0, 0
         t0 = time.perf_counter()
         chunk_tokens0 = self.metrics.prefill_chunk_tokens
         chunk_s0 = self.metrics.prefill_chunk_time_s
-        if self._free and self.scheduler.depth:
+        if self.scheduler.depth and (self._free or next_victim is not None):
             with self.tracer.span("serving_admit",
                                   queued=self.scheduler.depth):
-                while self._free and self.scheduler.depth:
+                while self.scheduler.depth:
+                    if not self._free:
+                        victim = next_victim or self._pick_victim()
+                        next_victim = None
+                        if victim is None:
+                            break
+                        self._preempt(victim)
                     if not self._admit(self.scheduler.pop()):
-                        break  # hybrid: waiting for KV pages
+                        # the head stalled on KV pages or a shard-pinned
+                        # slot.  A suitable victim may still unblock it
+                        # — a free slot in the WRONG shard suppressed
+                        # the gate above (_victim_slot_admits guarantees
+                        # the retry admits) — else resume parked
+                        # preempted requests: their swap-ins need no
+                        # pages and eventually release the pages the
+                        # head is waiting on.
+                        victim = self._pick_victim()
+                        if victim is not None:
+                            self._preempt(victim)
+                            continue
+                        self._resume_parked()
+                        break
         budget = self.prefill_tokens_per_tick
         left = float("inf") if budget == 0 else float(budget)
         chunks_run = 0
@@ -908,6 +1361,13 @@ class ServingEngine:
             if tracked.t_first_token is None:
                 tracked.t_first_token = t_now
                 self.metrics.record_ttft(t_now - tracked.t_submit)
+                if self.prefix_cache is not None:
+                    # TTFT split hit-vs-miss: the cache's whole point is
+                    # this delta (summary()["prefix_cache"])
+                    self.metrics.record_prefix_ttft(
+                        t_now - tracked.t_submit,
+                        hit=tracked.cache_hit is not None,
+                    )
                 gaps, t_prev = m - 1, t0
             else:
                 gaps, t_prev = m, tracked.t_last_token
@@ -936,6 +1396,14 @@ class ServingEngine:
                 "e2e_ms": round((t_now - tracked.t_submit) * 1000, 3),
                 "itl_hist": tracked.itl_hist.to_dict(),
             }
+            # cache/priority stamps only when the features are live, so
+            # records from plain engines stay byte-stable
+            if self.prefix_cache is not None:
+                request_record["prefix_hit"] = tracked.cache_hit
+            if tracked.preempted:
+                request_record["preemptions"] = tracked.preempted
+            if tracked.priority != self.scheduler.default_priority:
+                request_record["priority"] = tracked.priority
             self.metrics.record_request(request_record)
             if self.slo is not None:
                 self.slo.observe_request(request_record,
@@ -961,6 +1429,20 @@ class ServingEngine:
             )
             self._page_allocs = 0
             self._page_frees = 0
+        pc_gauges = {}
+        if self.prefix_cache is not None:
+            # hit/miss/bytes gauges ride the serving_tick record (host-
+            # side only; absent entirely on cache-off engines)
+            pc_gauges = dict(
+                prefix_hits=self._pc_hits,
+                prefix_misses=self._pc_misses,
+                prefix_saved_tokens=self._pc_saved_tokens,
+                prefix_cache_entries=len(self.prefix_cache),
+                prefix_cache_bytes=self.prefix_cache.nbytes,
+            )
+            self._pc_hits = 0
+            self._pc_misses = 0
+            self._pc_saved_tokens = 0
         self.metrics.record_tick(
             occupied=occupied, queue_depth=self.scheduler.depth,
             tokens_emitted=len(events), dt_s=dt,
@@ -974,8 +1456,11 @@ class ServingEngine:
             traces=live_traces,
             model_shards=(self.model_shards if self.model_shards > 1
                           else None),
+            preemptions=self._preemptions,
+            **pc_gauges,
             **kv_gauges,
         )
+        self._preemptions = 0
         self._pending_stall_ms = 0.0
         self._pending_chunk_tokens = 0
         self._pending_chunk_real_tokens = 0

@@ -174,6 +174,7 @@ def prefill_chunk(
 def chunked_prefill(
     params: dict, cfg: ModelConfig, prompt_ids,
     plan: ChunkPlan | None = None, max_len: int = 0, mesh=None,
+    prefix_cache=None,
 ):
     """Drive a whole prompt through the chunk step (the solo-`generate()`
     driver; the serving engine paces the same loop itself, against its
@@ -190,6 +191,18 @@ def chunked_prefill(
     chunk call — pass the serving engine's mesh to reproduce its chunk
     computation bit-for-bit.  Returns (last_logits (b, V) fp32, state),
     the ``lm_prefill`` contract, ready for the decode loop.
+
+    ``prefix_cache`` (a serving/prefix_cache.PrefixCache; batch-1
+    PURE-SSM prompts only — hybrid entries pin a serving engine's page
+    pool and are unusable here) reuses and refreshes carry snapshots:
+    a full hit returns the cached (logits, state) with zero chunk
+    calls, a partial hit seeds the deepest cached boundary carry (a
+    COPY — the chunk step donates its state argument, and a donated
+    cache entry would be destroyed), and completed chunks store their
+    boundaries back.  Cached carries are the literal outputs of this
+    exact layout's chunk steps, so warm results are bit-identical to
+    cold ones — and to a cache-enabled serving engine's, which shares
+    both the layout and the key scheme (tests/test_prefix_cache.py).
     """
     prompt = np.asarray(prompt_ids, np.int32)
     if prompt.ndim == 1:
@@ -226,9 +239,33 @@ def chunked_prefill(
                               max_len=pages * cfg.kv_page_tokens)
     else:
         state = init_lm_state(cfg, batch=b)
+    use_cache = prefix_cache is not None and not hybrid and b == 1
+    start = 0
+    if use_cache:
+        hit = prefix_cache.lookup(prompt[0], plan)
+        if hit is not None:
+            entry, start = hit
+            if start == plan.n_chunks:
+                # full hit: the snapshot IS this layout's prefill output
+                return entry.logits, {"blocks": entry.state["blocks"]}
+            # seed a COPY: prefill_chunk donates its state argument, and
+            # donating the cached arrays would destroy the entry
+            state = {"blocks": jax.tree.map(jnp.copy, entry.state["blocks"])}
     logits = None
-    for i in range(plan.n_chunks):
+    for i in range(start, plan.n_chunks):
         ids, mask = chunk_inputs(prompt, plan, i)
         logits, state = prefill_chunk(dparams, ids, mask, state, cfg=cfg,
                                       mesh=mesh)
+        if use_cache:
+            # the output carry feeds the NEXT chunk's donation — store a
+            # copy (tiny: the O(1) conv+SSM carry) ... except the last,
+            # which nothing donates again
+            keep = (state["blocks"] if i == plan.n_chunks - 1
+                    else jax.tree.map(jnp.copy, state["blocks"]))
+            prefix_cache.maybe_store_boundary(
+                prompt[0], plan, i, {"blocks": keep})
+            if i == plan.n_chunks - 1:
+                prefix_cache.maybe_store_full(
+                    prompt[0], {"blocks": keep}, logits,
+                    chunk=plan.chunk, chunks=plan.n_chunks)
     return logits, state

@@ -93,11 +93,18 @@ class EngineReplica:
         resident work per slot, plus KV page-pool pressure for hybrid
         engines — a replica whose pages are nearly gone would make a
         new hybrid request WAIT at admission even with slots free, so
-        free pages weigh in next to queue depth."""
+        free pages weigh in next to queue depth.  Prefix-cache
+        AFFINITY discounts a replica whose cache already holds this
+        prompt's prefix (engine.prefix_hit_fraction, a pure probe):
+        skipping a preamble's prefill is worth more than an idle cold
+        replica, so shared-prefix traffic converges on warm caches
+        instead of spraying cold prefills across the fabric."""
         eng = self.engine
         load = (eng.scheduler.depth + len(eng._slots)) / eng.capacity
         if eng.hybrid:
             load += eng.page_pool.pages_in_use / eng.page_pool.num_pages
+        if request is not None and eng.prefix_cache is not None:
+            load -= eng.prefix_hit_fraction(request.prompt_ids)
         return load
 
     def submit(self, request) -> int:

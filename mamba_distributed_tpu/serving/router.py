@@ -12,8 +12,10 @@ TPUv4").
 
 Placement is LEAST-LOADED: each submit picks the accepting replica
 with the lowest ``place_cost`` (queued + resident work per slot, plus
-KV page-pool pressure for hybrids), stamped as a ``serving_route``
-span.  ``drain(replica_id)`` retires a replica gracefully — no new
+KV page-pool pressure for hybrids, minus prefix-cache AFFINITY — the
+fraction of the prompt a replica's prefix cache could skip, so
+shared-preamble traffic converges on warm caches;
+serving/prefix_cache.py), stamped as a ``serving_route`` span.  ``drain(replica_id)`` retires a replica gracefully — no new
 placements, in-flight requests finish.  ``fail(replica_id)`` is
 failover: the dead replica's unfinished requests REQUEUE onto the
 survivors.

@@ -59,6 +59,13 @@ class GenerationRequest:
     # one flow chain in the exported timeline, however many replicas
     # it visited.
     trace_id: str | None = None
+    # priority class (higher = more important; None takes
+    # cfg.serving_default_priority).  Admission pops the highest
+    # priority first (FCFS within a class), and the engine PREEMPTS a
+    # lower-priority decoding slot — carry swapped to host RAM,
+    # resumed later without re-prefill — when a higher-priority
+    # request is stuck queued with no free slot (serving/engine.py).
+    priority: int | None = None
 
     def resolve_key(self) -> jax.Array:
         key = self.key if self.key is not None else jax.random.PRNGKey(self.seed)
@@ -126,21 +133,44 @@ class _Tracked:
     plan: object | None = None
     chunks_done: int = 0
     prefill_dt: float = 0.0
+    # real prompt tokens a partial prefix-cache hit seeded (skipped
+    # chunks) — record_prefill at completion reports only the COMPUTED
+    # tokens, so prefill throughput never double-counts what
+    # prefix_saved_tokens already claims was skipped
+    prefill_seeded_tokens: int = 0
     # consecutive chunk grants this slot was passed over for (the SRPT
     # starvation guard, serving/engine._pick_prefill_slot)
     prefill_skipped: int = 0
-    # hybrid paged KV: physical page ids reserved for this request at
-    # admission (prompt + max_new worth), recycled on evict/failure
-    # (serving/engine.py page allocator)
+    # hybrid paged KV: physical page ids this request holds a ref on
+    # (reserved at admission, or shared from a cached prefix and
+    # incref'd), decref'd on evict/failure (serving/engine.py page
+    # allocator; state_cache.PagePool refcounts)
     pages: list | None = None
+    # resolved priority class (request.priority, else the scheduler's
+    # default) — admission order + preemption rank
+    priority: int = 0
+    # preemption swap-out state (serving/engine._preempt): host copies
+    # of the slot's carry/logits + the generated-token count, so
+    # re-admission restores mid-decode without re-prefill.  Survives
+    # requeue — clearing it would silently re-prefill and REPLAY
+    # already-delivered tokens.
+    snapshot: dict | None = None
+    preempted: int = 0  # times this request was swapped out
+    # prefix-cache outcome at admission: "full" | "partial" | None
+    # (miss / cache off) — stamps the request record + TTFT split
+    cache_hit: str | None = None
 
 
 class FCFSScheduler:
-    """First-come-first-served admission queue."""
+    """First-come-first-served admission queue with priority classes:
+    ``pop``/``peek`` take the highest-priority entry, FCFS within a
+    class — with every request at the default priority this is exactly
+    the arrival-order deque it always was."""
 
-    def __init__(self) -> None:
+    def __init__(self, default_priority: int = 0) -> None:
         self._queue: deque[_Tracked] = deque()
         self._next_id = 0
+        self.default_priority = default_priority
 
     def submit(self, request: GenerationRequest) -> _Tracked:
         prompt = np.asarray(request.prompt_ids, np.int32).reshape(-1)
@@ -159,25 +189,65 @@ class FCFSScheduler:
         # failover re-placement is the same request's journey)
         tracked = _Tracked(request=request, request_id=self._next_id,
                            trace_id=request.trace_id or mint_trace_id(),
+                           priority=(self.default_priority
+                                     if request.priority is None
+                                     else request.priority),
                            t_submit=time.perf_counter())
         self._next_id += 1
         request.request_id = tracked.request_id  # convenience echo
         self._queue.append(tracked)
         return tracked
 
+    def _best(self) -> int | None:
+        """Index of the next request to admit: highest priority,
+        earliest arrival (queue position) within a class."""
+        if not self._queue:
+            return None
+        return max(range(len(self._queue)),
+                   key=lambda i: (self._queue[i].priority, -i))
+
     def pop(self) -> _Tracked | None:
-        """Next request to admit (arrival order), or None when empty."""
-        return self._queue.popleft() if self._queue else None
+        """Next request to admit (priority, then arrival order), or
+        None when empty."""
+        i = self._best()
+        if i is None:
+            return None
+        tracked = self._queue[i]
+        del self._queue[i]
+        return tracked
+
+    def peek(self) -> _Tracked | None:
+        """What ``pop`` would return, without removing it (the engine's
+        preemption check reads the queue's best priority)."""
+        i = self._best()
+        return None if i is None else self._queue[i]
+
+    def pop_preempted(self) -> _Tracked | None:
+        """Next queued PREEMPTED request (one holding a resume
+        snapshot), or None.  The engine resumes these even when the
+        queue's best request is stalled on KV pages: a swap-in needs no
+        pages, and running it is the only way the pages it pins ever
+        release (serving/engine._resume_parked)."""
+        for i, t in enumerate(self._queue):
+            if t.snapshot is not None:
+                del self._queue[i]
+                return t
+        return None
 
     def requeue(self, tracked: _Tracked) -> None:
         """Put a popped-but-not-admitted request back at the queue head
-        (a failed prefill must not drop it).  Chunked-prefill progress is
-        reset — the retry restarts from chunk 0 with a fresh carry."""
+        (a failed prefill must not drop it; a preempted request resumes
+        ahead of its class — it arrived first).  Chunked-prefill
+        progress is reset — a prefill retry restarts from chunk 0 with
+        a fresh carry — but a preemption ``snapshot`` survives: the
+        resume path must restore it, never re-prefill (a re-prefill
+        would replay tokens the consumer already has)."""
         tracked.status = RequestStatus.QUEUED
         tracked.slot = None
         tracked.plan = None
         tracked.chunks_done = 0
         tracked.prefill_dt = 0.0
+        tracked.prefill_seeded_tokens = 0
         tracked.prefill_skipped = 0
         self._queue.appendleft(tracked)
 

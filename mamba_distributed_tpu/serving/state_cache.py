@@ -106,11 +106,28 @@ def page_shard_ranges(
     return [(max(1, d * per), (d + 1) * per) for d in range(num_shards)]
 
 
+class PagePoolError(RuntimeError):
+    """A page-accounting violation: double free, freeing the trash
+    page, or touching a page id outside the pool.  These are always
+    caller bugs (the allocator's invariants make them impossible on the
+    engine's own paths), so they raise loudly instead of silently
+    corrupting the free lists."""
+
+
 class PagePool:
     """Host-side KV page allocator (hybrid pools): free lists over
     physical pages [1, P) — page 0 is the trash page and never handed
     out.  Purely bookkeeping; the page *arrays* live in the pool pytree
     and are written by the compiled chunk/tick steps.
+
+    Pages are REFCOUNTED: ``alloc`` hands out pages at refcount 1,
+    ``incref`` lets another holder (a prefix-cache entry, a slot
+    sharing a cached prefix copy-on-write — serving/prefix_cache.py)
+    pin the same physical page, and ``free`` decrements — a page
+    returns to the free list only when its last holder lets go.  This
+    is what lets N slots serve one cached system-prompt's KV from one
+    set of physical pages.  ``free`` rejects double-frees and the
+    trash page with a named ``PagePoolError``.
 
     With ``num_shards > 1`` (the mesh-sharded slot pool), the usable
     pages partition into per-shard free lists along the SAME contiguous
@@ -132,6 +149,7 @@ class PagePool:
         self.num_shards = num_shards
         self._ranges = page_shard_ranges(num_pages, num_shards)
         self._free_lists = [list(range(lo, hi)) for lo, hi in self._ranges]
+        self._refs: dict[int, int] = {}  # allocated page -> holder count
 
     @property
     def _free(self) -> list[int]:
@@ -165,7 +183,7 @@ class PagePool:
     def alloc(self, n: int, shard: int = 0) -> list[int]:
         """Reserve ``n`` pages from ``shard``'s range, or raise if it
         can't cover them (callers check ``free_pages_in`` first —
-        admission just waits)."""
+        admission just waits).  Pages come back at refcount 1."""
         lst = self._free_lists[shard]
         if n > len(lst):
             raise RuntimeError(
@@ -173,14 +191,59 @@ class PagePool:
                 f"{len(lst)}"
             )
         ids, self._free_lists[shard] = lst[:n], lst[n:]
+        for p in ids:
+            self._refs[p] = 1
         return ids
 
+    def incref(self, ids: list[int]) -> None:
+        """Add one holder to each page (prefix-cache entries pinning a
+        cached prefix's KV; a slot admitted onto shared pages).  Only
+        allocated pages can gain holders."""
+        for p in ids:
+            if self._refs.get(p, 0) <= 0:
+                raise PagePoolError(
+                    f"incref of page {p}, which is not allocated — only a "
+                    f"live page can gain a holder"
+                )
+        for p in ids:
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        """Current holder count (0 = free / never allocated)."""
+        return self._refs.get(page, 0)
+
     def free(self, ids: list[int]) -> None:
+        """Drop one holder per page; a page returns to its shard's free
+        list only at refcount 0 (eviction decrefs, never yanks a page a
+        prefix-cache entry or a sharing slot still reads).  Raises
+        ``PagePoolError`` on the trash page, on ids outside the pool,
+        and on double-frees (including a duplicate id inside one batch)
+        — all caller bugs."""
         touched = set()
         for p in ids:
-            d = self._owner(p)
-            self._free_lists[d].append(p)
-            touched.add(d)
+            if p == 0:
+                raise PagePoolError(
+                    "page 0 is the trash page — it is never allocated and "
+                    "must never be freed (masked writes depend on it)"
+                )
+            if not 1 <= p <= self.num_pages:
+                raise PagePoolError(
+                    f"page {p} is outside the pool's [1, {self.num_pages}] "
+                    f"physical range"
+                )
+            rc = self._refs.get(p, 0)
+            if rc <= 0:
+                raise PagePoolError(
+                    f"double free of page {p}: it has no holders (already "
+                    f"on the free list or never allocated)"
+                )
+            if rc == 1:
+                del self._refs[p]
+                d = self._owner(p)
+                self._free_lists[d].append(p)
+                touched.add(d)
+            else:
+                self._refs[p] = rc - 1
         for d in touched:
             self._free_lists[d].sort()  # deterministic reuse order
 
@@ -290,6 +353,61 @@ def insert(
         "logits": _set_row(pool["logits"], slot, logits),
         "meta": new_meta,
     }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def restore(
+    pool: dict,
+    slot: jax.Array,
+    state: dict,
+    logits: jax.Array,
+    key: jax.Array,
+    step: jax.Array,
+    max_new: jax.Array,
+    top_k: jax.Array,
+    temperature: jax.Array,
+    eos_id: jax.Array,
+) -> dict:
+    """Re-admit a PREEMPTED request mid-decode: identical to ``insert``
+    except the generated-token counter is restored instead of zeroed,
+    so the next tick samples ``fold_in(key, step)`` — the stream
+    continues bit-exactly where the swap-out cut it (the engine's
+    priority-preemption path, serving/engine.py)."""
+    new_state = _write_blocks(pool["state"], slot, state)
+    meta = pool["meta"]
+    new_meta = {
+        "active": _set_row(meta["active"], slot, True),
+        "done": _set_row(meta["done"], slot, False),
+        "prefilling": _set_row(meta["prefilling"], slot, False),
+        "key": _set_row(meta["key"], slot, key),
+        "step": _set_row(meta["step"], slot, step),
+        "max_new": _set_row(meta["max_new"], slot, max_new),
+        "top_k": _set_row(meta["top_k"], slot, top_k),
+        "temperature": _set_row(meta["temperature"], slot, temperature),
+        "eos_id": _set_row(meta["eos_id"], slot, eos_id),
+    }
+    return {
+        "state": new_state,
+        "logits": _set_row(pool["logits"], slot, logits),
+        "meta": new_meta,
+    }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def copy_page(attn_blocks, src: jax.Array, dst: jax.Array):
+    """Copy-on-write page duplication: copy physical page ``src`` into
+    ``dst`` across every attention layer's K and V pool (the page axis
+    is axis 1 of the (A, P+1, nkv, page, hd) leaves), in place on the
+    donated buffers.  The prefix cache uses it so a slot that APPENDS
+    to a shared cached prefix writes into its own copy of the boundary
+    page — sharers keep reading the frozen original.  One trace serves
+    every (src, dst) pair (both indices are traced scalars)."""
+
+    def cp(p):
+        page = jax.lax.dynamic_slice_in_dim(p, src, 1, axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(p, page, dst, axis=1)
+
+    return jax.tree.map(cp, attn_blocks)
 
 
 def _write_blocks(pool_state, slot: jax.Array, state):

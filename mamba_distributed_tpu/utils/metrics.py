@@ -183,6 +183,19 @@ class ServingMetrics:
         self.queue_wait_ms = StreamingHistogram()
         self.ttft_ms = StreamingHistogram()
         self.itl_ms = StreamingHistogram()
+        # prefix-state cache (serving/prefix_cache.py): the engine calls
+        # configure_prefix_cache() when the cache is on, unlocking the
+        # summary()["prefix_cache"] section — hit-rate, saved prefill
+        # tokens, and the TTFT split hit-vs-miss (the cache's headline)
+        self._prefix_cache_on = False
+        self.prefix_full_hits = 0
+        self.prefix_partial_hits = 0
+        self.prefix_misses = 0
+        self.prefix_saved_tokens = 0
+        self.prefix_ttft_hit_ms = StreamingHistogram()
+        self.prefix_ttft_miss_ms = StreamingHistogram()
+        # priority preemptions (serving/engine.py swap-out/resume)
+        self.preemptions = 0
         # same deferred-truncation contract as MetricsLogger/SpanTracer:
         # a reused path starts fresh on the first write unless
         # preserve_history() ran, so two runs can never interleave
@@ -234,6 +247,37 @@ class ServingMetrics:
         self.prefill_stall_s += dt_s
         self.prefill_stall_ms.record(dt_s * 1000)
 
+    # -------------------------------------------- prefix cache + preemption
+
+    def configure_prefix_cache(self) -> None:
+        """Mark the prefix-state cache live (engine construction):
+        ``summary()`` gains its ``prefix_cache`` section."""
+        self._prefix_cache_on = True
+
+    def record_prefix_lookup(self, kind: str | None,
+                             saved_tokens: int = 0) -> None:
+        """One admission-time cache lookup: ``kind`` is "full" (prefill
+        skipped outright), "partial" (seeded at a chunk boundary) or
+        None (miss); ``saved_tokens`` the prompt tokens the hit's
+        snapshot covers — prefill work NOT recomputed."""
+        if kind == "full":
+            self.prefix_full_hits += 1
+        elif kind == "partial":
+            self.prefix_partial_hits += 1
+        else:
+            self.prefix_misses += 1
+        self.prefix_saved_tokens += saved_tokens
+
+    def record_prefix_ttft(self, dt_s: float, hit: bool) -> None:
+        """TTFT of a finished-prefill request, split by cache outcome —
+        the delta between the two histograms is what the cache buys."""
+        (self.prefix_ttft_hit_ms if hit
+         else self.prefix_ttft_miss_ms).record(dt_s * 1000)
+
+    def record_preemption(self) -> None:
+        """One priority swap-out (serving/engine._preempt)."""
+        self.preemptions += 1
+
     # ------------------------------------------------- per-request latency
 
     def record_queue_wait(self, dt_s: float) -> None:
@@ -269,6 +313,12 @@ class ServingMetrics:
         slot_lanes: int = 0,
         traces: list | None = None,
         model_shards: int | None = None,
+        preemptions: int = 0,
+        prefix_hits: int | None = None,
+        prefix_misses: int | None = None,
+        prefix_saved_tokens: int | None = None,
+        prefix_cache_entries: int | None = None,
+        prefix_cache_bytes: int | None = None,
         kv_pages_used: int | None = None,
         kv_pages_capacity: int | None = None,
         kv_page_allocs: int = 0, kv_page_frees: int = 0,
@@ -300,6 +350,13 @@ class ServingMetrics:
         stamps the mesh's model-axis width on the record so per-tick
         rates are attributable to their weight layout; None (the
         replicated default) leaves the record unchanged.
+        ``prefix_hits``/``prefix_misses``/``prefix_saved_tokens`` are
+        the prefix-state cache's window counters and
+        ``prefix_cache_entries``/``prefix_cache_bytes`` its occupancy
+        gauges — stamped only by cache-enabled engines (None leaves
+        the record byte-stable), all host-side.  ``preemptions``
+        counts priority swap-outs in the window (stamped only when
+        nonzero).
         ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
         engines) gauge the page pool at this tick, with
         ``kv_page_allocs``/``kv_page_frees`` the allocator churn in the
@@ -348,6 +405,16 @@ class ServingMetrics:
             record["traces"] = list(traces)
         if model_shards is not None:
             record["model_shards"] = model_shards
+        if preemptions:
+            record["preemptions"] = preemptions
+        if prefix_hits is not None:
+            record.update({
+                "prefix_hits": prefix_hits,
+                "prefix_misses": prefix_misses,
+                "prefix_saved_tokens": prefix_saved_tokens,
+                "prefix_cache_entries": prefix_cache_entries,
+                "prefix_cache_bytes": prefix_cache_bytes,
+            })
         if kv_pages_used is not None:
             self.kv_pages_used = kv_pages_used
             self.kv_pages_capacity = kv_pages_capacity
@@ -401,6 +468,22 @@ class ServingMetrics:
             "prefill_stall_s": round(self.prefill_stall_s, 4),
             "prefill_stall_ms": self.prefill_stall_ms.summary(),
             "finished_requests": self.finished_requests,
+            "preemptions": self.preemptions,
+            "prefix_cache": (None if not self._prefix_cache_on else {
+                "full_hits": self.prefix_full_hits,
+                "partial_hits": self.prefix_partial_hits,
+                "misses": self.prefix_misses,
+                "hit_rate": (
+                    round((self.prefix_full_hits + self.prefix_partial_hits)
+                          / (self.prefix_full_hits + self.prefix_partial_hits
+                             + self.prefix_misses), 4)
+                    if (self.prefix_full_hits + self.prefix_partial_hits
+                        + self.prefix_misses) else None
+                ),
+                "saved_prefill_tokens": self.prefix_saved_tokens,
+                "ttft_hit_ms": self.prefix_ttft_hit_ms.summary(),
+                "ttft_miss_ms": self.prefix_ttft_miss_ms.summary(),
+            }),
             "goodput": {
                 "useful_tokens": self.useful_tokens,
                 "wasted_token_lanes": max(

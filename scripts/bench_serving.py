@@ -46,6 +46,15 @@ the default mode it also times a replicated-weights engine on the
 identical workload and reports ``tp_vs_replicated_speedup`` — the
 BENCH_SERVING.json ``tp_vs_replicated`` row.
 
+``--shared-prefix`` is the prefix-cache headline (serving/
+prefix_cache.py): SERVE_REQUESTS requests sharing a long preamble
+(SERVE_SHARED_PREFIX_LEN, default 4 chunks) with distinct same-length
+suffixes (SERVE_SUFFIX_LEN=16) run cache-OFF and cache-WARM; the record
+reports TTFT p95 for both, the warm/off speedup (full hits skip prefill
+outright), and the partial-hit TTFT of never-seen suffixes — the
+BENCH_SERVING.json ``shared_prefix_cpu`` row, gated via
+``scripts/bench_gate.py --case shared_prefix_cpu``.
+
 ``--long-prompt`` switches to the head-of-line-blocking workload: a few
 LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
 submitted AHEAD of the usual short mix, and the same workload runs
@@ -94,6 +103,30 @@ def _workload(rng, n, pmin, pmax, max_new, vocab):
     return reqs
 
 
+def _capture_metrics(capacity, jsonl_path=None):
+    """A ServingMetrics that also keeps request records host-side so a
+    bench can split latency by request class (deferred import: the
+    bench picks its backend before anything jax-heavy loads)."""
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    class _CaptureMetrics(ServingMetrics):
+        def __init__(self, capacity, jsonl_path=None):
+            super().__init__(capacity, jsonl_path=jsonl_path)
+            self.request_records = []
+
+        def record_request(self, record):
+            super().record_request(record)
+            self.request_records.append(record)
+
+    return _CaptureMetrics(capacity, jsonl_path=jsonl_path)
+
+
+def _p95(xs):
+    import numpy as np
+
+    return round(float(np.percentile(xs, 95)), 3) if xs else None
+
+
 def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
                        budget, short_max_len, jsonl):
     """Run the mixed long+short workload once per prefill mode; return
@@ -104,22 +137,8 @@ def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
     import numpy as np
 
     from mamba_distributed_tpu.serving import GenerationRequest, ServingEngine
-    from mamba_distributed_tpu.utils.metrics import ServingMetrics
 
-    class _CaptureMetrics(ServingMetrics):
-        """ServingMetrics that also keeps request records on the host so
-        the bench can split TTFT by prompt length."""
-
-        def __init__(self, capacity, jsonl_path=None):
-            super().__init__(capacity, jsonl_path=jsonl_path)
-            self.request_records = []
-
-        def record_request(self, record):
-            super().record_request(record)
-            self.request_records.append(record)
-
-    def p95(xs):
-        return round(float(np.percentile(xs, 95)), 3) if xs else None
+    p95 = _p95
 
     out = {}
     summary = None
@@ -137,7 +156,7 @@ def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
             kw["prefill_tokens_per_tick"] = budget
         ServingEngine(params, mode_cfg, **kw).run(reqs)  # warm: compile
         _progress(f"{mode}: warm")
-        metrics = _CaptureMetrics(
+        metrics = _capture_metrics(
             capacity, jsonl_path=jsonl if mode == "chunked" else None
         )
         engine = ServingEngine(params, mode_cfg, metrics=metrics, **kw)
@@ -159,6 +178,95 @@ def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
     return out, summary
 
 
+def _shared_prefix_bench(cfg, params, capacity, tokens_per_tick, n_requests,
+                         prefix_len, suffix_len, max_new, rng, jsonl):
+    """The prefix-cache headline: N requests sharing a long preamble
+    (distinct same-length suffixes), served cache-OFF vs cache-WARM.
+
+    Warm = the same engine already served the identical prompt set
+    once, so every timed request is a FULL hit (prefill skipped
+    outright — the near-zero-TTFT path); a few never-seen suffixes
+    ride along to measure PARTIAL hits (the shared preamble's chunk
+    boundaries are cached, only the suffix chunk runs).  Returns
+    (record fields, the warm run's metrics summary)."""
+    import dataclasses as _dc
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.serving import GenerationRequest, ServingEngine
+
+    preamble = rng.integers(0, cfg.vocab_size, size=prefix_len).astype(
+        np.int32)
+
+    def _suffix(seed):
+        return np.random.default_rng(seed).integers(
+            0, cfg.vocab_size, size=suffix_len).astype(np.int32)
+
+    prompts = [np.concatenate([preamble, _suffix(7000 + i)])
+               for i in range(n_requests)]
+
+    def reqs(prompt_list, seed0):
+        # fresh request objects per submit (ids/streams are per-submit)
+        return [GenerationRequest(prompt_ids=np.asarray(p),
+                                  max_new_tokens=max_new, seed=seed0 + i)
+                for i, p in enumerate(prompt_list)]
+
+    kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+    out = {}
+
+    # --- cache OFF: the baseline every request pays full prefill on
+    off_cfg = _dc.replace(cfg, prefix_cache_entries=0)
+    ServingEngine(params, off_cfg, **kw).run(reqs(prompts, 1000))  # jit warm
+    _progress("cache-off: warm")
+    m_off = _capture_metrics(capacity)
+    t0 = _time.perf_counter()
+    ServingEngine(params, off_cfg, metrics=m_off, **kw).run(
+        reqs(prompts, 1000))
+    out["wall_s_off"] = round(_time.perf_counter() - t0, 3)
+    out["ttft_p95_ms_off"] = _p95(
+        [r["ttft_ms"] for r in m_off.request_records])
+    _progress(f"cache-off: TTFT p95 {out['ttft_p95_ms_off']} ms")
+
+    # --- cache WARM: ONE engine (hybrid caches are engine-private —
+    # entries pin its page pool), populate run then timed run.  The
+    # timed run gets its own metrics object so its records are clean;
+    # the swap re-marks the cache flag (goodput rates stay on the
+    # populate-run metrics — this mode reports latency, not MFU).
+    warm_cfg = _dc.replace(cfg, prefix_cache_entries=1024)
+    engine = ServingEngine(params, warm_cfg, **kw)
+    engine.run(reqs(prompts, 1000))  # populates the cache + jit
+    # one full-hit admission off the clock: chunked COLD admissions
+    # never call state_cache.insert (they stash/finish), so the first
+    # hit would otherwise pay its one-time jit compile on the clock
+    engine.run(reqs(prompts[:1], 5000))
+    _progress(f"cache populated: {len(engine.prefix_cache)} entries, "
+              f"{engine.prefix_cache.nbytes} bytes")
+    n_fresh = max(1, n_requests // 4)
+    fresh_prompts = [np.concatenate([preamble, _suffix(9000 + i)])
+                     for i in range(n_fresh)]
+    m_warm = _capture_metrics(capacity, jsonl_path=jsonl)
+    m_warm.configure_prefix_cache()
+    engine.metrics = m_warm
+    t0 = _time.perf_counter()
+    engine.run(reqs(prompts, 1000) + reqs(fresh_prompts, 2000))
+    out["wall_s_warm"] = round(_time.perf_counter() - t0, 3)
+    full = [r["ttft_ms"] for r in m_warm.request_records
+            if r.get("prefix_hit") == "full"]
+    partial = [r["ttft_ms"] for r in m_warm.request_records
+               if r.get("prefix_hit") == "partial"]
+    out["ttft_p95_ms_warm"] = _p95(full)
+    out["ttft_p95_ms_partial"] = _p95(partial)
+    out["full_hits"] = len(full)
+    out["partial_hits"] = len(partial)
+    out["fresh_suffix_requests"] = n_fresh
+    a, b = out["ttft_p95_ms_off"], out["ttft_p95_ms_warm"]
+    out["ttft_p95_speedup"] = round(a / b, 2) if a and b else None
+    _progress(f"cache-warm: full-hit TTFT p95 {out['ttft_p95_ms_warm']} ms "
+              f"({out['ttft_p95_speedup']}x vs cache-off)")
+    return out, m_warm.summary()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jsonl", default=None, metavar="PATH",
@@ -170,6 +278,13 @@ def main() -> None:
     ap.add_argument("--long-prompt", action="store_true",
                     help="mixed long+short workload; report short-request "
                          "TTFT p95 with chunked vs one-shot prefill")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="prefix-cache workload: N requests sharing a "
+                         "long preamble (SERVE_SHARED_PREFIX_LEN, default "
+                         "4x the chunk; SERVE_SUFFIX_LEN=16 distinct "
+                         "same-length suffixes); report TTFT p95 with the "
+                         "prefix cache warm vs cache-off — the "
+                         "BENCH_SERVING.json shared_prefix row")
     ap.add_argument("--occupancy", default=None, metavar="F1,F2,...",
                     help="sweep slot-pool fill: for each fraction F run "
                          "the engine-vs-sequential comparison with "
@@ -190,14 +305,16 @@ def main() -> None:
                          "a replicated-weights engine on the identical "
                          "workload and reports tp_vs_replicated_speedup")
     args = ap.parse_args()
-    if args.long_prompt and args.replicas:
-        ap.error("--long-prompt and --replicas are separate bench modes; "
-                 "pick one (the --replicas workload already mixes long "
-                 "and short prompts)")
-    if args.occupancy and (args.long_prompt or args.replicas):
+    modes = [m for m, on in [("--long-prompt", args.long_prompt),
+                             ("--shared-prefix", args.shared_prefix),
+                             ("--replicas", bool(args.replicas))] if on]
+    if len(modes) > 1:
+        ap.error(f"{' and '.join(modes)} are separate bench modes; "
+                 f"pick one")
+    if args.occupancy and modes:
         ap.error("--occupancy sweeps the default engine-vs-sequential "
-                 "mode; it does not combine with --long-prompt or "
-                 "--replicas")
+                 "mode; it does not combine with "
+                 + "/".join(modes))
 
     import jax
     import jax.numpy as jnp
@@ -329,6 +446,47 @@ def main() -> None:
             "prefill_chunks": summary["prefill_chunks"],
             "prefill_stall_ms": summary["prefill_stall_ms"],
             "latency": summary["latency"],
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
+
+    if args.shared_prefix:
+        chunk = cfg.effective_prefill_chunk_tokens
+        if chunk <= 0:
+            raise SystemExit(
+                "--shared-prefix needs chunked prefill (the cache "
+                "snapshots chunk-boundary carries); the preset has "
+                "prefill_chunk_tokens=0"
+            )
+        prefix_len = int(os.environ.get("SERVE_SHARED_PREFIX_LEN",
+                                        str(4 * chunk)))
+        suffix_len = int(os.environ.get("SERVE_SUFFIX_LEN", "16"))
+        if prefix_len < chunk:
+            raise SystemExit(
+                f"SERVE_SHARED_PREFIX_LEN={prefix_len} must cover at "
+                f"least one chunk ({chunk} tokens) or nothing is shared"
+            )
+        fields, summary = _shared_prefix_bench(
+            cfg, params, capacity, tokens_per_tick, n_requests,
+            prefix_len, suffix_len, max_new, rng, args.jsonl,
+        )
+        record = {
+            "metric": (f"serving_shared_prefix_ttft_speedup_"
+                       f"{preset.replace('-', '_')}"),
+            "value": fields["ttft_p95_speedup"],
+            "unit": "x lower TTFT p95, prefix cache warm vs cache-off",
+            **fields,
+            "requests": n_requests,
+            "shared_prefix_len": prefix_len,
+            "suffix_len": suffix_len,
+            "max_new_tokens": max_new,
+            "prefill_chunk_tokens": chunk,
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prefix_cache": summary["prefix_cache"],
             "device": dev.device_kind,
         }
         if args.jsonl:

@@ -165,6 +165,28 @@ def build_report(events: list[dict]) -> dict:
                 "allocs": sum(e.get("kv_page_allocs", 0) for e in kv_ticks),
                 "frees": sum(e.get("kv_page_frees", 0) for e in kv_ticks),
             }
+        # prefix-state cache gauges (absent unless a cache-enabled
+        # engine wrote the stream): window hit/miss/saved-token
+        # counters summed, occupancy gauges from the last record
+        pticks = [e for e in ticks if e.get("prefix_hits") is not None]
+        prefix = None
+        if pticks:
+            p_hits = sum(e["prefix_hits"] for e in pticks)
+            p_misses = sum(e.get("prefix_misses", 0) for e in pticks)
+            prefix = {
+                "hits": p_hits,
+                "misses": p_misses,
+                "hit_rate": (
+                    round(p_hits / (p_hits + p_misses), 4)
+                    if p_hits + p_misses else None
+                ),
+                "saved_prefill_tokens": sum(
+                    e.get("prefix_saved_tokens", 0) for e in pticks
+                ),
+                "entries": pticks[-1].get("prefix_cache_entries"),
+                "bytes": pticks[-1].get("prefix_cache_bytes"),
+            }
+        preemptions = sum(e.get("preemptions", 0) for e in ticks)
         # goodput accounting (absent in pre-goodput streams): useful
         # tokens vs computed token lanes per tick window, plus the
         # host-computed serving MFU (window-weighted mean, so long
@@ -216,6 +238,8 @@ def build_report(events: list[dict]) -> dict:
                 if chunk_tokens and chunk_total_ms else None
             ),
             "goodput": goodput,
+            "prefix_cache": prefix,
+            "preemptions": preemptions,
             "kv_pages": kv_pages,
         }
 
@@ -317,6 +341,17 @@ def build_report(events: list[dict]) -> dict:
             "e2e_ms": _pcts(col("e2e_ms")),
             "itl_ms": itl.summary() if itl is not None else None,
         }
+        # prefix-cache TTFT split: cache-enabled engines stamp each
+        # request record with its admission outcome ("full"/"partial"/
+        # None) — the hit-vs-miss TTFT gap is the cache's headline
+        stamped = [e for e in reqs if "prefix_hit" in e]
+        if stamped:
+            report["requests"]["ttft_hit_ms"] = _pcts(
+                [e["ttft_ms"] for e in stamped
+                 if e["prefix_hit"] and e.get("ttft_ms") is not None])
+            report["requests"]["ttft_miss_ms"] = _pcts(
+                [e["ttft_ms"] for e in stamped
+                 if not e["prefix_hit"] and e.get("ttft_ms") is not None])
 
     # --- SLO attainment (obs/slo.py): the monitor stamps its targets
     # into the stream as an slo_config event, so attainment is
@@ -450,6 +485,18 @@ def format_report(report: dict) -> str:
                 f"serving MFU: "
                 f"{'-' if mfu is None else f'{mfu * 100:.2f}%'}"
             )
+        if s.get("prefix_cache"):
+            pc = s["prefix_cache"]
+            rate = pc["hit_rate"]
+            head += (
+                f"\nprefix cache: {pc['hits']} hits / {pc['misses']} misses"
+                f" ({'-' if rate is None else f'{rate * 100:.1f}%'})   "
+                f"saved prefill tokens: {pc['saved_prefill_tokens']}   "
+                f"entries: {_fmt(pc['entries'])}   "
+                f"bytes: {_fmt(pc['bytes'])}"
+            )
+        if s.get("preemptions"):
+            head += f"\npreemptions: {s['preemptions']}"
         if s.get("kv_pages"):
             kv = s["kv_pages"]
             head += (
@@ -503,6 +550,9 @@ def format_report(report: dict) -> str:
         rows = [_pct_row("queue_wait_ms", r["queue_wait_ms"]),
                 _pct_row("ttft_ms", r["ttft_ms"]),
                 _pct_row("e2e_ms", r["e2e_ms"])]
+        if "ttft_hit_ms" in r:
+            rows.append(_pct_row("ttft_ms (prefix hit)", r["ttft_hit_ms"]))
+            rows.append(_pct_row("ttft_ms (miss)", r["ttft_miss_ms"]))
         if r["itl_ms"] is not None:
             rows.append(_pct_row("itl_ms", r["itl_ms"]))
         out.append(

@@ -134,6 +134,63 @@ def test_bench_serving_long_prompt_smoke(tmp_path):
 
 
 @pytest.mark.serving
+def test_bench_serving_shared_prefix_smoke(tmp_path):
+    """CI smoke for the prefix-cache headline bench: ``--shared-prefix``
+    must run cache-off and cache-warm end-to-end, report the TTFT
+    split (warm full hits / partial hits / off) and the prefix-cache
+    summary, leave a tick stream carrying the hit/miss gauges that
+    obs_report.py renders, and gate against the committed
+    BENCH_SERVING.json ``shared_prefix_cpu`` row (ISSUE 9 satellite)."""
+    import json
+
+    jsonl = str(tmp_path / "sp.jsonl")
+    json_out = str(tmp_path / "sp.json")
+    env = dict(os.environ)
+    # mamba2-tiny has chunk_size=64 -> 64-token chunks are legal; a
+    # 128-token preamble = 2 shared chunks, 8-token suffixes
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="3", SERVE_CAPACITY="2",
+               SERVE_MAX_NEW="4", SERVE_TOKENS_PER_TICK="2",
+               SERVE_SHARED_PREFIX_LEN="128", SERVE_SUFFIX_LEN="8",
+               SERVE_CHUNK_TOKENS="64")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--shared-prefix", "--jsonl", jsonl, "--json", json_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["ttft_p95_ms_off"] is not None
+    assert rec["ttft_p95_ms_warm"] is not None
+    assert rec["full_hits"] == 3  # every seen prompt skipped prefill
+    assert rec["partial_hits"] >= 1  # fresh suffixes seeded the preamble
+    assert rec["prefix_cache"]["misses"] == 0
+    assert rec["shared_prefix_len"] == 128
+    ticks = [json.loads(ln) for ln in open(jsonl)
+             if json.loads(ln).get("kind") == "serving_tick"]
+    assert sum(t.get("prefix_hits", 0) for t in ticks) == rec["full_hits"] \
+        + rec["partial_hits"]
+    # the gauges render through the report tables
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "prefix cache:" in r.stdout
+    assert "ttft_ms (prefix hit)" in r.stdout
+    # the registered gate path: the committed shared_prefix_cpu row
+    # gates this record's speedup (huge band: the smoke's tiny workload
+    # is a different operating point than the committed default run)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "shared_prefix_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "shared_prefix_cpu" in g.stdout
+
+
+@pytest.mark.serving
 def test_bench_gate_smoke(tmp_path, monkeypatch):
     """CI smoke for the bench regression gate (ISSUE 7 satellite): a
     fresh tiny ``bench_serving --json`` run passes against a baseline
@@ -181,12 +238,20 @@ def test_bench_gate_smoke(tmp_path, monkeypatch):
              "--band", "0.1", "--field", "speedup_vs_sequential")
     assert r.returncode == 1, r.stdout + r.stderr
     assert "REGRESSION" in r.stdout
-    # the committed artifact: tiny mamba2 smoke has no baseline row
-    # (only hybrid/router rows) — rc 2 reports "no baseline" distinctly
-    # unless --missing-ok opts into the new-metric path (in-process to
-    # keep the smoke cheap; the CLI surface is exercised above)
+    # the committed artifact: a metric with no baseline row anywhere —
+    # rc 2 reports "no baseline" distinctly unless --missing-ok opts
+    # into the new-metric path (in-process to keep the smoke cheap; the
+    # CLI surface is exercised above)
     monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
     import bench_gate
 
-    assert bench_gate.main([fresh, "--band", "0.99"]) == 2
-    assert bench_gate.main([fresh, "--band", "0.99", "--missing-ok"]) == 0
+    fresh2 = str(tmp_path / "fresh2.json")
+    json.dump(dict(rec, metric="serving_metric_with_no_history_smoke"),
+              open(fresh2, "w"))
+    assert bench_gate.main([fresh2, "--band", "0.99"]) == 2
+    assert bench_gate.main([fresh2, "--band", "0.99", "--missing-ok"]) == 0
+    # ...while the default tiny record DOES gate since PR 8: the
+    # tp_vs_replicated_cpu row shares its metric, and "last matching
+    # case wins" picks it up (the stale pre-PR-8 expectation here was
+    # rc 2 — tier-1's one red test between PRs 8 and 9)
+    assert bench_gate.main([fresh, "--band", "0.99"]) == 0

@@ -1,0 +1,649 @@
+"""Prefix-state cache + preemptible slots (ISSUE 9).
+
+The contracts under test:
+
+  * WARM == COLD, bit-exact — a cached-prefix admission's token stream
+    is bit-identical to a cold solo ``generate()`` (mamba1/mamba2/
+    hybrid, short + chunked long prompts, the (2,2) TP mesh), because
+    a snapshot is the literal output of the identical chunk
+    computation the cold run would execute.
+  * FULL hits skip prefill entirely — zero chunk steps, zero
+    ``record_prefill`` calls (asserted, per the acceptance criteria).
+  * Copy-on-write KV pages — a slot appending to a shared cached
+    prefix writes an owned copy; sharers' streams never change; pages
+    are refcounted (double-free / trash-page free raise named errors)
+    and release only when the last holder lets go.
+  * Preempt -> resume mid-decode — a higher-priority request swaps a
+    lower-priority slot's carry to host RAM and the resumed stream
+    continues bit-exactly, no re-prefill, no replayed token.
+  * Zero extra jit traces with the cache on (TRACE_COUNTS flat), and
+    telemetry: prefix gauges on serving_tick records (absent when the
+    cache is off), ``summary()["prefix_cache"]``, obs_report rendering.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.serving import (
+    GenerationRequest,
+    PagePool,
+    PagePoolError,
+    PrefixCache,
+    PrefixEntry,
+    ServingEngine,
+)
+
+pytestmark = [pytest.mark.serving, pytest.mark.fast]
+
+CHUNK = 16
+
+
+def tiny_cfg(layer="mamba2", **kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    kw.setdefault("prefix_cache_entries", 64)
+    kw.setdefault("vocab_size", 64)
+    return ModelConfig(d_model=32, n_layer=2, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32", **kw)
+
+
+def hybrid_cfg(**kw):
+    return tiny_cfg(attn_layer_idx=(1,), attn_num_heads=4,
+                    attn_num_kv_heads=2, remat=False, kv_page_tokens=8,
+                    kv_slot_tokens=96, **kw)
+
+
+def make_cfg(layer, **kw):
+    return hybrid_cfg(**kw) if layer == "hybrid" else tiny_cfg(layer, **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def solo(params, cfg, prompt, key, **kw):
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None], key,
+                   **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+@pytest.fixture(scope="module")
+def models():
+    """(cfg, params) per layer flavor, built once for the module."""
+    out = {}
+    for layer in ("mamba2", "mamba1", "hybrid"):
+        cfg = make_cfg(layer)
+        out[layer] = (cfg, init_lm_params(jax.random.PRNGKey(0), cfg))
+    return out
+
+
+# --------------------------------------------------- PagePool refcounts
+
+
+def test_page_pool_double_free_rejected():
+    pool = PagePool(8)
+    ids = pool.alloc(2)
+    pool.free(ids)
+    with pytest.raises(PagePoolError, match="double free"):
+        pool.free(ids)
+    with pytest.raises(PagePoolError, match="double free"):
+        pool.free([ids[0]])
+
+
+def test_page_pool_trash_page_free_rejected():
+    pool = PagePool(8)
+    with pytest.raises(PagePoolError, match="trash page"):
+        pool.free([0])
+    with pytest.raises(PagePoolError, match="outside the pool"):
+        pool.free([99])
+
+
+def test_page_pool_refcount_sharing():
+    pool = PagePool(8)
+    (page,) = pool.alloc(1)
+    assert pool.refcount(page) == 1
+    pool.incref([page])
+    assert pool.refcount(page) == 2
+    pool.free([page])  # one holder left: still in use
+    assert pool.refcount(page) == 1
+    assert pool.pages_in_use == 1
+    pool.free([page])  # last holder: back to the free list
+    assert pool.refcount(page) == 0
+    assert pool.pages_in_use == 0
+    assert page in pool._free
+    # a free page cannot gain holders
+    with pytest.raises(PagePoolError, match="not allocated"):
+        pool.incref([page])
+
+
+# --------------------------------------------------------- PrefixCache LRU
+
+
+def _entry(nbytes=100, tokens=8):
+    return PrefixEntry(state={}, tokens=tokens, chunks=1, nbytes=nbytes)
+
+
+def test_lru_entry_cap_and_recency():
+    evicted = []
+    pc = PrefixCache(max_entries=2, evict_hook=evicted.append)
+    a, b, c = _entry(), _entry(), _entry()
+    pc.put("a", a)
+    pc.put("b", b)
+    pc.get("a")  # refresh: b is now the LRU
+    pc.put("c", c)
+    assert evicted == [b]
+    assert "a" in pc and "c" in pc and "b" not in pc
+
+
+def test_lru_byte_cap():
+    evicted = []
+    pc = PrefixCache(max_entries=10, max_bytes=250, evict_hook=evicted.append)
+    pc.put("a", _entry(100))
+    pc.put("b", _entry(100))
+    pc.put("c", _entry(100))  # 300 bytes > 250: 'a' goes
+    assert len(evicted) == 1 and "a" not in pc
+    assert pc.nbytes == 200
+    # one oversized entry is kept (never evict down to empty over bytes)
+    pc2 = PrefixCache(max_entries=10, max_bytes=50)
+    pc2.put("big", _entry(500))
+    assert len(pc2) == 1
+
+
+def test_min_hits_promotion_unit():
+    pc = PrefixCache(max_entries=4, min_hits=2)
+    assert not pc.wants("k")  # never missed
+    pc.note_miss("k")
+    assert not pc.wants("k")  # 1 < 2
+    pc.note_miss("k")
+    assert pc.wants("k")
+    pc.put("k", _entry())
+    assert not pc.wants("k")  # already cached
+
+
+# ------------------------------------------------- warm-vs-cold parity
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1", "hybrid"])
+def test_warm_streams_bit_identical_to_cold(models, layer):
+    """THE acceptance scenario: run a mixed workload twice on one
+    cache-enabled engine — short prompts, a chunk-spanning long one —
+    and every stream of BOTH runs matches cold solo generate() exactly.
+    The second run's repeats are FULL hits that run zero chunk steps
+    and zero prefills."""
+    cfg, params = models[layer]
+    prompts = [rand_prompt(9, seed=2), rand_prompt(3 * CHUNK + 5, seed=3),
+               rand_prompt(7, seed=4)]
+    keys = [jax.random.PRNGKey(40 + i) for i in range(3)]
+    budgets = [4, 5, 6]
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+
+    def run_once():
+        return eng.run([
+            GenerationRequest(prompt_ids=p, max_new_tokens=b, key=k)
+            for p, k, b in zip(prompts, keys, budgets)
+        ])
+
+    first = run_once()
+    chunks0 = eng.metrics.prefill_chunks
+    prefills0 = eng.metrics.prefills
+    second = run_once()
+    # full-hit admissions skip prefill entirely: 0 chunk steps, 0
+    # one-shot prefills in the whole second run
+    assert eng.metrics.prefill_chunks == chunks0
+    assert eng.metrics.prefills == prefills0
+    assert eng.metrics.prefix_full_hits == len(prompts)
+    for res_set in (first, second):
+        for res, p, k, b in zip(res_set, prompts, keys, budgets):
+            want = solo(params, cfg, p, k, max_new_tokens=b)
+            assert res.new_tokens.tolist() == want, (
+                f"{layer} warm stream diverged from cold generate()"
+            )
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "hybrid"])
+def test_shared_preamble_partial_hit_bit_exact(models, layer):
+    """Two prompts sharing a 2-chunk preamble (equal total lengths, so
+    equal pads): the second admission seeds the cached boundary carry
+    and runs ONLY the suffix chunk — and its stream still matches cold
+    generate() bit-for-bit."""
+    cfg, params = models[layer]
+    pre = rand_prompt(2 * CHUNK, seed=5)
+    sa = np.concatenate([pre, rand_prompt(CHUNK, seed=6)])
+    sb = np.concatenate([pre, rand_prompt(CHUNK, seed=7)])
+    ka, kb = jax.random.PRNGKey(50), jax.random.PRNGKey(51)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    ra = eng.run([GenerationRequest(prompt_ids=sa, max_new_tokens=5,
+                                    key=ka)])[0]
+    chunks0 = eng.metrics.prefill_chunks
+    rb = eng.run([GenerationRequest(prompt_ids=sb, max_new_tokens=5,
+                                    key=kb)])[0]
+    assert eng.metrics.prefill_chunks - chunks0 == 1  # suffix chunk only
+    assert eng.metrics.prefix_partial_hits == 1
+    assert ra.new_tokens.tolist() == solo(params, cfg, sa, ka,
+                                          max_new_tokens=5)
+    assert rb.new_tokens.tolist() == solo(params, cfg, sb, kb,
+                                          max_new_tokens=5)
+
+
+def test_warm_parity_on_2x2_tp_mesh():
+    """Warm parity survives the 2-D serving mesh: (data=2, model=2) on
+    the conftest's virtual 8-device host, chunked long prompt included
+    — warm streams == cold solo generate(mesh=engine.mesh)."""
+    cfg = tiny_cfg(serving_data_shards=2, serving_model_shards=2)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompts = [rand_prompt(7, seed=8), rand_prompt(2 * CHUNK + 3, seed=9)]
+    keys = [jax.random.PRNGKey(60), jax.random.PRNGKey(61)]
+    eng = ServingEngine(params, cfg, capacity=4, tokens_per_tick=2)
+
+    def run_once():
+        return eng.run([
+            GenerationRequest(prompt_ids=p, max_new_tokens=4, key=k)
+            for p, k in zip(prompts, keys)
+        ])
+
+    run_once()
+    second = run_once()
+    assert eng.metrics.prefix_full_hits == len(prompts)
+    for res, p, k in zip(second, prompts, keys):
+        want = solo(params, cfg, p, k, max_new_tokens=4)
+        assert res.new_tokens.tolist() == want
+
+
+def test_lru_eviction_under_byte_cap_engine():
+    """A byte-capped cache evicts old prefixes under churn and the
+    engine keeps serving correct (cold-parity) streams throughout."""
+    cfg = tiny_cfg(prefix_cache_bytes=40_000)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    for i in range(6):
+        p = rand_prompt(2 * CHUNK + i, seed=20 + i)
+        k = jax.random.PRNGKey(70 + i)
+        res = eng.run([GenerationRequest(prompt_ids=p, max_new_tokens=3,
+                                         key=k)])[0]
+        assert res.new_tokens.tolist() == solo(params, cfg, p, k,
+                                               max_new_tokens=3)
+    assert eng.prefix_cache.nbytes <= 40_000
+    assert eng.prefix_cache.evictions > 0
+
+
+# ---------------------------------------------------- copy-on-write pages
+
+
+def test_cow_page_alias_writer_copies_sharer_unchanged(models):
+    """Hybrid CoW: a full-hit slot shares the cached prefix's pages
+    (refcount > 1 while resident) and appends into an owned copy of
+    the mid-page boundary — repeat sharers keep producing cold-exact
+    streams, so no writer ever touched the shared originals."""
+    cfg, params = models["hybrid"]
+    # 43 tokens: kv_len % kv_page_tokens = 3 -> the boundary page is
+    # partial and every attaching slot must CoW-copy it
+    prompt = rand_prompt(43, seed=30)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    keys = [jax.random.PRNGKey(80 + i) for i in range(3)]
+    r0 = eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=5,
+                                    key=keys[0])])[0]
+    # the cache pins the prefix pages past request eviction
+    held = eng.page_pool.pages_in_use
+    assert held >= -(-43 // cfg.kv_page_tokens)
+    # submit a sharer and catch it mid-flight: shared pages have 2 holders
+    rid = eng.submit(GenerationRequest(prompt_ids=prompt, max_new_tokens=5,
+                                       key=keys[1]))
+    eng.step()
+    tracked = next(iter(eng._slots.values()))
+    shared = [p for p in tracked.pages if eng.page_pool.refcount(p) > 1]
+    assert shared, "full hit should attach to the cached prefix's pages"
+    while eng.pending:
+        eng.step()
+    r1 = eng.results[rid]
+    r2 = eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=5,
+                                    key=keys[2])])[0]
+    for res, k in zip((r0, r1, r2), keys):
+        assert res.new_tokens.tolist() == solo(params, cfg, prompt, k,
+                                               max_new_tokens=5)
+    # drop the cache: every pinned page returns to the allocator
+    eng.prefix_cache.clear()
+    assert eng.page_pool.pages_in_use == 0
+
+
+def test_concurrent_sharers_disjoint_writes(models):
+    """Two slots sharing one cached prefix simultaneously: both streams
+    cold-exact, and their OWNED (writable) pages never overlap."""
+    cfg, params = models["hybrid"]
+    prompt = rand_prompt(40, seed=31)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    eng.run([GenerationRequest(prompt_ids=prompt, max_new_tokens=4,
+                               key=jax.random.PRNGKey(90))])
+    ka, kb = jax.random.PRNGKey(91), jax.random.PRNGKey(92)
+    ra = eng.submit(GenerationRequest(prompt_ids=prompt, max_new_tokens=6,
+                                      key=ka))
+    rb = eng.submit(GenerationRequest(prompt_ids=prompt, max_new_tokens=6,
+                                      key=kb))
+    eng.step()
+    owned = []
+    for t in eng._slots.values():
+        owned.append({p for p in t.pages if eng.page_pool.refcount(p) == 1})
+    assert len(owned) == 2 and not owned[0] & owned[1]
+    while eng.pending:
+        eng.step()
+    assert eng.results[ra].new_tokens.tolist() == solo(
+        params, cfg, prompt, ka, max_new_tokens=6)
+    assert eng.results[rb].new_tokens.tolist() == solo(
+        params, cfg, prompt, kb, max_new_tokens=6)
+
+
+def test_cache_pinned_pages_released_under_admission_pressure(models):
+    """Liveness valve: a warm cache pinning most of an oversubscribed
+    page pool must not starve admission — the engine evicts page-pinned
+    entries LRU-first until the reservation fits (previously serve()
+    would spin forever: cache refs release only via LRU churn that
+    needs an admission to happen first)."""
+    cfg, params = models["hybrid"]
+    # 12-page pool: a 40+4-token request pins 6 pages in the cache
+    cfg = dataclasses.replace(cfg, kv_pool_pages=12)
+    params_local = params
+    eng = ServingEngine(params_local, cfg, capacity=2, tokens_per_tick=2)
+    pa = rand_prompt(40, seed=80)
+    ka = jax.random.PRNGKey(160)
+    eng.run([GenerationRequest(prompt_ids=pa, max_new_tokens=4, key=ka)])
+    assert eng.page_pool.pages_in_use > 0  # the cache pins the prefix
+    # a different prompt needing more pages than remain free (8 of 12,
+    # with 5 cache-pinned): admission must reclaim cache pages and
+    # serve within a bounded step count
+    pb = rand_prompt(60, seed=81)
+    kb = jax.random.PRNGKey(161)
+    rid = eng.submit(GenerationRequest(prompt_ids=pb, max_new_tokens=4,
+                                       key=kb))
+    for _ in range(200):
+        eng.step()
+        if not eng.pending:
+            break
+    assert not eng.pending, "admission starved behind cache-pinned pages"
+    assert eng.prefix_cache.evictions > 0
+    assert eng.results[rid].new_tokens.tolist() == solo(
+        params_local, cfg, pb, kb, max_new_tokens=4)
+
+
+def test_stalled_admission_does_not_drift_cache_stats(models):
+    """A request retrying admission every step (waiting on KV pages)
+    must not re-count cache hits/misses per retry — stats commit only
+    when a slot is secured."""
+    cfg, params = models["hybrid"]
+    cfg = dataclasses.replace(cfg, kv_pool_pages=8,
+                              prefix_cache_entries=64)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1)
+    r1 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(40, seed=82),
+                                      max_new_tokens=8,
+                                      key=jax.random.PRNGKey(170)))
+    eng.step()  # r1 resident, holding 6 of 8 pages
+    r2 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(30, seed=83),
+                                      max_new_tokens=4,
+                                      key=jax.random.PRNGKey(171)))
+    eng.step()  # r2 stalls: needs 5 pages, 2 free
+    misses0 = eng.prefix_cache.misses
+    eng.step()
+    eng.step()  # retries must not bump the counters again
+    assert eng.prefix_cache.misses == misses0
+    while eng.pending:
+        eng.step()
+    assert {r1, r2} <= set(eng.results)
+
+
+# ------------------------------------------------- preemption + priority
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "hybrid"])
+def test_preempt_resume_mid_decode_parity(models, layer):
+    """A higher-priority arrival preempts the decoding low-priority
+    slot (carry to host RAM, slot freed); the victim later resumes and
+    BOTH final streams are cold-exact — the swap is invisible in the
+    tokens.  The victim is never re-prefilled."""
+    cfg, params = models[layer]
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+    plo, phi = rand_prompt(9, seed=40), rand_prompt(7, seed=41)
+    klo, khi = jax.random.PRNGKey(100), jax.random.PRNGKey(101)
+    rlo = eng.submit(GenerationRequest(prompt_ids=plo, max_new_tokens=12,
+                                       key=klo, priority=0))
+    eng.step()
+    eng.step()  # the low-priority request is mid-decode
+    prefills0 = eng.metrics.prefills + eng.metrics.prefill_chunks
+    rhi = eng.submit(GenerationRequest(prompt_ids=phi, max_new_tokens=4,
+                                       key=khi, priority=5))
+    while eng.pending:
+        eng.step()
+    assert eng.metrics.preemptions == 1
+    lo = eng.results[rlo]
+    assert lo.new_tokens.tolist() == solo(params, cfg, plo, klo,
+                                          max_new_tokens=12)
+    assert eng.results[rhi].new_tokens.tolist() == solo(
+        params, cfg, phi, khi, max_new_tokens=4)
+    # the victim's resume restored state — it never prefilled again:
+    # the only prefill work after the preempt is the high-pri's own
+    # admission (mamba2: one one-shot; hybrid: one chunk + its
+    # completion record)
+    hi_prefill = 1 if layer == "mamba2" else 2
+    assert (eng.metrics.prefills + eng.metrics.prefill_chunks
+            - prefills0) <= hi_prefill
+
+
+def test_equal_priorities_never_preempt(models):
+    """With uniform priorities the scheduler is plain FCFS — no
+    preemption ever triggers (the pre-PR-9 behavior, exactly)."""
+    cfg, params = models["mamba2"]
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+    reqs = [GenerationRequest(prompt_ids=rand_prompt(5 + i, seed=50 + i),
+                              max_new_tokens=4, key=jax.random.PRNGKey(i))
+            for i in range(3)]
+    eng.run(reqs)
+    assert eng.metrics.preemptions == 0
+
+
+def test_priority_pops_ahead_of_fcfs(models):
+    """A higher-priority submission admits before earlier lower-priority
+    queue entries (FCFS within a class)."""
+    cfg, params = models["mamba2"]
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2)
+    order = []
+    seen = set()
+
+    def record(events):
+        for ev in events:
+            if ev.request_id not in seen:
+                seen.add(ev.request_id)
+                order.append(ev.request_id)
+
+    r0 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(5, seed=60),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(110)))
+    record(eng.step())  # r0 resident; the next two queue behind it
+    r1 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(6, seed=61),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(111)))
+    r2 = eng.submit(GenerationRequest(prompt_ids=rand_prompt(7, seed=62),
+                                      max_new_tokens=3,
+                                      key=jax.random.PRNGKey(112),
+                                      priority=3))
+    while eng.pending:
+        record(eng.step())
+    assert order.index(r2) < order.index(r1)
+    # r0 decoded before r2 even arrived, so its first token leads
+    # regardless of the preemption that follows
+    assert order[0] == r0
+
+
+# ------------------------------------------------------ traces + telemetry
+
+
+def test_trace_counts_flat_with_cache_enabled():
+    """The cache adds zero jit traces: a warm second run compiles
+    nothing new (tick/chunk/prefill counters all flat)."""
+    from mamba_distributed_tpu.serving.engine import TRACE_COUNTS as ENG
+    from mamba_distributed_tpu.serving.prefill import (
+        TRACE_COUNTS as CHUNK_TC,
+    )
+
+    # own model shape so the jit cache can't already hold signatures
+    cfg = tiny_cfg(vocab_size=48)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        max_top_k=20)
+    reqs = lambda: [
+        GenerationRequest(prompt_ids=rand_prompt(n, seed=n, vocab=48),
+                          max_new_tokens=3, top_k=20,
+                          key=jax.random.PRNGKey(n))
+        for n in (5, 2 * CHUNK + 1)
+    ]
+    eng.run(reqs())
+    t0, p0, c0 = ENG["tick"], ENG["prefill"], CHUNK_TC["chunk"]
+    eng.run(reqs())  # warm: full hits
+    assert (ENG["tick"], ENG["prefill"], CHUNK_TC["chunk"]) == (t0, p0, c0)
+
+
+def test_tick_records_carry_prefix_gauges(models, tmp_path):
+    """serving_tick records from a cache-enabled engine carry the
+    hit/miss/bytes gauges; cache-off records stay byte-stable (no
+    prefix fields at all); request records carry prefix_hit; the
+    summary grows the prefix_cache section and obs_report renders it."""
+    import json
+
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    cfg, params = models["mamba2"]
+    jsonl = tmp_path / "pc.jsonl"
+    metrics = ServingMetrics(2, jsonl_path=str(jsonl))
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        metrics=metrics)
+    req = lambda: [GenerationRequest(prompt_ids=rand_prompt(9, seed=70),
+                                     max_new_tokens=3,
+                                     key=jax.random.PRNGKey(120))]
+    eng.run(req())
+    eng.run(req())
+    lines = [json.loads(ln) for ln in open(jsonl)]
+    ticks = [ln for ln in lines if ln["kind"] == "serving_tick"]
+    assert all("prefix_hits" in t and "prefix_cache_bytes" in t
+               for t in ticks)
+    assert sum(t["prefix_hits"] for t in ticks) == 1
+    assert sum(t["prefix_misses"] for t in ticks) == 1
+    assert sum(t["prefix_saved_tokens"] for t in ticks) == 9
+    reqs = [ln for ln in lines if ln["kind"] == "request"]
+    assert [r["prefix_hit"] for r in reqs] == [None, "full"]
+    s = metrics.summary()
+    assert s["prefix_cache"]["full_hits"] == 1
+    assert s["prefix_cache"]["hit_rate"] == 0.5
+    assert s["prefix_cache"]["saved_prefill_tokens"] == 9
+    assert s["prefix_cache"]["ttft_hit_ms"]["count"] == 1
+    assert s["prefix_cache"]["ttft_miss_ms"]["count"] == 1
+    # obs_report: the aggregated report exposes the gauges + TTFT split
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import obs_report
+
+    report = obs_report.build_report(lines)
+    assert report["serving"]["prefix_cache"]["hits"] == 1
+    assert report["serving"]["prefix_cache"]["hit_rate"] == 0.5
+    assert report["requests"]["ttft_hit_ms"]["count"] == 1
+    assert report["requests"]["ttft_miss_ms"]["count"] == 1
+    rendered = obs_report.format_report(report)
+    assert "prefix cache: 1 hits / 1 misses" in rendered
+    # cache OFF: records byte-stable (no prefix fields anywhere)
+    cfg_off = dataclasses.replace(cfg, prefix_cache_entries=0)
+    jsonl2 = tmp_path / "off.jsonl"
+    m2 = ServingMetrics(2, jsonl_path=str(jsonl2))
+    ServingEngine(params, cfg_off, capacity=2, tokens_per_tick=2,
+                  metrics=m2).run(req())
+    for ln in open(jsonl2):
+        rec = json.loads(ln)
+        assert not any(k.startswith("prefix") for k in rec)
+    assert m2.summary()["prefix_cache"] is None
+
+
+def test_min_hits_promotion_engine(models):
+    """prefix_min_chunk_hits=2: the first sighting stores nothing, the
+    second stores, the third hits."""
+    cfg, params = models["mamba2"]
+    cfg = dataclasses.replace(cfg, prefix_min_chunk_hits=2)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    req = lambda: [GenerationRequest(
+        prompt_ids=rand_prompt(2 * CHUNK + 1, seed=71), max_new_tokens=3,
+        key=jax.random.PRNGKey(130))]
+    eng.run(req())
+    assert len(eng.prefix_cache) == 0
+    eng.run(req())
+    assert len(eng.prefix_cache) > 0
+    assert eng.metrics.prefix_full_hits == 0
+    eng.run(req())
+    assert eng.metrics.prefix_full_hits == 1
+
+
+# ------------------------------------------------ generate() cache reuse
+
+
+def test_generate_prefix_cache_reuse(models):
+    """generate(prefix_cache=) warms its own cache through the chunked
+    path and hits on repeats — streams identical warm and cold; a cache
+    warmed by an ENGINE serves generate() too (shared keys + layouts)."""
+    cfg, params = models["mamba2"]
+    pc = PrefixCache(max_entries=32)
+    prompt = rand_prompt(2 * CHUNK + 5, seed=72)
+    key = jax.random.PRNGKey(140)
+    cold = solo(params, cfg, prompt, key, max_new_tokens=4)
+    warm1 = solo(params, cfg, prompt, key, max_new_tokens=4,
+                 prefix_cache=pc)
+    assert warm1 == cold and len(pc) > 0
+    hits0 = pc.hits
+    warm2 = solo(params, cfg, prompt, key, max_new_tokens=4,
+                 prefix_cache=pc)
+    assert warm2 == cold and pc.hits > hits0
+    # engine-warmed cache, consumed by generate(): short (one-shot
+    # full entry) AND chunked prompts
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2)
+    short = rand_prompt(9, seed=73)
+    kshort = jax.random.PRNGKey(141)
+    eng.run([GenerationRequest(prompt_ids=short, max_new_tokens=4,
+                               key=kshort),
+             GenerationRequest(prompt_ids=prompt, max_new_tokens=4,
+                               key=key)])
+    ehits0 = eng.prefix_cache.hits
+    got_short = solo(params, cfg, short, kshort, max_new_tokens=4,
+                     prefix_cache=eng.prefix_cache)
+    got_long = solo(params, cfg, prompt, key, max_new_tokens=4,
+                    prefix_cache=eng.prefix_cache)
+    assert eng.prefix_cache.hits == ehits0 + 2
+    assert got_short == solo(params, cfg, short, kshort, max_new_tokens=4)
+    assert got_long == cold
+
+
+# ------------------------------------------------------- router affinity
+
+
+def test_router_prefers_cache_warm_replica(models):
+    """Cache affinity: with equal load, a prompt routes to the replica
+    whose prefix cache already holds it."""
+    from mamba_distributed_tpu.serving import RequestRouter
+
+    cfg, params = models["mamba2"]
+    router = RequestRouter(params, cfg, num_replicas=2, capacity=2,
+                           tokens_per_tick=2)
+    prompt = rand_prompt(2 * CHUNK + 2, seed=74)
+    gid = router.submit(GenerationRequest(
+        prompt_ids=prompt, max_new_tokens=3, key=jax.random.PRNGKey(150)))
+    first_rep = router._routed[gid].replica_id
+    while router.pending:
+        router.step()
+    # warm replica now discounts this prompt below the idle cold one
+    gid2 = router.submit(GenerationRequest(
+        prompt_ids=prompt, max_new_tokens=3, key=jax.random.PRNGKey(151)))
+    assert router._routed[gid2].replica_id == first_rep
+    while router.pending:
+        router.step()
