@@ -191,6 +191,18 @@ class ModelConfig:
     # mesh.  1 => single-device pool (the pre-fabric behavior).
     # capacity must divide evenly across the shards.
     serving_data_shards: int = 1
+    # --- disaggregated prefill/decode tiers (serving/router.py,
+    # serving/replica.py role=) ---
+    # Prompt-length cutoff (tokens) above which the router places a
+    # request on the PREFILL tier (EngineReplica(role="prefill")): the
+    # replica runs the chunked prefill, then at prefill-complete the
+    # request's O(1) carry snapshot (+ hybrid KV pages) MIGRATES to a
+    # decode-tier replica where state_cache.restore resumes the stream
+    # bit-exactly — long prompts stop taxing short-request ITL on the
+    # decode tier (docs/SERVING.md "Disaggregated tiers").  0 (default)
+    # disables role-aware routing: every replica serves mixed, the
+    # exact pre-disagg fabric.
+    disagg_prompt_threshold: int = 0
     # --- prefix-state cache + preemption (serving/prefix_cache.py,
     # serving/engine.py) ---
     # Prefix-state cache entry cap: chunk-boundary conv/SSM carry
@@ -294,6 +306,11 @@ class ModelConfig:
             raise ValueError(
                 f"serving_model_shards must be >= 1, got "
                 f"{self.serving_model_shards}"
+            )
+        if self.disagg_prompt_threshold < 0:
+            raise ValueError(
+                f"disagg_prompt_threshold must be >= 0 (0 disables "
+                f"role-aware routing), got {self.disagg_prompt_threshold}"
             )
         if self.prefix_cache_entries < 0:
             raise ValueError(

@@ -269,14 +269,30 @@ def pack_attention_pages(cfg: ModelConfig, k: jax.Array, v: jax.Array,
 
 
 def gather_kv_pages(k_pages: jax.Array, v_pages: jax.Array,
-                    page_table: jax.Array):
+                    page_table: jax.Array,
+                    live_pages: jax.Array | None = None):
     """Reassemble each row's logical KV view: (P, nkv, pg, hd) head-major
     pages + (b, W) table -> (b, W*pg, nkv, hd).  The lax fallback path —
     the Pallas ragged kernels (ops/pallas/attention_kernels.py) walk the
     table in-kernel instead of materializing this (and read the
-    head-major pages without the axis move this gather folds in)."""
+    head-major pages without the axis move this gather folds in).
+
+    ``live_pages`` (b,) int32 — logical pages actually LIVE per row —
+    redirects table entries at or past each row's live extent to the
+    trash page, so the gather's read traffic touches only live pages
+    (plus the one trash page, hot in cache) instead of every reserved
+    page up to the table width: O(live tokens), not O(pool), per call —
+    what makes the fallback viable for CPU-serving deployments.  Safe
+    bit-exactly: every position in a dead page is already hard-masked
+    to -inf by the callers' causal/position bounds (``_sdpa_positions``
+    ``jnp.where``s masked scores regardless of the gathered values), so
+    the substitution can never change a live lane."""
     b, W = page_table.shape
     _, nkv, pg, hd = k_pages.shape
+    if live_pages is not None:
+        page_table = jnp.where(
+            jnp.arange(W)[None, :] < live_pages[:, None], page_table, 0
+        )
 
     def gather(pages):
         x = jnp.moveaxis(pages[page_table], 2, 3)        # (b, W, pg, nkv, hd)
@@ -365,7 +381,12 @@ def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array,
             jnp.minimum(qpos + 1, W * pg),
         )[:, None]
     else:
-        kk, vv = gather_kv_pages(k_pages, v_pages, page_table)
+        # tokens readable after the write = qpos + 1 per row: gather
+        # only the pages that hold them (the rest go to trash — masked
+        # anyway), so decode cost tracks live tokens off-TPU too
+        kk, vv = gather_kv_pages(
+            k_pages, v_pages, page_table, (qpos + pg) // pg
+        )
         out = _sdpa_positions(q, kk, vv, qpos[:, None])
     y = linear(params["out_proj"], out.reshape(b, nh * hd), compute_dtype)
     return y, (k_pages, v_pages)
@@ -438,7 +459,16 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
         # (b, c, nkv, hd) blocks one axis past the heads
         k_pages = k_pages.at[phys, :, off].set(k.astype(k_pages.dtype))
         v_pages = v_pages.at[phys, :, off].set(v.astype(v_pages.dtype))
-        kk, vv = gather_kv_pages(k_pages, v_pages, page_table)
+        # live extent after this chunk's write = prefix + its real
+        # tokens; pages past it gather as trash (fully masked), so the
+        # chunk's fallback cost tracks live tokens, not table width
+        # (at least one page: a degenerate all-pad row clamps its
+        # queries to position 0, which must stay a real gather)
+        tokens = jnp.minimum(lengths + (c - pad), W * pg)
+        kk, vv = gather_kv_pages(
+            k_pages, v_pages, page_table,
+            jnp.maximum((tokens + pg - 1) // pg, 1),
+        )
         out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
     y = linear(params["out_proj"], out.reshape(b, c, nh * hd), compute_dtype)
     return y, (k_pages, v_pages)

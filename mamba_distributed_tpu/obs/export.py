@@ -19,7 +19,12 @@ directly in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``:
     (``s``/``t``/``f`` events sharing one id), so clicking a
     ``serving_route`` slice on the router track highlights the chain
     through that request's prefill/chunk slices on whichever replica
-    it landed on.  A ``serving_tick`` slice lists its resident
+    it landed on.  A disaggregated fabric's ``serving_migrate`` span
+    (router track, same trace id) sits between the prefill replica's
+    chunk spans and the decode replica's ``serving_resume``, so the
+    cross-replica handoff renders as one arrow hop in the same chain
+    (docs/SERVING.md "Disaggregated tiers").  A ``serving_tick``
+    slice lists its resident
     requests in a ``traces`` attr; the first tick containing a
     request terminates that request's arrow (its first decode tick —
     where TTFT lands).

@@ -13,12 +13,15 @@ docs/SERVING.md has the architecture; the short version:
                serving_mesh's data axis (the shard_slots path)
   scheduler    FCFS queue + request lifecycle (queued -> prefill ->
                decode -> finished)
-  replica      one engine + lifecycle (active/draining/dead) — the
-               router's placement unit
-  router       data-parallel serving fabric front end: least-loaded
-               placement over N replicas (prefix-cache affinity
-               discounts warm replicas), drain, failover with replay
-               dedup (docs/SERVING.md "Multi-host serving")
+  replica      one engine + lifecycle (active/draining/dead) and a
+               disagg tier role (mixed/prefill/decode) — the router's
+               placement unit
+  router       data-parallel serving fabric front end: role-filtered
+               least-loaded placement over N replicas (prefix-cache
+               affinity discounts warm replicas), drain, failover with
+               replay dedup, and the prefill->decode tier migration
+               (docs/SERVING.md "Multi-host serving" and
+               "Disaggregated tiers")
   prefix_cache host-side LRU of chunk-boundary carry snapshots keyed
                by prompt-prefix hash — near-zero TTFT for shared
                prompts; hybrid entries pin KV pages copy-on-write
@@ -30,7 +33,11 @@ from mamba_distributed_tpu.serving.prefix_cache import (
     PrefixCache,
     PrefixEntry,
 )
-from mamba_distributed_tpu.serving.replica import EngineReplica, ReplicaState
+from mamba_distributed_tpu.serving.replica import (
+    REPLICA_ROLES,
+    EngineReplica,
+    ReplicaState,
+)
 from mamba_distributed_tpu.serving.router import RequestRouter
 from mamba_distributed_tpu.serving.prefill import (
     ChunkPlan,
@@ -62,6 +69,7 @@ __all__ = [
     "PagePoolError",
     "PrefixCache",
     "PrefixEntry",
+    "REPLICA_ROLES",
     "ReplicaState",
     "RequestRouter",
     "RequestStatus",

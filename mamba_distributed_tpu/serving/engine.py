@@ -305,6 +305,22 @@ class ServingEngine:
         private; pure-SSM caches may be shared with
         ``generate(prefix_cache=)`` under the same params.
 
+      migrate_hook: the disaggregated prefill/decode handoff
+        (serving/router.py installs it on PREFILL-role replicas'
+        engines).  Called as ``hook(tracked, package)`` for every slot
+        that just turned decodable with zero tokens emitted — i.e. at
+        prefill-complete, whether the prefill was chunked, one-shot,
+        or a full prefix-cache hit.  ``package()`` serializes the
+        migration artifact (the O(1) conv/SSM carry + last logits,
+        plus hybrid KV page contents); a True return means the router
+        re-placed the request on a decode replica (this engine frees
+        the slot and its pages), False means no decode capacity — the
+        slot decodes HERE (mixed-mode fallback, offered exactly once
+        via ``no_migrate`` so a declined request never stalls).  The
+        receiving engine admits the artifact via ``submit_migrated``
+        and ``state_cache.restore`` — the resumed stream is bit-exact
+        (the preempt/resume contract, tests/test_disagg.py).
+
     Priority + preemption: requests carry a ``priority`` (higher wins;
     default ``cfg.serving_default_priority``).  When the queue's best
     request outranks a resident DECODING slot and no slot is free, the
@@ -335,6 +351,7 @@ class ServingEngine:
         slo=None,
         mesh=None,
         prefix_cache: PrefixCache | None = None,
+        migrate_hook=None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -484,6 +501,12 @@ class ServingEngine:
         self._pc_misses = 0
         self._pc_saved_tokens = 0
         self._preemptions = 0
+        # disaggregated prefill/decode handoff (serving/router.py):
+        # the hook a prefill-role replica's router installs, plus the
+        # per-window migration counters -> serving_tick records
+        self.migrate_hook = migrate_hook
+        self._migrations_out = 0
+        self._migrations_in = 0
         # prefill accounting awaiting a tick record: tick-less steps
         # (everything resident still mid-prefill) roll their stall /
         # chunk counters into the NEXT tick's jsonl record so the
@@ -504,6 +527,11 @@ class ServingEngine:
 
     def submit(self, request: GenerationRequest) -> int:
         """Queue a request; returns its request_id."""
+        return self._submit_tracked(request).request_id
+
+    def _submit_tracked(self, request: GenerationRequest) -> _Tracked:
+        """``submit`` returning the scheduler's tracker itself (what
+        ``submit_migrated`` decorates with the migration artifact)."""
         if not 1 <= request.top_k <= self.max_top_k:
             raise ValueError(
                 f"request top_k={request.top_k} must be in "
@@ -535,7 +563,29 @@ class ServingEngine:
                     f"{self.num_shards} shard(s); cfg.kv_pool_pages); "
                     f"it could never be admitted"
                 )
-        tracked = self.scheduler.submit(request)
+        return self.scheduler.submit(request)
+
+    def submit_migrated(self, request: GenerationRequest, snapshot: dict,
+                        *, source_replica: int | None = None) -> int:
+        """Admit a request mid-journey: it finished prefill on ANOTHER
+        replica (the prefill tier, docs/SERVING.md "Disaggregated
+        tiers") and arrives as the O(1) migration artifact — conv/SSM
+        carry + last logits, plus serialized hybrid KV page contents —
+        instead of a prompt to prefill.  Queued like any request
+        (same validation, same FCFS/priority order); admission routes
+        it through the ``state_cache.restore`` path (zero prefill
+        compute here, fresh pages allocated and the serialized KV
+        scattered in), and the resumed stream is bit-exactly the one
+        a local prefill would have produced.  Latency stamps span the
+        WHOLE journey: ``snapshot["t_submit"]`` carries the original
+        submit time, so the finished record's TTFT/e2e include the
+        prefill-tier residency.  Returns the engine-local request id."""
+        tracked = self._submit_tracked(request)
+        tracked.snapshot = snapshot
+        tracked.no_migrate = True  # never bounce back to a prefill tier
+        tracked.migration_source = source_replica
+        if snapshot.get("t_submit") is not None:
+            tracked.t_submit = snapshot["t_submit"]
         return tracked.request_id
 
     def _slot_shard(self, slot: int) -> int:
@@ -1050,6 +1100,14 @@ class ServingEngine:
             return True
         shard = self._slot_shard(victim.slot)
         if head.snapshot is not None:
+            if head.snapshot.get("migrated"):
+                # a migrated-in head brings page CONTENTS, not refs: it
+                # re-allocates its full reservation in the freed slot's
+                # shard, so that shard's free pages must cover it
+                r = head.request
+                return attention_page_count(
+                    self.cfg, len(r.prompt_ids) + r.max_new_tokens
+                ) <= self.page_pool.free_pages_in(shard)
             return shard == head.snapshot.get("shard", shard)
         r = head.request
         n_pages = attention_page_count(
@@ -1112,14 +1170,32 @@ class ServingEngine:
             self.scheduler.requeue(tracked)
 
     def _resume(self, tracked: _Tracked) -> bool:
-        """Re-admit a preempted request: restore its host snapshot into
-        a free slot — the same data shard for hybrids, where its pages
-        live — with ``step`` preserved.  Returns False (requeued) when
-        no compatible slot is free yet."""
+        """Re-admit a request from a host snapshot with ``step``
+        preserved: a PREEMPTED request back into a free slot — the
+        same data shard for hybrids, where its page refs live — or a
+        MIGRATED one (``snapshot["migrated"]``, the prefill-tier
+        handoff artifact) into any slot whose shard can cover its full
+        page reservation: the pages are allocated HERE and the
+        serialized KV contents scattered in (``state_cache
+        .write_pages``), so the artifact is shard- and replica-
+        agnostic.  Returns False (requeued) when no compatible slot is
+        free yet."""
         snap = tracked.snapshot
+        migrated = bool(snap.get("migrated"))
+        n_pages = 0
         if self.hybrid:
-            slot = next((s for s in self._free
-                         if self._slot_shard(s) == snap["shard"]), None)
+            if migrated:
+                r = tracked.request
+                n_pages = attention_page_count(
+                    self.cfg, len(r.prompt_ids) + r.max_new_tokens
+                )
+                slot = next(
+                    (s for s in self._free
+                     if n_pages <= self.page_pool.free_pages_in(
+                         self._slot_shard(s))), None)
+            else:
+                slot = next((s for s in self._free
+                             if self._slot_shard(s) == snap["shard"]), None)
         else:
             slot = self._free[0] if self._free else None
         if slot is None:
@@ -1127,10 +1203,35 @@ class ServingEngine:
             return False
         self._free.remove(slot)
         r = tracked.request
+        t0 = time.perf_counter()
         try:
             with self.tracer.span("serving_resume", slot=slot,
                                   request=tracked.request_id,
-                                  trace=tracked.trace_id):
+                                  trace=tracked.trace_id,
+                                  **({"migrated": True} if migrated
+                                     else {})):
+                if self.hybrid and migrated:
+                    tracked.pages = self.page_pool.alloc(
+                        n_pages, self._slot_shard(slot)
+                    )
+                    self._page_allocs += n_pages
+                    n_live = snap["n_live"]
+                    if n_live:
+                        # dst ids padded to the artifact's pow2 page
+                        # bucket with the trash page (whose contents
+                        # are garbage by contract), so one scatter
+                        # trace covers every page count
+                        bucket = jax.tree.leaves(
+                            snap["kv_data"])[0].shape[1]
+                        dst = np.zeros((bucket,), np.int32)
+                        dst[:n_live] = tracked.pages[:n_live]
+                        self.pool["state"]["attn_blocks"] = \
+                            state_cache.write_pages(
+                                self.pool["state"]["attn_blocks"],
+                                jax.tree.map(jnp.asarray,
+                                             snap["kv_data"]),
+                                jnp.asarray(dst),
+                            )
                 self.pool = state_cache.restore(
                     self.pool, slot,
                     {"blocks": jax.tree.map(jnp.asarray, snap["blocks"])},
@@ -1145,7 +1246,15 @@ class ServingEngine:
         except Exception:
             # slot back, request back — the snapshot survives requeue,
             # so a retry restores instead of re-prefilling (a re-prefill
-            # would replay tokens the consumer already has)
+            # would replay tokens the consumer already has).  Pages a
+            # MIGRATED restore allocated here are returned (its data
+            # lives on in the snapshot; a retry re-allocates).
+            if migrated and tracked.pages:
+                self.page_pool.free(tracked.pages)
+                self._page_frees += len(tracked.pages)
+                tracked.pages = None
+                self._page_tbl[slot] = 0
+                self._kv_len[slot] = 0
             self._free.insert(0, slot)
             self._free.sort()
             self.scheduler.requeue(tracked)
@@ -1154,7 +1263,94 @@ class ServingEngine:
         tracked.slot = slot
         tracked.status = RequestStatus.DECODE
         self._slots[slot] = tracked
+        if migrated and tracked.itl_hist is None:
+            # a migrated-in tracker is FRESH on this scheduler and
+            # skipped _admit's lifecycle stamping: the admission stamp
+            # travels in the artifact (queue-wait was recorded once,
+            # on the prefill replica — re-recording here would double-
+            # count it in the histogram) and the per-request ITL
+            # histogram starts empty (no token has streamed yet)
+            tracked.t_admit = snap.get("t_admit") or time.perf_counter()
+            tracked.itl_hist = StreamingHistogram()
+        if migrated:
+            # handoff latency = source-side packaging + this restore's
+            # host dispatch (the router's serving_migrate span covers
+            # the placement hop between them)
+            dt_ms = (snap.get("package_ms", 0.0)
+                     + (time.perf_counter() - t0) * 1000)
+            tracked.migrations += 1
+            tracked.migration_ms += dt_ms
+            self._migrations_in += 1
+            self.metrics.record_migration_in(dt_ms)
         return True
+
+    # ------------------------------------- disaggregated tier migration
+
+    def _package_migration(self, slot: int, tracked: _Tracked) -> dict:
+        """Serialize a prefill-complete slot into the migration
+        artifact: the same preempt-style host snapshot
+        ``state_cache.restore`` consumes (O(1) conv/SSM carry + last
+        logits + the token counter, here 0) plus — hybrids — the live
+        KV pages' contents read out of the page pool
+        (``state_cache.read_pages``, pow2-bucketed page count so one
+        gather trace covers every prompt length).  The ``device_get``
+        is the one deliberate sync on this path: a migration IS a
+        device->host->device move, and Mamba makes it O(1) in the
+        sequence length (plus O(prompt) KV pages only for hybrid
+        stacks)."""
+        t0 = time.perf_counter()
+        state = state_cache.read_state(self.pool, slot)
+        snap = {
+            "migrated": True,
+            "blocks": jax.device_get(state["blocks"]),
+            "logits": jax.device_get(self.pool["logits"][slot][None]),
+            "step": len(tracked.new_tokens),
+            "t_submit": tracked.t_submit,
+            "t_admit": tracked.t_admit,
+        }
+        if self.hybrid:
+            kv_len = int(self._kv_len[slot])
+            n_live = -(-kv_len // self.cfg.kv_page_tokens) if kv_len else 0
+            bucket = next_pow2_bucket(max(n_live, 1), min_bucket=1)
+            ids = np.zeros((bucket,), np.int32)  # pad -> trash page 0
+            ids[:n_live] = tracked.pages[:n_live]
+            snap["kv_data"] = jax.device_get(state_cache.read_pages(
+                self.pool["state"]["attn_blocks"], jnp.asarray(ids)
+            ))
+            snap["kv_len"] = kv_len
+            snap["n_live"] = n_live
+        snap["package_ms"] = (time.perf_counter() - t0) * 1000
+        return snap
+
+    def _migrate_ready(self) -> None:
+        """Prefill-tier handoff (``migrate_hook`` engines only): offer
+        every prefill-complete slot — DECODE status, zero tokens
+        emitted, so chunked, one-shot and full-cache-hit prefills all
+        qualify — to the hook BEFORE it ever decodes here.  The hook
+        (serving/router._migrate_from) re-places the packaged artifact
+        on a decode-tier replica and returns True: this engine then
+        frees the slot and drops its page refs (the artifact carries
+        page CONTENTS, so the physical pages recycle immediately).
+        False = no decode capacity right now: the slot decodes HERE
+        (mixed-mode fallback) and is marked ``no_migrate`` so it is
+        offered exactly once — graceful degradation, never a stall."""
+        for slot in [s for s, t in self._slots.items()
+                     if t.status is RequestStatus.DECODE
+                     and not t.new_tokens and not t.no_migrate]:
+            tracked = self._slots[slot]
+            if self.migrate_hook(
+                tracked,
+                lambda s=slot, t=tracked: self._package_migration(s, t),
+            ):
+                self.pool = state_cache.evict(self.pool, slot)
+                self._release_pages(slot, tracked)
+                del self._slots[slot]
+                self._free.append(slot)
+                self._free.sort()
+                self._migrations_out += 1
+                self.metrics.record_migration_out()
+            else:
+                tracked.no_migrate = True
 
     # chunk grants a slot can be passed over in a row before it outranks
     # SRPT's shortest-remaining rule (the starvation guard)
@@ -1280,6 +1476,11 @@ class ServingEngine:
             self.metrics.record_prefill_stall(stall_s)
         self._pending_stall_ms += stall_s * 1000
         self._pending_chunk_tokens += chunk_tokens
+        if self.migrate_hook is not None:
+            # prefill-tier handoff BEFORE the tick: a slot that just
+            # finished prefill migrates out without decoding a single
+            # token here (zero replayed tokens by construction)
+            self._migrate_ready()
         if not any(t.status is RequestStatus.DECODE
                    for t in self._slots.values()):
             # nothing decodable yet (empty engine, or every resident slot
@@ -1402,6 +1603,16 @@ class ServingEngine:
                 request_record["prefix_hit"] = tracked.cache_hit
             if tracked.preempted:
                 request_record["preemptions"] = tracked.preempted
+            if tracked.migrations:
+                # the disaggregated handoff trail: how many times this
+                # request moved tiers, the host time the moves cost,
+                # and the prefill replica that produced the artifact
+                # (this record's own `replica` stamp is the target)
+                request_record["migrations"] = tracked.migrations
+                request_record["migration_ms"] = round(
+                    tracked.migration_ms, 3)
+                request_record["migration_source"] = \
+                    tracked.migration_source
             if tracked.priority != self.scheduler.default_priority:
                 request_record["priority"] = tracked.priority
             self.metrics.record_request(request_record)
@@ -1457,10 +1668,14 @@ class ServingEngine:
             model_shards=(self.model_shards if self.model_shards > 1
                           else None),
             preemptions=self._preemptions,
+            migrations_out=self._migrations_out,
+            migrations_in=self._migrations_in,
             **pc_gauges,
             **kv_gauges,
         )
         self._preemptions = 0
+        self._migrations_out = 0
+        self._migrations_in = 0
         self._pending_stall_ms = 0.0
         self._pending_chunk_tokens = 0
         self._pending_chunk_real_tokens = 0

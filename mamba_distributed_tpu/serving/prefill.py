@@ -22,6 +22,13 @@ calls:
     in the request's slot (state_cache.stash_prefill) when its per-tick
     token budget runs out.
 
+The chunk carry is also the DISAGGREGATION currency: on a prefill-tier
+replica (docs/SERVING.md "Disaggregated tiers") the completed prompt's
+carry + last logits — the exact outputs the last chunk step returns —
+become the O(1) migration artifact a decode replica restores, so
+splitting the phases across replicas costs one host round-trip of the
+same snapshot prefix caching and preemption already move.
+
 Parity: the engine and ``generate()`` run the SAME jitted chunk step
 over the SAME padded chunk layout with params cast by the SAME jitted
 cast, so their prefill states — and therefore token streams — are

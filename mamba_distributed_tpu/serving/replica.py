@@ -38,18 +38,42 @@ class ReplicaState(enum.Enum):
     DEAD = "dead"          # failed over; never stepped again
 
 
+# the disaggregated prefill/decode tiers (docs/SERVING.md
+# "Disaggregated tiers"): "mixed" is the exact pre-disagg status quo;
+# "prefill" replicas take the long prompts, run the chunked prefill
+# and MIGRATE the finished carry out (the router installs
+# engine.migrate_hook); "decode" replicas take short prompts and
+# migrated-in artifacts, never a long prompt's prefill
+REPLICA_ROLES = ("mixed", "prefill", "decode")
+
+
 class EngineReplica:
     """One ``ServingEngine`` + the host-side routing state around it.
 
-    The router reads ``place_cost()`` for least-loaded placement,
-    ``drain()`` to retire the replica gracefully, and ``mark_dead()``
-    on failure (requeueing is the router's job — it owns the request
-    records; the replica only stops accepting and ticking).
+    The router reads ``place_cost()`` for least-loaded placement
+    (applied WITHIN the role-filtered tier — see ``role`` and
+    serving/router._role_filter), ``drain()`` to retire the replica
+    gracefully, and ``mark_dead()`` on failure (requeueing is the
+    router's job — it owns the request records; the replica only stops
+    accepting and ticking).
+
+    ``role`` ("mixed" default) assigns the replica to a disaggregated
+    tier: the router routes long prompts (above
+    ``cfg.disagg_prompt_threshold``) to "prefill" replicas — whose
+    engines hand the finished carry off via ``migrate_hook`` instead
+    of decoding — and short prompts plus migrated-in artifacts to
+    "decode"/"mixed" replicas.  "mixed" everywhere (or threshold 0) is
+    the exact pre-disagg fabric.
     """
 
     def __init__(self, replica_id: int, params: dict, cfg, *, mesh=None,
                  metrics: ServingMetrics | None = None, tracer=NULL_TRACER,
-                 **engine_kw):
+                 role: str = "mixed", **engine_kw):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"role must be one of {REPLICA_ROLES}, got {role!r}"
+            )
+        self.role = role
         self.replica_id = replica_id
         if metrics is None:
             metrics = ServingMetrics(engine_kw.get("capacity", 8),
@@ -89,16 +113,22 @@ class EngineReplica:
     # ---------------------------------------------------------- placement
 
     def place_cost(self, request=None) -> float:
-        """Least-loaded placement cost (lower is better): queued +
-        resident work per slot, plus KV page-pool pressure for hybrid
-        engines — a replica whose pages are nearly gone would make a
-        new hybrid request WAIT at admission even with slots free, so
-        free pages weigh in next to queue depth.  Prefix-cache
-        AFFINITY discounts a replica whose cache already holds this
-        prompt's prefix (engine.prefix_hit_fraction, a pure probe):
-        skipping a preamble's prefill is worth more than an idle cold
-        replica, so shared-prefix traffic converges on warm caches
-        instead of spraying cold prefills across the fabric."""
+        """Placement cost (lower is better) — one of the THREE terms of
+        the router's placement contract, which is NOT plain least-
+        loaded: (1) the router first filters candidates by ROLE (long
+        prompts -> the prefill tier, shorts and migrated artifacts ->
+        decode/mixed; serving/router._role_filter — this method never
+        sees replicas outside the request's tier), then picks the
+        lowest cost = (2) load: queued + resident work per slot, plus
+        KV page-pool pressure for hybrid engines — a replica whose
+        pages are nearly gone would make a new hybrid request WAIT at
+        admission even with slots free, so free pages weigh in next to
+        queue depth — minus (3) prefix-cache AFFINITY (PR 9): the
+        fraction of this prompt's prefill the replica's cache could
+        skip (engine.prefix_hit_fraction, a pure probe) — skipping a
+        preamble's prefill is worth more than an idle cold replica, so
+        shared-prefix traffic converges on warm caches instead of
+        spraying cold prefills across the fabric."""
         eng = self.engine
         load = (eng.scheduler.depth + len(eng._slots)) / eng.capacity
         if eng.hybrid:

@@ -10,15 +10,32 @@ PAPERS.md), with the device side a pjit-style sharding-annotation
 problem ("Scalable Training of Language Models using JAX pjit and
 TPUv4").
 
-Placement is LEAST-LOADED: each submit picks the accepting replica
+Placement is ROLE-FILTERED least-loaded: candidates are first
+restricted to the request's tier when the fabric is disaggregated
+(``roles=`` + ``cfg.disagg_prompt_threshold`` — long prompts to the
+prefill tier, shorts to decode/mixed replicas; all-"mixed" roles are
+the exact pre-disagg status quo), then each submit picks the replica
 with the lowest ``place_cost`` (queued + resident work per slot, plus
 KV page-pool pressure for hybrids, minus prefix-cache AFFINITY — the
 fraction of the prompt a replica's prefix cache could skip, so
 shared-preamble traffic converges on warm caches;
-serving/prefix_cache.py), stamped as a ``serving_route`` span.  ``drain(replica_id)`` retires a replica gracefully — no new
+serving/prefix_cache.py), stamped as a ``serving_route`` span.
+``drain(replica_id)`` retires a replica gracefully — no new
 placements, in-flight requests finish.  ``fail(replica_id)`` is
 failover: the dead replica's unfinished requests REQUEUE onto the
 survivors.
+
+Disaggregated tiers (docs/SERVING.md "Disaggregated tiers"): a
+prefill-role replica runs a long prompt's chunked prefill, then its
+engine's ``migrate_hook`` (installed here) hands the finished O(1)
+carry snapshot — plus hybrid KV page contents — to ``_migrate_from``,
+which re-places it on the least-loaded decode replica
+(``submit_migrated`` -> ``state_cache.restore``): the resumed stream
+is bit-exact, no re-prefill, no replayed token, one ``serving_migrate``
+span on the SAME trace id so the exported timeline draws the handoff
+as one flow chain.  When no decode replica accepts, the hook declines
+and the prefill replica decodes locally — mixed-mode fallback, never
+a stall.
 
 Failover preserves the token contract — no request lost, no duplicate
 tokens — by leaning on the engine parity invariant: a request's stream
@@ -103,6 +120,16 @@ class RequestRouter:
       retain_results: keep finished GenerationResults in ``.results``
         (what ``run()`` reads); a long-lived streaming server should
         pass False and consume TokenEvents.
+      roles: per-replica tier assignment (len == num_replicas; each
+        "mixed" | "prefill" | "decode" — serving/replica.REPLICA_ROLES).
+        None (default) = all "mixed", the exact pre-disagg fabric.
+        With prefill/decode roles AND a positive threshold, placement
+        is role-filtered and prefill replicas migrate finished carries
+        to the decode tier (the module docstring's handoff).
+      disagg_prompt_threshold: prompt-token cutoff above which a
+        request routes to the prefill tier; None (default) takes
+        ``cfg.disagg_prompt_threshold`` (0 = role-blind routing even
+        if roles were assigned).
       engine_kw: forwarded to every ServingEngine (max_top_k,
         tokens_per_tick, prefill_tokens_per_tick, mesh, ...).
     """
@@ -110,7 +137,8 @@ class RequestRouter:
     def __init__(self, params: dict, cfg, num_replicas: int | None = None,
                  capacity: int = 8, *, jsonl_path: str | None = None,
                  tracer=NULL_TRACER, replica_tracers=None,
-                 retain_results: bool = True, **engine_kw):
+                 retain_results: bool = True, roles=None,
+                 disagg_prompt_threshold: int | None = None, **engine_kw):
         if num_replicas is None:
             num_replicas = cfg.serving_replicas
         if num_replicas < 1:
@@ -120,9 +148,18 @@ class RequestRouter:
                 f"replica_tracers has {len(replica_tracers)} tracer(s) "
                 f"for {num_replicas} replica(s) — need one per replica"
             )
+        if roles is not None and len(roles) != num_replicas:
+            raise ValueError(
+                f"roles has {len(roles)} entr(ies) for {num_replicas} "
+                f"replica(s) — need one per replica"
+            )
         self.cfg = cfg
         self.tracer = tracer
         self.retain_results = retain_results
+        self.disagg_prompt_threshold = (
+            cfg.disagg_prompt_threshold if disagg_prompt_threshold is None
+            else disagg_prompt_threshold
+        )
         if jsonl_path:
             open(jsonl_path, "w").close()  # one fresh stream, all replicas
         self.replicas: list[EngineReplica] = []
@@ -134,8 +171,22 @@ class RequestRouter:
             self.replicas.append(EngineReplica(
                 i, params, cfg, metrics=metrics,
                 tracer=(replica_tracers[i] if replica_tracers else tracer),
+                role=(roles[i] if roles else "mixed"),
                 capacity=capacity, retain_results=False, **engine_kw,
             ))
+        if self.disagg_prompt_threshold > 0:
+            # threshold 0 keeps roles inert — no role filter AND no
+            # migration, the exact pre-disagg fabric
+            for rep in self.replicas:
+                if rep.role == "prefill":
+                    # the disaggregated handoff: at each prefill-
+                    # complete the engine offers the request here
+                    # before decoding
+                    rep.engine.migrate_hook = (
+                        lambda tracked, package, _src=rep:
+                        self._migrate_from(_src, tracked, package)
+                    )
+        self.migrations = 0  # successful cross-replica handoffs
         self._routed: dict[int, _Routed] = {}
         self._by_local: dict[tuple[int, int], _Routed] = {}
         self._next_id = 0
@@ -161,20 +212,47 @@ class RequestRouter:
         self._routed[routed.global_id] = routed
         return routed.global_id
 
+    def _role_filter(self, cands: list[EngineReplica],
+                     request: GenerationRequest) -> list[EngineReplica]:
+        """Restrict placement candidates to the request's tier when the
+        fabric is disaggregated: long prompts (above
+        ``disagg_prompt_threshold`` tokens) go to prefill-role replicas
+        (mixed next), shorts to decode/mixed replicas — a decode
+        replica never admits a long prompt's prefill through the
+        normal path.  Falls back to the unfiltered candidates when the
+        preferred tier has nothing accepting (graceful degradation:
+        a missing tier must never strand a request), and is the
+        identity with threshold 0 or an all-mixed fabric."""
+        thr = self.disagg_prompt_threshold
+        if thr <= 0 or all(r.role == "mixed" for r in self.replicas):
+            return cands
+        if len(request.prompt_ids) > thr:
+            tier = ([r for r in cands if r.role == "prefill"]
+                    or [r for r in cands if r.role == "mixed"])
+        else:
+            tier = [r for r in cands if r.role in ("decode", "mixed")]
+        return tier or cands
+
     def _place(self, routed: _Routed) -> None:
-        """Least-loaded placement (one ``serving_route`` span): lowest
-        ``place_cost`` among accepting replicas, ties to the lowest id."""
+        """Role-filtered least-loaded placement (one ``serving_route``
+        span): lowest ``place_cost`` among the accepting replicas of
+        the request's tier, ties to the lowest id."""
         cands = [r for r in self.replicas if r.accepting]
         if not cands:
             raise RuntimeError(
                 "no accepting replicas (all draining or dead); request "
                 "not placed"
             )
+        cands = self._role_filter(cands, routed.request)
         cost, rep = min(((r.place_cost(routed.request), r) for r in cands),
                         key=lambda cr: (cr[0], cr[1].replica_id))
         attrs = dict(request_id=routed.global_id, replica=rep.replica_id,
                      trace=routed.trace_id, cost=round(cost, 4),
                      queue_depth=rep.engine.scheduler.depth)
+        if rep.role != "mixed" and self.disagg_prompt_threshold > 0:
+            # disagg fabrics only: with threshold 0 roles are inert and
+            # spans stay byte-stable vs a role-less router
+            attrs["role"] = rep.role
         if rep.engine.hybrid:
             attrs["free_pages"] = rep.engine.page_pool.free_pages
         # propagate the entry's trace id through the request object only
@@ -189,6 +267,74 @@ class RequestRouter:
             routed.request.trace_id = prev_trace
         routed.replica_id, routed.local_id = rep.replica_id, local_id
         self._by_local[(rep.replica_id, local_id)] = routed
+
+    # ------------------------------------------------ disaggregated handoff
+
+    def _migrate_from(self, source: EngineReplica, tracked, package) -> bool:
+        """The migration hook installed on prefill-tier replicas'
+        engines (``ServingEngine.migrate_hook``): called at each
+        prefill-complete with the engine's tracked request and a
+        zero-arg packager.  Picks the least-loaded accepting
+        decode-role replica (mixed replicas next; never the source),
+        serializes the O(1) carry (+ hybrid KV pages) snapshot, and
+        re-places the request there via ``submit_migrated`` — one
+        ``serving_migrate`` span carrying the SAME trace id as the
+        rest of the request's journey, so ``scripts/trace_export.py``
+        draws the cross-replica handoff as a flow arrow in the chain
+        prefill replica -> migration -> decode replica.  Returns False
+        (the prefill replica decodes locally — mixed-mode fallback,
+        never a stall) when no tier-compatible replica accepts or
+        every candidate rejects the artifact's page reservation."""
+        routed = self._by_local.get((source.replica_id, tracked.request_id))
+        if routed is None:
+            return False  # not a router-managed request
+        cands = [r for r in self.replicas
+                 if r.accepting and r is not source and r.role == "decode"]
+        if not cands:
+            cands = [r for r in self.replicas
+                     if r.accepting and r is not source
+                     and r.role == "mixed"]
+        if not cands:
+            return False
+        # place_cost WITHOUT the request: a migration artifact runs no
+        # prefill, so the prefix-cache affinity discount (an
+        # O(prompt_len) probe per candidate) would both waste host time
+        # and skew the restore toward cache-warm-but-busier replicas —
+        # plain load + page pressure is the cost a restore actually has
+        cands.sort(key=lambda r: (r.place_cost(), r.replica_id))
+        snap = package()
+        for rep in cands:
+            attrs = dict(request_id=routed.global_id,
+                         trace=routed.trace_id,
+                         source=source.replica_id,
+                         target=rep.replica_id,
+                         package_ms=round(snap["package_ms"], 3))
+            if "kv_len" in snap:
+                attrs["kv_pages"] = snap["n_live"]
+            # propagate the entry's trace id for the duration of the
+            # submit, exactly like _place — one request journey, one
+            # trace, however many replicas it visits
+            prev_trace = routed.request.trace_id
+            routed.request.trace_id = routed.trace_id
+            try:
+                with self.tracer.span("serving_migrate", **attrs):
+                    local_id = rep.engine.submit_migrated(
+                        routed.request, snap,
+                        source_replica=source.replica_id,
+                    )
+            except ValueError:
+                # this replica can never hold the reservation (e.g. a
+                # sharded page pool narrower than the request) — try
+                # the next candidate
+                continue
+            finally:
+                routed.request.trace_id = prev_trace
+            self._by_local.pop((source.replica_id, routed.local_id), None)
+            routed.replica_id, routed.local_id = rep.replica_id, local_id
+            self._by_local[(rep.replica_id, local_id)] = routed
+            self.migrations += 1
+            return True
+        return False
 
     # ------------------------------------------------------------ lifecycle
 

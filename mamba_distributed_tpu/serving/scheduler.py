@@ -159,6 +159,15 @@ class _Tracked:
     # prefix-cache outcome at admission: "full" | "partial" | None
     # (miss / cache off) — stamps the request record + TTFT split
     cache_hit: str | None = None
+    # --- disaggregated prefill/decode migration (serving/router.py,
+    # serving/engine.py).  no_migrate marks a request the migration
+    # hook must skip: it already arrived here VIA migration (or the
+    # hook declined once — mixed-mode fallback decodes it locally), so
+    # re-offering it every step would ping-pong between tiers.
+    no_migrate: bool = False
+    migrations: int = 0  # prefill->decode handoffs this request took
+    migration_ms: float = 0.0  # host time spent packaging + restoring
+    migration_source: int | None = None  # replica id that prefilled
 
 
 class FCFSScheduler:
@@ -227,9 +236,13 @@ class FCFSScheduler:
         snapshot), or None.  The engine resumes these even when the
         queue's best request is stalled on KV pages: a swap-in needs no
         pages, and running it is the only way the pages it pins ever
-        release (serving/engine._resume_parked)."""
+        release (serving/engine._resume_parked).  MIGRATED-in snapshots
+        (the disaggregated prefill->decode artifact) are skipped: they
+        carry page CONTENTS and re-allocate their full reservation at
+        restore, so unlike a preempted swap-in they compete for the
+        very pages the stalled head is waiting on."""
         for i, t in enumerate(self._queue):
-            if t.snapshot is not None:
+            if t.snapshot is not None and not t.snapshot.get("migrated"):
                 del self._queue[i]
                 return t
         return None

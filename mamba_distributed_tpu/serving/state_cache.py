@@ -410,6 +410,37 @@ def copy_page(attn_blocks, src: jax.Array, dst: jax.Array):
     return jax.tree.map(cp, attn_blocks)
 
 
+@jax.jit
+def read_pages(attn_blocks, page_ids: jax.Array):
+    """Gather physical pages ``page_ids`` (n,) out of every attention
+    layer's K and V pool (page axis 1 of the (A, P+1, nkv, page, hd)
+    leaves) -> (A, n, nkv, page, hd) leaves, logical order.  The
+    serialization half of the disaggregated prefill->decode MIGRATION
+    artifact (serving/engine._package_migration): the prefill replica
+    reads the request's live pages here and ``jax.device_get``s them
+    alongside the O(1) conv/SSM carry.  NOT donated — the source pool
+    lives on; ``page_ids`` is traced, so one trace serves every page
+    set of a given (pow2-bucketed) count."""
+    return jax.tree.map(
+        lambda p: jnp.take(p, page_ids, axis=1), attn_blocks
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def write_pages(attn_blocks, data, page_ids: jax.Array):
+    """Scatter serialized page ``data`` (read_pages layout) into
+    physical pages ``page_ids`` of the donated pool — the restore half
+    of the migration artifact, run on the DECODE replica against its
+    own freshly allocated page ids.  ``page_ids`` is traced (one trace
+    per bucketed count); pad entries may point at the trash page 0,
+    whose contents are garbage by contract (masked writes land there),
+    so bucket padding never corrupts a live page."""
+    return jax.tree.map(
+        lambda p, d: p.at[:, page_ids].set(d.astype(p.dtype)),
+        attn_blocks, data,
+    )
+
+
 def _write_blocks(pool_state, slot: jax.Array, state):
     """Write a batch-1 ``{"blocks": ...}`` pytree into ``slot`` of the
     (L, S, ...) conv+SSM pool leaves (shared by insert / stash_prefill /

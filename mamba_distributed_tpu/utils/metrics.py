@@ -196,6 +196,14 @@ class ServingMetrics:
         self.prefix_ttft_miss_ms = StreamingHistogram()
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
+        # disaggregated prefill/decode handoffs (docs/SERVING.md
+        # "Disaggregated tiers"): migrations OUT of this engine (a
+        # prefill replica exporting its finished carry) vs IN (a
+        # decode replica restoring one), with the per-handoff host
+        # latency (packaging + restore dispatch)
+        self.migrations_out = 0
+        self.migrations_in = 0
+        self.migration_ms = StreamingHistogram()
         # same deferred-truncation contract as MetricsLogger/SpanTracer:
         # a reused path starts fresh on the first write unless
         # preserve_history() ran, so two runs can never interleave
@@ -278,6 +286,18 @@ class ServingMetrics:
         """One priority swap-out (serving/engine._preempt)."""
         self.preemptions += 1
 
+    def record_migration_out(self) -> None:
+        """One prefill-complete carry exported to another replica
+        (serving/engine._migrate_ready on a prefill-tier engine)."""
+        self.migrations_out += 1
+
+    def record_migration_in(self, dt_ms: float) -> None:
+        """One migration artifact restored into a slot here
+        (serving/engine._resume); ``dt_ms`` is the handoff's host
+        latency — source-side packaging + this restore's dispatch."""
+        self.migrations_in += 1
+        self.migration_ms.record(dt_ms)
+
     # ------------------------------------------------- per-request latency
 
     def record_queue_wait(self, dt_s: float) -> None:
@@ -314,6 +334,8 @@ class ServingMetrics:
         traces: list | None = None,
         model_shards: int | None = None,
         preemptions: int = 0,
+        migrations_out: int = 0,
+        migrations_in: int = 0,
         prefix_hits: int | None = None,
         prefix_misses: int | None = None,
         prefix_saved_tokens: int | None = None,
@@ -357,6 +379,9 @@ class ServingMetrics:
         the record byte-stable), all host-side.  ``preemptions``
         counts priority swap-outs in the window (stamped only when
         nonzero).
+        ``migrations_out``/``migrations_in`` count disaggregated-tier
+        handoffs exported/restored in the window (stamped only when
+        nonzero; docs/SERVING.md "Disaggregated tiers").
         ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
         engines) gauge the page pool at this tick, with
         ``kv_page_allocs``/``kv_page_frees`` the allocator churn in the
@@ -407,6 +432,12 @@ class ServingMetrics:
             record["model_shards"] = model_shards
         if preemptions:
             record["preemptions"] = preemptions
+        if migrations_out:
+            # disaggregated-tier handoffs in the window (stamped only
+            # when live, so non-disagg streams stay byte-stable)
+            record["migrations_out"] = migrations_out
+        if migrations_in:
+            record["migrations_in"] = migrations_in
         if prefix_hits is not None:
             record.update({
                 "prefix_hits": prefix_hits,
@@ -469,6 +500,11 @@ class ServingMetrics:
             "prefill_stall_ms": self.prefill_stall_ms.summary(),
             "finished_requests": self.finished_requests,
             "preemptions": self.preemptions,
+            "migrations": {
+                "out": self.migrations_out,
+                "in": self.migrations_in,
+                "migration_ms": self.migration_ms.summary(),
+            },
             "prefix_cache": (None if not self._prefix_cache_on else {
                 "full_hits": self.prefix_full_hits,
                 "partial_hits": self.prefix_partial_hits,

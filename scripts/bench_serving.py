@@ -55,6 +55,18 @@ outright), and the partial-hit TTFT of never-seen suffixes — the
 BENCH_SERVING.json ``shared_prefix_cpu`` row, gated via
 ``scripts/bench_gate.py --case shared_prefix_cpu``.
 
+``--disagg`` is the disaggregated-tier headline (docs/SERVING.md
+"Disaggregated tiers"): the ``--long-prompt`` mix — SERVE_LONG_COUNT
+longs submitted ahead of a short mix — served by a (1 prefill +
+SERVE_DECODE_REPLICAS decode) fabric vs the SAME total replica count
+all-mixed.  Long prompts route to the prefill tier
+(SERVE_DISAGG_THRESHOLD, default SERVE_PROMPT_MAX) and migrate their
+finished carry to the decode tier, so short requests never share a
+replica with chunk work; the record reports short-request TTFT/ITL
+p95 for both fabrics, the TTFT speedup, and the migration count +
+latency — the BENCH_SERVING.json ``disagg_cpu`` row, gated via
+``scripts/bench_gate.py --case disagg_cpu``.
+
 ``--long-prompt`` switches to the head-of-line-blocking workload: a few
 LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
 submitted AHEAD of the usual short mix, and the same workload runs
@@ -125,6 +137,100 @@ def _p95(xs):
     import numpy as np
 
     return round(float(np.percentile(xs, 95)), 3) if xs else None
+
+
+def _disagg_bench(cfg, params, requests, capacity, tokens_per_tick,
+                  budget, short_max_len, decode_replicas, threshold,
+                  jsonl):
+    """The disaggregated-tier comparison: the same long+short workload
+    through a (1 prefill + N decode) role fabric and through an
+    all-mixed fabric of the SAME total replica count.  Short-request
+    TTFT/ITL come from the jsonl request records (shorts =
+    prompt_tokens <= short_max_len); migration latency from the decode
+    replicas' metrics.  Returns (record fields, the disagg run's
+    per-replica summary)."""
+    import os as _os
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.obs.export import load_jsonl
+    from mamba_distributed_tpu.obs.histogram import StreamingHistogram
+    from mamba_distributed_tpu.serving import GenerationRequest, RequestRouter
+
+    n_replicas = 1 + decode_replicas
+    roles = ["prefill"] + ["decode"] * decode_replicas
+
+    def fresh():
+        # per-run request objects: ids/streams are per-submit
+        return [GenerationRequest(
+            prompt_ids=np.asarray(r.prompt_ids),
+            max_new_tokens=r.max_new_tokens, seed=r.seed,
+        ) for r in requests]
+
+    kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+    if budget is not None:
+        kw["prefill_tokens_per_tick"] = budget
+    out = {}
+    summary = None
+    migration_hist = None
+    migrations = 0
+    for mode in ("disagg", "mixed"):
+        mode_kw = dict(kw)
+        if mode == "disagg":
+            mode_kw.update(roles=roles, disagg_prompt_threshold=threshold)
+        # warm every jit signature (incl. the migrate restore path)
+        RequestRouter(params, cfg, num_replicas=n_replicas,
+                      **mode_kw).run(fresh())
+        _progress(f"{mode}: warm")
+        tmp_path = None
+        if mode == "disagg" and jsonl:
+            path = jsonl
+        else:
+            fd, tmp_path = tempfile.mkstemp(suffix=f"_{mode}.jsonl")
+            _os.close(fd)
+            path = tmp_path
+        router = RequestRouter(params, cfg, num_replicas=n_replicas,
+                               jsonl_path=path, **mode_kw)
+        t0 = _time.perf_counter()
+        router.run(fresh())
+        out[f"wall_s_{mode}"] = round(_time.perf_counter() - t0, 3)
+        recs = [e for e in load_jsonl(path) if e.get("kind") == "request"]
+        if tmp_path is not None:
+            _os.unlink(tmp_path)
+        shorts = [e for e in recs
+                  if e["prompt_tokens"] <= short_max_len]
+        out[f"ttft_short_p95_ms_{mode}"] = _p95(
+            [e["ttft_ms"] for e in shorts])
+        itl = None
+        for e in shorts:
+            h = e.get("itl_hist")
+            if h and h.get("count"):
+                h = StreamingHistogram.from_dict(h)
+                itl = h if itl is None else itl.merge(h)
+        out[f"itl_short_p95_ms_{mode}"] = (
+            round(itl.percentile(95), 3) if itl is not None else None)
+        if mode == "disagg":
+            summary = router.summary()
+            migrations = router.migrations
+            for rep in router.replicas:
+                h = rep.engine.metrics.migration_ms
+                if migration_hist is None:
+                    migration_hist = StreamingHistogram(h.lo, h.hi,
+                                                        h.growth)
+                migration_hist.merge(h)
+        _progress(f"{mode}: short TTFT p95 "
+                  f"{out[f'ttft_short_p95_ms_{mode}']} ms, short ITL "
+                  f"p95 {out[f'itl_short_p95_ms_{mode}']} ms")
+    a, b = out["ttft_short_p95_ms_mixed"], out["ttft_short_p95_ms_disagg"]
+    out["ttft_short_p95_speedup"] = round(a / b, 2) if a and b else None
+    a, b = out["itl_short_p95_ms_mixed"], out["itl_short_p95_ms_disagg"]
+    out["itl_short_p95_speedup"] = round(a / b, 2) if a and b else None
+    out["migrations"] = migrations
+    out["migration_ms"] = (migration_hist.summary()
+                           if migration_hist is not None else None)
+    return out, summary
 
 
 def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
@@ -278,6 +384,13 @@ def main() -> None:
     ap.add_argument("--long-prompt", action="store_true",
                     help="mixed long+short workload; report short-request "
                          "TTFT p95 with chunked vs one-shot prefill")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated tiers: the --long-prompt mix "
+                         "through a (1 prefill + SERVE_DECODE_REPLICAS "
+                         "decode) role fabric vs the same replica count "
+                         "all-mixed; report short-request TTFT/ITL p95 "
+                         "for both and the migration count/latency — "
+                         "the BENCH_SERVING.json disagg row")
     ap.add_argument("--shared-prefix", action="store_true",
                     help="prefix-cache workload: N requests sharing a "
                          "long preamble (SERVE_SHARED_PREFIX_LEN, default "
@@ -307,6 +420,7 @@ def main() -> None:
     args = ap.parse_args()
     modes = [m for m, on in [("--long-prompt", args.long_prompt),
                              ("--shared-prefix", args.shared_prefix),
+                             ("--disagg", args.disagg),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
         ap.error(f"{' and '.join(modes)} are separate bench modes; "
@@ -397,6 +511,78 @@ def main() -> None:
             jax.block_until_ready(out)
         dt_seq = time.perf_counter() - t0
         return served, dt_serve, dt_seq, metrics.summary()
+
+    if args.disagg:
+        from mamba_distributed_tpu.serving import GenerationRequest
+
+        long_count = int(os.environ.get("SERVE_LONG_COUNT", "2"))
+        long_len = int(os.environ.get("SERVE_LONG_LEN", "8192"))
+        decode_replicas = int(os.environ.get("SERVE_DECODE_REPLICAS", "1"))
+        threshold = int(os.environ.get("SERVE_DISAGG_THRESHOLD", str(pmax)))
+        if "SERVE_REQUESTS" not in os.environ:
+            # shorts default to one replica's slots: the decode tier
+            # must hold them without queueing, or TTFT measures queue
+            # wait instead of the prefill interference this mode
+            # exists to expose
+            n_requests = capacity
+        if long_len <= max(threshold, cfg.effective_prefill_chunk_tokens):
+            raise SystemExit(
+                f"SERVE_LONG_LEN={long_len} must exceed both the disagg "
+                f"threshold {threshold} and prefill_chunk_tokens="
+                f"{cfg.effective_prefill_chunk_tokens} so the longs "
+                f"actually route to the prefill tier and chunk"
+            )
+        requests = _workload(rng, n_requests, pmin, pmax, max_new,
+                             cfg.vocab_size)
+        longs = [GenerationRequest(
+            prompt_ids=rng.integers(0, cfg.vocab_size, size=long_len)
+            .astype(np.int32),
+            max_new_tokens=max_new, seed=5000 + i,
+        ) for i in range(long_count)]
+        budget_env = os.environ.get("SERVE_PREFILL_BUDGET", "")
+        budget = int(budget_env) if budget_env else None
+        # longs submitted FIRST: the head-of-line worst case the tiers
+        # exist to absorb
+        fields, summary = _disagg_bench(
+            cfg, params, longs + requests, capacity, tokens_per_tick,
+            budget, pmax, decode_replicas, threshold, args.jsonl,
+        )
+        per_replica = {
+            str(rid): {
+                "finished_requests": s["finished_requests"],
+                "migrations_out": s["migrations"]["out"],
+                "migrations_in": s["migrations"]["in"],
+            }
+            for rid, s in summary.items()
+        }
+        record = {
+            "metric": (f"serving_disagg_short_ttft_speedup_"
+                       f"{preset.replace('-', '_')}"),
+            "value": fields["ttft_short_p95_speedup"],
+            "unit": ("x lower short-request TTFT p95, (1 prefill + "
+                     f"{decode_replicas} decode) tiers vs "
+                     f"{1 + decode_replicas} mixed replicas"),
+            **{k: v for k, v in fields.items() if k != "migration_ms"},
+            "migration_ms": fields["migration_ms"],
+            "requests": n_requests,
+            "long_requests": long_count,
+            "long_prompt_len": long_len,
+            "disagg_prompt_threshold": threshold,
+            "decode_replicas": decode_replicas,
+            "prefill_chunk_tokens": cfg.effective_prefill_chunk_tokens,
+            "prefill_tokens_per_tick": (
+                budget if budget is not None else cfg.prefill_tokens_per_tick
+            ),
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "per_replica": per_replica,
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
 
     if args.long_prompt:
         from mamba_distributed_tpu.serving import GenerationRequest

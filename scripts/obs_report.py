@@ -187,6 +187,16 @@ def build_report(events: list[dict]) -> dict:
                 "bytes": pticks[-1].get("prefix_cache_bytes"),
             }
         preemptions = sum(e.get("preemptions", 0) for e in ticks)
+        # disaggregated-tier handoffs (absent unless a disagg fabric
+        # wrote the stream): fabric-wide every handoff is one OUT and
+        # one IN, so the count is the max of the two tick-gauge sums —
+        # a pure prefill replica never ticks (nothing ever decodes
+        # there), so only its decode-side restores reliably reach the
+        # tick stream
+        handoffs = max(
+            sum(e.get("migrations_out", 0) for e in ticks),
+            sum(e.get("migrations_in", 0) for e in ticks),
+        )
         # goodput accounting (absent in pre-goodput streams): useful
         # tokens vs computed token lanes per tick window, plus the
         # host-computed serving MFU (window-weighted mean, so long
@@ -240,6 +250,7 @@ def build_report(events: list[dict]) -> dict:
             "goodput": goodput,
             "prefix_cache": prefix,
             "preemptions": preemptions,
+            "migrations": {"handoffs": handoffs} if handoffs else None,
             "kv_pages": kv_pages,
         }
 
@@ -352,6 +363,28 @@ def build_report(events: list[dict]) -> dict:
             report["requests"]["ttft_miss_ms"] = _pcts(
                 [e["ttft_ms"] for e in stamped
                  if not e["prefix_hit"] and e.get("ttft_ms") is not None])
+        # disaggregated-tier migrations (docs/SERVING.md "Disaggregated
+        # tiers"): migrated request records carry the handoff trail —
+        # count, host latency, prefill-source -> decode-target replica
+        # pair — rendered as its own table when any request migrated
+        migrated = [e for e in reqs if e.get("migrations")]
+        if migrated:
+            routes: dict[str, int] = {}
+            for e in migrated:
+                pair = (f"{_fmt(e.get('migration_source'))}->"
+                        f"{_fmt(e.get('replica'))}")
+                routes[pair] = routes.get(pair, 0) + 1
+            report["migrations"] = {
+                "requests": len(migrated),
+                "total_handoffs": sum(e["migrations"] for e in migrated),
+                "migration_ms": _pcts(
+                    [e["migration_ms"] for e in migrated
+                     if e.get("migration_ms") is not None]),
+                "ttft_ms": _pcts(
+                    [e["ttft_ms"] for e in migrated
+                     if e.get("ttft_ms") is not None]),
+                "routes": dict(sorted(routes.items())),
+            }
 
     # --- SLO attainment (obs/slo.py): the monitor stamps its targets
     # into the stream as an slo_config event, so attainment is
@@ -497,6 +530,10 @@ def format_report(report: dict) -> str:
             )
         if s.get("preemptions"):
             head += f"\npreemptions: {s['preemptions']}"
+        if s.get("migrations"):
+            head += (f"\ntier migrations: "
+                     f"{s['migrations']['handoffs']} prefill->decode "
+                     f"handoff(s)")
         if s.get("kv_pages"):
             kv = s["kv_pages"]
             head += (
@@ -531,6 +568,21 @@ def format_report(report: dict) -> str:
                    "mean_occ", "peak_queue", "min_kv_free",
                    "itl_p50/p95"]
         ))
+    if "migrations" in report:
+        m = report["migrations"]
+        rows = [_pct_row("migration_ms", m["migration_ms"]),
+                _pct_row("ttft_ms (migrated)", m["ttft_ms"])]
+        routes = "   ".join(f"{pair}: {n}"
+                            for pair, n in m["routes"].items())
+        out.append(
+            f"== migrations (disaggregated tiers) ==\n"
+            f"migrated requests: {m['requests']}   handoffs: "
+            f"{m['total_handoffs']}   routes (src->dst replica): "
+            f"{routes}\n"
+            + _table(rows,
+                     ["metric", "count", "mean", "p50", "p95", "p99",
+                      "max"])
+        )
     if "slo" in report:
         s = report["slo"]
         rows = [

@@ -191,6 +191,64 @@ def test_bench_serving_shared_prefix_smoke(tmp_path):
 
 
 @pytest.mark.serving
+@pytest.mark.disagg
+def test_bench_serving_disagg_smoke(tmp_path):
+    """CI smoke for the disaggregated-tier bench: ``--disagg`` must run
+    the role fabric AND the mixed baseline end-to-end, report the
+    short-request TTFT/ITL split with at least one real migration, and
+    gate against the committed BENCH_SERVING.json ``disagg_cpu`` row
+    (ISSUE 10 satellite)."""
+    import json
+
+    jsonl = str(tmp_path / "dg.jsonl")
+    json_out = str(tmp_path / "dg.json")
+    env = dict(os.environ)
+    # mamba2-tiny has chunk_size=64 -> 64-token chunks; a 160-token
+    # long exceeds the default threshold (= SERVE_PROMPT_MAX = 8), so
+    # it routes to the prefill tier and chunks there
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="2", SERVE_CAPACITY="3",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="8",
+               SERVE_MAX_NEW="4", SERVE_TOKENS_PER_TICK="2",
+               SERVE_LONG_COUNT="1", SERVE_LONG_LEN="160",
+               SERVE_CHUNK_TOKENS="64", SERVE_PREFILL_BUDGET="64")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--disagg", "--jsonl", jsonl, "--json", json_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["ttft_short_p95_ms_disagg"] is not None
+    assert rec["ttft_short_p95_ms_mixed"] is not None
+    assert rec["itl_short_p95_ms_disagg"] is not None
+    assert rec["migrations"] == 1  # the long took the handoff
+    assert rec["migration_ms"]["count"] == 1
+    assert rec["per_replica"]["0"]["migrations_out"] == 1
+    assert rec["disagg_prompt_threshold"] == 8
+    # the timed disagg run's stream carries the migration stamps
+    recs = [json.loads(ln) for ln in open(jsonl)]
+    assert any(r.get("migrations") for r in recs
+               if r.get("kind") == "request")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "migrations (disaggregated tiers)" in r.stdout
+    # the registered gate path: the committed disagg_cpu row gates this
+    # record's speedup (huge band: the smoke's tiny workload is a
+    # different operating point than the committed default run)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "disagg_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "disagg_cpu" in g.stdout
+
+
+@pytest.mark.serving
 def test_bench_gate_smoke(tmp_path, monkeypatch):
     """CI smoke for the bench regression gate (ISSUE 7 satellite): a
     fresh tiny ``bench_serving --json`` run passes against a baseline

@@ -1,0 +1,375 @@
+"""Disaggregated prefill/decode tier tests (serving/router.py roles +
+the O(1) state migration).
+
+The contract under test, per ISSUE 10's acceptance criteria:
+
+  * MIGRATION PARITY — a long prompt routed to a prefill-role replica
+    chunks there, then its carry (+ hybrid KV pages) migrates to a
+    decode replica at prefill-complete; the resumed stream is
+    BIT-identical to solo ``generate()`` — no re-prefill, no replayed
+    token — for mamba1/mamba2/hybrid, chunked longs, and the (2, 2)
+    tensor-parallel serving mesh.
+  * DEATH MID-MIGRATION — killing the prefill replica while a long
+    prompt is mid-prefill (or already handed off) loses no token and
+    duplicates none: the failover requeue + replay-cursor dedup cover
+    the disaggregated path too.
+  * FALLBACK — when no decode replica accepts, the prefill replica
+    decodes locally (mixed-mode degradation): requests always finish,
+    never stall.
+  * FLAT TRACES — roles + migration add zero jit signatures: a second
+    identical workload retraces nothing.
+
+Runnable standalone: ``pytest -m disagg``.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.serving import (
+    GenerationRequest,
+    RequestRouter,
+)
+
+pytestmark = [pytest.mark.disagg, pytest.mark.serving, pytest.mark.fast]
+
+CHUNK = 16
+
+
+def tiny_cfg(layer="mamba2", **kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    kw.setdefault("disagg_prompt_threshold", CHUNK)
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32", **kw)
+
+
+def hybrid_cfg(**kw):
+    """CPU-runnable hybrid: paged attention KV at layer 1."""
+    return tiny_cfg(attn_layer_idx=(1,), attn_num_heads=4,
+                    attn_num_kv_heads=2, remat=False, kv_page_tokens=8,
+                    kv_slot_tokens=64, **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def solo(params, cfg, prompt, key, mesh=None, **kw):
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None], key,
+                   mesh=mesh, **kw)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def mixed_requests(n_short=3, n_long=2, max_new=6, vocab=64):
+    """Shorts below the disagg threshold plus chunk-spanning longs."""
+    reqs = []
+    for i in range(n_short):
+        reqs.append(GenerationRequest(
+            prompt_ids=rand_prompt(5 + 3 * i, seed=10 + i, vocab=vocab),
+            max_new_tokens=max_new, key=jax.random.PRNGKey(100 + i)))
+    for i in range(n_long):
+        reqs.append(GenerationRequest(
+            prompt_ids=rand_prompt(2 * CHUNK + 7 + i, seed=50 + i,
+                                   vocab=vocab),
+            max_new_tokens=max_new, key=jax.random.PRNGKey(200 + i)))
+    return reqs
+
+
+def assert_parity(params, cfg, requests, results, mesh=None):
+    for r, res in zip(requests, results):
+        want = solo(params, cfg, r.prompt_ids, r.key, mesh=mesh,
+                    max_new_tokens=r.max_new_tokens)
+        assert res.new_tokens.tolist() == want
+
+
+def disagg_router(params, cfg, capacity=3, **kw):
+    kw.setdefault("tokens_per_tick", 2)
+    return RequestRouter(params, cfg, num_replicas=2, capacity=capacity,
+                         roles=["prefill", "decode"], **kw)
+
+
+# ---------------------------------------------------------- migration parity
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1"])
+def test_migration_parity(layer):
+    """Longs prefill on the prefill tier, migrate, and decode on the
+    decode tier — every stream still bit-matches solo generate()."""
+    cfg = tiny_cfg(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests()
+    router = disagg_router(params, cfg)
+    results = router.run(reqs)
+    assert_parity(params, cfg, reqs, results)
+    # every long actually took the handoff (shorts never migrate)
+    assert router.migrations == 2
+    # the prefill replica decoded nothing: all finishes on the decode
+    # tier, and the migration counters split out/in across the tiers
+    s = router.summary()
+    assert s[0]["finished_requests"] == 0
+    assert s[1]["finished_requests"] == len(reqs)
+    assert s[0]["migrations"] == {
+        "out": 2, "in": 0, "migration_ms": s[0]["migrations"]["migration_ms"]}
+    assert s[1]["migrations"]["in"] == 2
+    assert s[1]["migrations"]["migration_ms"]["count"] == 2
+
+
+def test_hybrid_migration_parity_and_page_recycle():
+    """Hybrid migration ships the KV page CONTENTS: the decode replica
+    re-allocates pages in its own pool, streams stay bit-identical,
+    and both pools drain back to zero pages in use."""
+    cfg = hybrid_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests(n_short=2, n_long=2)
+    router = disagg_router(params, cfg, capacity=2)
+    results = router.run(reqs)
+    assert_parity(params, cfg, reqs, results)
+    assert router.migrations == 2
+    for rep in router.replicas:
+        assert rep.engine.page_pool.pages_in_use == 0
+
+
+def test_migration_parity_tp_mesh():
+    """The (2, 2) tensor-parallel serving mesh: migration composes with
+    sharded slot pools + TP weights, streams bit-match generate(mesh=)."""
+    cfg = tiny_cfg(serving_data_shards=2, serving_model_shards=2)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests(n_short=2, n_long=1, max_new=5)
+    router = disagg_router(params, cfg, capacity=2)
+    results = router.run(reqs)
+    mesh = router.replicas[0].engine.mesh
+    assert dict(mesh.shape) == {"data": 2, "model": 2}
+    assert_parity(params, cfg, reqs, results, mesh=mesh)
+    assert router.migrations == 1
+
+
+def test_threshold_zero_is_status_quo():
+    """Roles assigned but threshold 0: routing stays role-blind and no
+    migration ever fires — the exact pre-disagg fabric."""
+    cfg = tiny_cfg(disagg_prompt_threshold=0)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests()
+    router = disagg_router(params, cfg)
+    results = router.run(reqs)
+    assert_parity(params, cfg, reqs, results)
+    assert router.migrations == 0
+
+
+def test_role_validation():
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="role"):
+        RequestRouter(params, cfg, num_replicas=2, capacity=2,
+                      roles=["prefill", "frobnicate"])
+    with pytest.raises(ValueError, match="one per replica"):
+        RequestRouter(params, cfg, num_replicas=2, capacity=2,
+                      roles=["prefill"])
+
+
+# ------------------------------------------------------------ failure paths
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "hybrid"])
+def test_prefill_replica_death_mid_migration(layer):
+    """Kill the prefill replica while a long prompt is still mid-
+    prefill there (and shorts are streaming on the decode tier): the
+    failover requeue re-derives every stream bit-identically — no lost
+    token, no duplicate — even though the long's re-placement must now
+    fall back past its dead tier."""
+    cfg = hybrid_cfg() if layer == "hybrid" else tiny_cfg(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests(n_short=2, n_long=1, max_new=8)
+    router = disagg_router(params, cfg, capacity=4)
+    ids = [router.submit(r) for r in reqs]
+    long_gid = ids[-1]
+    assert router._routed[long_gid].replica_id == 0  # prefill tier
+    streams: dict[int, list] = {i: [] for i in ids}
+    indices: dict[int, list] = {i: [] for i in ids}
+
+    def take(events):
+        for ev in events:
+            streams[ev.request_id].append(ev.token)
+            indices[ev.request_id].append(ev.index)
+
+    # step until the long is mid-prefill on the prefill replica but
+    # has NOT migrated yet — the mid-migration window
+    while (router._routed[long_gid].replica_id == 0
+           and not router.replicas[0].engine._prefill_queue):
+        take(router.step())
+    assert router._routed[long_gid].replica_id == 0
+    take(router.fail(0) and [])  # requeue onto the survivor
+    for _ in range(10_000):
+        if not router.pending:
+            break
+        take(router.step())
+    assert router.pending == 0
+    for gid, req in zip(ids, reqs):
+        want = solo(params, cfg, req.prompt_ids, req.key,
+                    max_new_tokens=req.max_new_tokens)
+        assert streams[gid] == want  # no loss, no dups, bit-identical
+        assert indices[gid] == list(range(len(want)))  # contiguous
+
+
+def test_decode_replica_death_after_migration():
+    """Kill the DECODE replica after the long migrated onto it and
+    started streaming: the failover re-places it (back through the
+    prefill tier, which re-prefills and re-migrates... to nobody —
+    so it decodes locally) and the replay cursor suppresses the
+    already-delivered indices."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests(n_short=1, n_long=1, max_new=8)
+    router = disagg_router(params, cfg, capacity=4)
+    ids = [router.submit(r) for r in reqs]
+    long_gid = ids[-1]
+    streams: dict[int, list] = {i: [] for i in ids}
+
+    def take(events):
+        for ev in events:
+            streams[ev.request_id].append(ev.token)
+
+    # run until the long has migrated AND streamed at least one token
+    while not (router._routed.get(long_gid) is None
+               or (router._routed[long_gid].replica_id == 1
+                   and streams[long_gid])):
+        take(router.step())
+    assert router.migrations == 1
+    router.fail(1)
+    for _ in range(10_000):
+        if not router.pending:
+            break
+        take(router.step())
+    for gid, req in zip(ids, reqs):
+        want = solo(params, cfg, req.prompt_ids, req.key,
+                    max_new_tokens=req.max_new_tokens)
+        assert streams[gid] == want
+
+
+def test_no_decode_capacity_falls_back_to_mixed():
+    """Drain the decode tier: longs still land on the prefill replica,
+    whose migration hook finds nobody accepting and declines — the
+    replica decodes LOCALLY (mixed-mode fallback).  Shorts, whose tier
+    is gone, fall back onto the prefill replica too.  Everything
+    finishes; nothing stalls; zero migrations."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reqs = mixed_requests(n_short=2, n_long=1)
+    router = disagg_router(params, cfg, capacity=4)
+    router.drain(1)
+    ids = [router.submit(r) for r in reqs]
+    assert all(router._routed[g].replica_id == 0 for g in ids)
+    results = router.run([])
+    assert router.migrations == 0
+    assert_parity(params, cfg, reqs,
+                  [router.results[i] for i in ids])
+    del results
+
+
+# ------------------------------------------------------- traces + telemetry
+
+
+def test_flat_trace_counts_with_roles_on():
+    """Roles + migration add no jit signatures: after a warm run, an
+    identical workload retraces nothing (tick and chunk counters pinned
+    flat — the no-retrace contract extends to the disagg fabric)."""
+    from mamba_distributed_tpu.serving.engine import TRACE_COUNTS
+    from mamba_distributed_tpu.serving.prefill import (
+        TRACE_COUNTS as CHUNK_COUNTS,
+    )
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    router = disagg_router(params, cfg)
+    router.run(mixed_requests())  # warm every signature
+    t0, c0 = TRACE_COUNTS["tick"], CHUNK_COUNTS["chunk"]
+    router2 = disagg_router(params, cfg)
+    results = router2.run(mixed_requests())
+    assert router2.migrations == 2
+    assert len(results) == 5
+    assert TRACE_COUNTS["tick"] == t0
+    assert CHUNK_COUNTS["chunk"] == c0
+
+
+def test_migration_telemetry_and_trace_flow(tmp_path):
+    """The handoff is observable end to end: a ``serving_migrate`` span
+    (same trace id as the route), migration stamps on tick/request
+    records, the obs_report migration table, and one Perfetto flow
+    chain spanning prefill replica -> migration -> decode replica."""
+    from mamba_distributed_tpu.obs import SpanTracer
+    from mamba_distributed_tpu.obs.export import export_chrome_trace
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    router_spans = str(tmp_path / "router.jsonl")
+    rep_spans = [str(tmp_path / f"rep{i}.jsonl") for i in range(2)]
+    serve_path = str(tmp_path / "serve.jsonl")
+    router = disagg_router(
+        params, cfg, jsonl_path=serve_path,
+        tracer=SpanTracer(router_spans),
+        replica_tracers=[SpanTracer(p) for p in rep_spans],
+    )
+    reqs = mixed_requests(n_short=1, n_long=1)
+    router.run(reqs)
+    assert router.migrations == 1
+
+    spans = [json.loads(l) for l in open(router_spans)]
+    migrates = [s for s in spans
+                if s.get("kind") == "span" and s["name"] == "serving_migrate"]
+    assert len(migrates) == 1
+    mig = migrates[0]
+    assert mig["source"] == 0 and mig["target"] == 1
+    assert "package_ms" in mig
+    routes = {s["trace"] for s in spans
+              if s.get("kind") == "span" and s["name"] == "serving_route"}
+    assert mig["trace"] in routes  # same trace id spans the handoff
+
+    recs = [json.loads(l) for l in open(serve_path)]
+    migrated_reqs = [r for r in recs
+                     if r["kind"] == "request" and r.get("migrations")]
+    assert len(migrated_reqs) == 1
+    r = migrated_reqs[0]
+    assert r["migrations"] == 1 and r["migration_source"] == 0
+    assert r["replica"] == 1 and r["migration_ms"] > 0
+    # non-migrated records carry NO migration keys (byte-stability)
+    for other in recs:
+        if other["kind"] == "request" and other is not r:
+            assert "migrations" not in other
+    ticks = [t for t in recs if t["kind"] == "serving_tick"]
+    assert sum(t.get("migrations_in", 0) for t in ticks) == 1
+
+    # obs_report renders the migration table from the same stream
+    import scripts.obs_report as obs_report
+
+    report = obs_report.build_report(recs)
+    assert report["migrations"]["requests"] == 1
+    assert report["migrations"]["routes"] == {"0->1": 1}
+    # a pure prefill replica never ticks, so the fabric handoff count
+    # comes from the decode side's tick gauges
+    assert report["serving"]["migrations"] == {"handoffs": 1}
+    assert "migrations (disaggregated tiers)" in obs_report.format_report(
+        report)
+
+    # the exporter draws the handoff as one flow chain: router span(s)
+    # + serving_migrate + the decode replica's serving_resume all share
+    # the migrated request's trace id
+    out = str(tmp_path / "trace.json")
+    meta = export_chrome_trace([router_spans] + rep_spans, out)
+    assert meta["linked_requests"] >= 1
+    doc = json.load(open(out))
+    flows = [e for e in doc["traceEvents"]
+             if e.get("cat") == "request" and e["id"] == mig["trace"]]
+    assert len(flows) >= 3  # route -> migrate -> resume/tick hops
+    resume = [e for e in doc["traceEvents"]
+              if e.get("name") == "serving_resume"
+              and e.get("args", {}).get("trace") == mig["trace"]]
+    assert resume and resume[0]["args"].get("migrated") is True

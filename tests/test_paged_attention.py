@@ -90,6 +90,49 @@ def test_ragged_kernel_ignores_pages_past_length(rng):
     np.testing.assert_array_equal(np.asarray(base), np.asarray(poisoned))
 
 
+def test_lax_gather_live_extent_masks_dead_pages(rng):
+    """``gather_kv_pages(live_pages=)`` — the lax fallback's answer to
+    the kernels' ragged page skip: table entries at or past each row's
+    live extent redirect to the trash page, so the gather's read
+    traffic scales with LIVE tokens (CPU-serving deployments stop
+    paying O(pool) per tick), and poisoned dead pages can't change any
+    output (their positions are hard-masked to -inf downstream)."""
+    q, kp, vp, tbl, kv_len = paged_case(rng, seed_lens=[5, 9, 12, 3])
+    pg = kp.shape[2]
+    live = (np.asarray(kv_len) + pg - 1) // pg
+    live = np.maximum(live, 1).astype(np.int32)
+    kk, _ = gather_kv_pages(kp, vp, tbl, jnp.asarray(live))
+    # unit check: the gathered view holds the trash page past each
+    # row's live extent, the real pages inside it
+    for s in range(tbl.shape[0]):
+        for j in range(tbl.shape[1]):
+            want = kp[tbl[s, j]] if j < live[s] else kp[0]
+            np.testing.assert_array_equal(
+                np.asarray(kk)[s, j * pg:(j + 1) * pg],
+                np.moveaxis(np.asarray(want), 1, 0),
+            )
+    # end-to-end check: poison every dead page — the masked SDPA over
+    # the live-extent gather is bit-identical to the clean full gather
+    ref = _sdpa_positions(
+        q[:, None], *gather_kv_pages(kp, vp, tbl), (kv_len - 1)[:, None]
+    )
+    npg, nvg = np.array(kp), np.array(vp)
+    for s, ln in enumerate(np.asarray(kv_len)):
+        for j in range(tbl.shape[1]):
+            if j >= live[s]:
+                npg[np.asarray(tbl)[s, j]] = 1e9
+                nvg[np.asarray(tbl)[s, j]] = -1e9
+    got = _sdpa_positions(
+        q[:, None],
+        *gather_kv_pages(jnp.asarray(npg), jnp.asarray(nvg), tbl,
+                         jnp.asarray(live)),
+        (kv_len - 1)[:, None],
+    )
+    rows_live = np.asarray(kv_len) > 0
+    np.testing.assert_array_equal(np.asarray(got)[rows_live],
+                                  np.asarray(ref)[rows_live])
+
+
 def test_ragged_kernel_one_trace_across_occupancies(rng):
     """One jit trace covers every occupancy / length mix at a fixed
     (S, W) layout — the serving tick's no-retrace contract."""
