@@ -228,6 +228,33 @@ class ModelConfig:
     # page refs held — no page churn) and it resumes later without
     # re-prefill, mid-stream, bit-exactly.
     serving_default_priority: int = 0
+    # --- quantized serving (ops/quant.py; docs/SERVING.md "Quantized
+    # serving") ---
+    # Serving/decode weight dtype.  "bf16" (default) is the byte-stable
+    # status quo: the decode cast (inference/generate._decode_params)
+    # casts matmul kernels + embedding to ``compute_dtype`` exactly as
+    # before.  "int8" quantizes the same leaves symmetric per-channel
+    # (q int8 + f32 scale per output column for column-parallel params,
+    # per input row for row-parallel, per vocab row for the embedding/
+    # head — the scale axis is always the tensor-parallel axis, so
+    # scales shard with their weight and no cross-shard rescale is ever
+    # needed) and the matmul sites dequantize AT USE: ``(x @ q) * scale``
+    # / ``(x * scale) @ q``, fused by XLA — no materialized full-
+    # precision weight copy.  Both the serving engine and ``generate()``
+    # read this knob through the ONE shared decode cast, so quantized
+    # engine==generate() parity holds by construction (toleranced —
+    # ``ops/quant.assert_stream_close``).
+    serving_weight_dtype: str = "bf16"
+    # KV page-pool dtype (hybrid stacks).  "bf16" (default) stores
+    # pages in ``compute_dtype`` — the byte-stable status quo.  "int8"
+    # stores int8 pages with one f32 scale per (physical page, kv head)
+    # alongside the head-major pools; the ragged Pallas kernels fuse
+    # the dequant into the scalar-prefetched page walk (read int8 tile
+    # -> multiply by scale in-register) and prefill's fused page WRITE
+    # quantizes the chunk's K/V before the one-hot merge.  Halves page
+    # bytes => ~2x pages per chip at fixed pool HBM (the
+    # ``quant_kv_capacity`` bench row).
+    kv_page_dtype: str = "bf16"
     # Tensor-parallel shards of the serving WEIGHTS over `mesh.model`
     # (the 2-D serving mesh's second axis): Mamba d_inner channels,
     # attention heads and the embedding/head vocab axis split across
@@ -343,6 +370,17 @@ class ModelConfig:
                 f"kv_pool_pages must be >= 0 (0 => auto-size from "
                 f"capacity), got {self.kv_pool_pages}"
             )
+        if self.serving_weight_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"serving_weight_dtype must be 'bf16' (the compute-dtype "
+                f"decode cast, the status quo) or 'int8', got "
+                f"{self.serving_weight_dtype!r}"
+            )
+        if self.kv_page_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_page_dtype must be 'bf16' (compute-dtype pages, the "
+                f"status quo) or 'int8', got {self.kv_page_dtype!r}"
+            )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"attn_impl must be 'auto', 'xla' or 'pallas', got "
@@ -399,6 +437,12 @@ class ModelConfig:
         if self.ssm_layer == "mamba2" and c % self.chunk_size:
             return ((c + self.chunk_size - 1) // self.chunk_size) * self.chunk_size
         return c
+
+    @property
+    def kv_quantized(self) -> bool:
+        """True when the paged attention KV pools store int8 pages with
+        per-(page, kv-head) f32 scales (``kv_page_dtype="int8"``)."""
+        return self.kv_page_dtype == "int8"
 
     @property
     def kv_pages_per_slot(self) -> int:

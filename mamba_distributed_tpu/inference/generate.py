@@ -87,8 +87,26 @@ def _decode_params(params: dict, cfg: ModelConfig) -> dict:
     bit-identical because the per-step cast produced the same bf16
     numbers.  Conv kernels, biases, norm weights, SSM scalars and the
     MoE router (routed in fp32) stay fp32 — their math runs in fp32.
+
+    ``cfg.serving_weight_dtype="int8"`` goes further (ops/quant.py):
+    the ``linear()``-routed kernels and the embedding become symmetric
+    per-channel int8 (``{"kernel": int8, "scale": f32}``, scale axis =
+    the tensor-parallel axis) instead of bf16, halving resident weight
+    bytes again; the matmul sites dequantize at use.  The serving
+    engine and ``generate()`` both quantize HERE — one shared cast —
+    so the quantized engine==generate() parity argument mirrors the
+    bf16 one (toleranced: ops/quant.assert_stream_close).  mamba1's
+    dt_proj kernel stays on the bf16 cast (its matmul bypasses
+    ``linear`` — the dt bias folds into the scan's fp32 delta path).
     """
     cd = jnp.dtype(cfg.compute_dtype)
+    if cfg.serving_weight_dtype == "int8":
+        from mamba_distributed_tpu.ops.quant import quantize_serving_params
+
+        # quantize FROM THE FP32 MASTERS (before any bf16 cast — the
+        # scales keep full precision); the cast below then skips the
+        # int8 kernels and their f32 scales
+        params = quantize_serving_params(params)
 
     def cast(path, leaf):
         # denylist contract: every "kernel" leaf is a bf16-matmul weight
@@ -97,6 +115,10 @@ def _decode_params(params: dict, cfg: ModelConfig) -> dict:
         # extending this tuple + test_decode_params_cast_selectivity
         # (tests/test_inference.py), which pins the casted/uncasted split.
         keys = [getattr(p, "key", None) for p in path]
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.integer):
+            return leaf  # int8 quantized kernels stay as-is
+        if keys and keys[-1] == "scale":
+            return leaf  # quantization scales stay f32
         if keys and keys[-1] == "embedding":
             return leaf.astype(cd)
         if (

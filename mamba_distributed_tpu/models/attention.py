@@ -224,21 +224,63 @@ def attention_page_count(cfg: ModelConfig, max_len: int) -> int:
     return max(1, -(-max_len // cfg.kv_page_tokens))
 
 
+# ---------------------------------------------------------------------------
+# Int8 KV page quantization (cfg.kv_page_dtype == "int8"; ops/quant.py
+# holds the shared round/clip math and docs/SERVING.md "Quantized
+# serving" the layout).  An int8 layer cache is a 4-tuple
+# ``(k_pages int8, v_pages int8, k_scale f32 (P, nkv), v_scale f32
+# (P, nkv))`` — one symmetric scale per (physical page, kv head), so a
+# page's whole (page, hd) tile dequantizes with ONE scalar multiply
+# (what the Pallas page walk fuses in-register).  The scale-update rule
+# needs NO read of old page content:
+#
+#   new_scale = max(old_scale if the page holds PRIOR tokens of this
+#                   sequence (write offset > 0 within the page),
+#                   absmax(fresh rows) / 127)
+#
+# because old_scale already bounds the page's stored values.  Old rows
+# re-express under the new scale (``round(q_old * old/new)`` — the
+# ratio is <= 1 whenever prior content exists, so requantization only
+# ever rounds, never clips real data), and a RECYCLED page's stale
+# scale is ignored outright (no prior content => fresh scale), so
+# garbage from an evicted tenant can never inflate a live page's step
+# size.  The lax fallback and both ragged kernels implement the same
+# rule, so kernel-vs-lax stays within fp tolerance at every ragged mix.
+# ---------------------------------------------------------------------------
+
+
+def _kv_page_scale_init(n_pages: int, nkv: int) -> jax.Array:
+    """Fresh scale array: ones — never read before the first write to a
+    page sets it (the no-prior-content branch ignores old scales), and
+    finite so trash-page dequantization can never produce NaN/inf."""
+    return jnp.ones((n_pages, nkv), jnp.float32)
+
+
 def init_attention_state(cfg: ModelConfig, batch: int, max_len: int,
                          dtype=None):
     """Empty paged KV cache for one attention layer: (k_pages, v_pages)
     of shape (1 + batch*W, nkv, page, hd) — HEAD-MAJOR, page 0 is the
     trash page — in the compute dtype, matching what the prefill path
     produces.  The shared (page_table, lengths) metadata is built once
-    per model by ``attention_page_meta`` (models/lm.init_lm_state)."""
+    per model by ``attention_page_meta`` (models/lm.init_lm_state).
+
+    ``cfg.kv_page_dtype="int8"`` returns the quantized 4-tuple instead:
+    int8 pages plus the per-(page, kv-head) f32 scale arrays (see the
+    section comment above) — page bytes halve, which is the serving
+    pool's capacity doubling (``quant_kv_capacity``)."""
     nh, nkv, hd, _ = _attn_dims(cfg)
+    quant = cfg.kv_quantized and dtype is None
     if dtype is None:
-        dtype = jnp.dtype(cfg.compute_dtype)
+        dtype = jnp.int8 if quant else jnp.dtype(cfg.compute_dtype)
     W = attention_page_count(cfg, max_len)
-    shape = (1 + batch * W, nkv, cfg.kv_page_tokens, hd)
+    P = 1 + batch * W
+    shape = (P, nkv, cfg.kv_page_tokens, hd)
     # two INDEPENDENT allocations: returning one aliased array twice
     # would blow up any donating jit downstream ("donate the same
     # buffer twice") if a caller ever skips the re-stacking copy
+    if quant:
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                _kv_page_scale_init(P, nkv), _kv_page_scale_init(P, nkv))
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -254,7 +296,8 @@ def pack_attention_pages(cfg: ModelConfig, k: jax.Array, v: jax.Array,
                          max_len: int):
     """(b, t, nkv, hd) full-sequence K/V -> identity-paged head-major
     (k_pages, v_pages) with capacity ``max_len`` (lm_prefill's state
-    packing)."""
+    packing).  Int8 pools additionally quantize each (page, kv-head)
+    tile under its own absmax scale and return the 4-tuple."""
     b, t, nkv, hd = k.shape
     pg = cfg.kv_page_tokens
     W = attention_page_count(cfg, max_len)
@@ -265,12 +308,32 @@ def pack_attention_pages(cfg: ModelConfig, k: jax.Array, v: jax.Array,
         x = jnp.moveaxis(x, 3, 2).reshape(b * W, nkv, pg, hd)
         return jnp.concatenate([jnp.zeros_like(x[:1]), x], axis=0)
 
+    if cfg.kv_quantized:
+        from mamba_distributed_tpu.ops.quant import (
+            Q_MAX,
+            SCALE_EPS,
+            kv_quantize,
+        )
+
+        def pack_q(x):
+            pages = pack(x.astype(jnp.float32))           # (P, nkv, pg, hd)
+            absmax = jnp.max(jnp.abs(pages), axis=(2, 3))  # (P, nkv)
+            scale = jnp.maximum(absmax / Q_MAX, SCALE_EPS)
+            q = kv_quantize(pages, scale[:, :, None, None])
+            return q.astype(jnp.int8), scale
+
+        kq, ks = pack_q(k)
+        vq, vs = pack_q(v)
+        return kq, vq, ks, vs
     return pack(k), pack(v)
 
 
 def gather_kv_pages(k_pages: jax.Array, v_pages: jax.Array,
                     page_table: jax.Array,
-                    live_pages: jax.Array | None = None):
+                    live_pages: jax.Array | None = None,
+                    k_scale: jax.Array | None = None,
+                    v_scale: jax.Array | None = None,
+                    dtype=None):
     """Reassemble each row's logical KV view: (P, nkv, pg, hd) head-major
     pages + (b, W) table -> (b, W*pg, nkv, hd).  The lax fallback path —
     the Pallas ragged kernels (ops/pallas/attention_kernels.py) walk the
@@ -286,19 +349,31 @@ def gather_kv_pages(k_pages: jax.Array, v_pages: jax.Array,
     bit-exactly: every position in a dead page is already hard-masked
     to -inf by the callers' causal/position bounds (``_sdpa_positions``
     ``jnp.where``s masked scores regardless of the gathered values), so
-    the substitution can never change a live lane."""
+    the substitution can never change a live lane.
+
+    ``k_scale``/``v_scale`` (int8 pools: (P, nkv) per-page-per-head
+    scales) dequantize the gathered pages into ``dtype`` — the lax
+    mirror of the kernels' in-register scale multiply.  Trash-page
+    rows dequantize with the trash scale (finite garbage, masked as
+    above)."""
     b, W = page_table.shape
     _, nkv, pg, hd = k_pages.shape
     if live_pages is not None:
         page_table = jnp.where(
             jnp.arange(W)[None, :] < live_pages[:, None], page_table, 0
         )
+    if dtype is None:
+        dtype = jnp.float32
 
-    def gather(pages):
-        x = jnp.moveaxis(pages[page_table], 2, 3)        # (b, W, pg, nkv, hd)
+    def gather(pages, scales):
+        x = pages[page_table]                            # (b, W, nkv, pg, hd)
+        if scales is not None:
+            x = x.astype(dtype) * scales[page_table][
+                ..., None, None].astype(dtype)
+        x = jnp.moveaxis(x, 2, 3)                        # (b, W, pg, nkv, hd)
         return x.reshape(b, W * pg, nkv, hd)
 
-    return gather(k_pages), gather(v_pages)
+    return gather(k_pages, k_scale), gather(v_pages, v_scale)
 
 
 def _sdpa_positions(q, k, v, qpos):
@@ -330,19 +405,32 @@ def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array,
                          write_mask: jax.Array | None = None):
     """Single-token decode against the paged KV cache.
 
-    u_t (b, d); kv = (k_pages, v_pages); page_table (b, W); lengths (b,)
+    u_t (b, d); kv = (k_pages, v_pages) — or the int8 4-tuple with the
+    per-(page, kv-head) scales; page_table (b, W); lengths (b,)
     — the row's token count BEFORE this step (the new token lands at
     cache position ``lengths[r]``).  ``write_mask`` (b,) bool routes
     masked rows' KV writes to the trash page and is how the serving tick
     protects recycled pages from dead slots; the shared ``lengths``
     update happens once per model step in models/lm.py.
 
-    Returns (y (b, d), (k_pages, v_pages)).
+    Int8 pools make the write page-granular: the target page is read,
+    old rows re-expressed under the (possibly grown) scale, the fresh
+    row quantized in, and the (page, scale) pair scattered back — the
+    scale-update rule in the section comment above, shared bit-for-bit
+    with the chunk path and mirrored by the kernels.  Masked rows'
+    page AND scale writes land on the trash page as before.
+
+    Returns (y (b, d), kv') with kv' the same arity as ``kv``.
     """
     nh, nkv, hd, rot = _attn_dims(cfg)
     b, _ = u_t.shape
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    k_pages, v_pages = kv
+    quant = len(kv) == 4
+    if quant:
+        k_pages, v_pages, k_scale, v_scale = kv
+    else:
+        k_pages, v_pages = kv
+        k_scale = v_scale = None
     pg = cfg.kv_page_tokens
     W = page_table.shape[1]
 
@@ -361,10 +449,41 @@ def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array,
         mask, jnp.take_along_axis(page_table, pidx[:, None], axis=1)[:, 0], 0
     )
     off = jnp.where(mask, lengths % pg, 0)
-    # head-major pages: the token offset sits one axis past the heads, so
-    # the (b,) phys/off pair scatters a (b, nkv, hd) row block per write
-    k_pages = k_pages.at[phys, :, off].set(k[:, 0].astype(k_pages.dtype))
-    v_pages = v_pages.at[phys, :, off].set(v[:, 0].astype(v_pages.dtype))
+    if quant:
+        from mamba_distributed_tpu.ops.quant import (
+            Q_MAX,
+            SCALE_EPS,
+            kv_quantize,
+            kv_requant,
+        )
+
+        def qwrite(pages, scales, row):
+            # row (b, nkv, hd): requantize the whole target page under
+            # the updated scale, insert the fresh row at ``off``
+            old_q = pages[phys]                       # (b, nkv, pg, hd)
+            old_s = scales[phys]                      # (b, nkv)
+            has_prior = (off > 0)[:, None]            # page holds this
+            # sequence's earlier tokens iff the write offset is interior
+            amax = jnp.max(jnp.abs(row.astype(jnp.float32)), axis=-1)
+            new_s = jnp.maximum(jnp.maximum(
+                jnp.where(has_prior, old_s, 0.0), amax / Q_MAX), SCALE_EPS)
+            ratio = jnp.where(has_prior, old_s / new_s, 0.0)
+            req = kv_requant(old_q, ratio[..., None, None])
+            q_row = kv_quantize(row, new_s[..., None])
+            onehot = jnp.arange(pg)[None, :] == off[:, None]   # (b, pg)
+            page = jnp.where(onehot[:, None, :, None],
+                             q_row[:, :, None, :], req)
+            return (pages.at[phys].set(page.astype(pages.dtype)),
+                    scales.at[phys].set(new_s))
+
+        k_pages, k_scale = qwrite(k_pages, k_scale, k[:, 0])
+        v_pages, v_scale = qwrite(v_pages, v_scale, v[:, 0])
+    else:
+        # head-major pages: the token offset sits one axis past the
+        # heads, so the (b,) phys/off pair scatters a (b, nkv, hd) row
+        # block per write
+        k_pages = k_pages.at[phys, :, off].set(k[:, 0].astype(k_pages.dtype))
+        v_pages = v_pages.at[phys, :, off].set(v[:, 0].astype(v_pages.dtype))
 
     from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
 
@@ -375,21 +494,74 @@ def attention_mixer_step(params: dict, cfg: ModelConfig, u_t: jax.Array,
         )
 
         # kv_len = tokens readable AFTER the write; the kernel skips
-        # whole pages past it, so decode cost tracks live tokens
+        # whole pages past it, so decode cost tracks live tokens (int8
+        # pools: dequant fused into the page walk via the prefetched
+        # scales)
         out = ragged_paged_decode_attention(
             q[:, 0], k_pages, v_pages, page_table,
             jnp.minimum(qpos + 1, W * pg),
+            k_scale=k_scale, v_scale=v_scale,
         )[:, None]
     else:
         # tokens readable after the write = qpos + 1 per row: gather
         # only the pages that hold them (the rest go to trash — masked
         # anyway), so decode cost tracks live tokens off-TPU too
         kk, vv = gather_kv_pages(
-            k_pages, v_pages, page_table, (qpos + pg) // pg
+            k_pages, v_pages, page_table, (qpos + pg) // pg,
+            k_scale=k_scale, v_scale=v_scale, dtype=compute_dtype,
         )
         out = _sdpa_positions(q, kk, vv, qpos[:, None])
     y = linear(params["out_proj"], out.reshape(b, nh * hd), compute_dtype)
+    if quant:
+        return y, (k_pages, v_pages, k_scale, v_scale)
     return y, (k_pages, v_pages)
+
+
+def _chunk_page_scales(k, v, real, page_table, lengths, n_real,
+                       k_scale, v_scale, pg: int):
+    """Post-chunk-write per-(page, kv-head) scales (int8 pools).
+
+    Applies the scale-update rule (section comment above) to every page
+    in the chunk's write window — ``[lengths, lengths + n_real)`` per
+    row — WITHOUT reading page content: old scales bound old values, so
+    ``new = max(old if prior content else 0, chunk absmax / 127)``.
+    Returns ``(k_scale', v_scale', takes)`` with the updated (P, nkv)
+    arrays (non-window pages untouched; trash-page entries are garbage
+    by the usual contract) and the (b, W) write-window mask.  Shared by
+    the lax fallback and the Pallas path (the kernel takes the OLD and
+    NEW arrays scalar-prefetched and re-derives the requant ratio per
+    visited page), so the two paths can never disagree on a scale.
+    """
+    from mamba_distributed_tpu.ops.quant import Q_MAX, SCALE_EPS
+
+    b, c = real.shape
+    W = page_table.shape[1]
+    total = lengths + n_real
+    pad = c - n_real
+    pos = lengths[:, None] + jnp.arange(c)[None, :] - pad[:, None]
+    pageidx = jnp.clip(jnp.maximum(pos, 0) // pg, 0, W - 1)
+    wcol = jnp.arange(W)[None, :]
+    takes = ((wcol * pg < total[:, None])
+             & ((wcol + 1) * pg > lengths[:, None])
+             & (n_real > 0)[:, None])                      # (b, W)
+    has_prior = lengths[:, None] > wcol * pg               # (b, W)
+    # which chunk rows land in which window page (pads excluded)
+    oh = (pageidx[:, :, None] == wcol[:, None, :]) & real[:, :, None]
+
+    def update(x, scales):
+        absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)  # (b,c,nkv)
+        amax = jnp.max(
+            jnp.where(oh[..., None], absmax[:, :, None, :], 0.0), axis=1
+        )                                                  # (b, W, nkv)
+        old = scales[page_table]                           # (b, W, nkv)
+        new = jnp.maximum(jnp.maximum(
+            jnp.where(has_prior[..., None], old, 0.0), amax / Q_MAX),
+            SCALE_EPS)
+        new = jnp.where(takes[..., None], new, old)
+        dst = jnp.where(takes, page_table, 0)              # no-writes -> trash
+        return scales.at[dst].set(new)
+
+    return update(k, k_scale), update(v, v_scale), takes
 
 
 def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
@@ -416,12 +588,25 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
     fallback (explicit ``attn_impl="xla"``, or auto off-TPU) keeps the
     scatter + full-view gather + dense SDPA.
 
-    Returns (y (b, c, d), (k_pages, v_pages)).
+    Int8 pools (``kv`` the 4-tuple): the post-write scales are planned
+    host-of-kernel in ``_chunk_page_scales`` (no page reads needed),
+    then the write-window pages requantize-and-merge — in-kernel for
+    the Pallas path (old/new scale arrays scalar-prefetched, fresh
+    rows quantized before the one-hot merge, attend on the dequantized
+    merged tile), in XLA for the fallback — and the attend runs over
+    the dequantized view.  Same math both paths.
+
+    Returns (y (b, c, d), kv') with kv' the same arity as ``kv``.
     """
     nh, nkv, hd, rot = _attn_dims(cfg)
     b, c, _ = u.shape
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    k_pages, v_pages = kv
+    quant = len(kv) == 4
+    if quant:
+        k_pages, v_pages, k_scale, v_scale = kv
+    else:
+        k_pages, v_pages = kv
+        k_scale = v_scale = None
     pg = cfg.kv_page_tokens
     W = page_table.shape[1]
 
@@ -439,6 +624,11 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
         q = apply_rope(q, angles)
         k = apply_rope(k, angles)
 
+    if quant:
+        ks_new, vs_new, takes = _chunk_page_scales(
+            k, v, real, page_table, lengths, c - pad, k_scale, v_scale, pg
+        )
+
     from mamba_distributed_tpu.ops.pallas.common import resolve_attn_impl
 
     if resolve_attn_impl(cfg.attn_impl) == "pallas":
@@ -447,8 +637,66 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
         )
 
         out, k_pages, v_pages = ragged_paged_prefill_attention(
-            q, k, v, k_pages, v_pages, page_table, lengths, c - pad
+            q, k, v, k_pages, v_pages, page_table, lengths, c - pad,
+            **({} if not quant else dict(
+                k_scale_old=k_scale, v_scale_old=v_scale,
+                k_scale_new=ks_new, v_scale_new=vs_new,
+            )),
         )
+        if quant:
+            k_scale, v_scale = ks_new, vs_new
+    elif quant:
+        from mamba_distributed_tpu.ops.quant import kv_quantize, kv_requant
+
+        # the chunk's write WINDOW — the only pages that requantize or
+        # write back — spans at most ceil(c/pg)+1 logical pages starting
+        # at lengths//pg, so the merge gathers/scatters O(chunk) pages
+        # per row, never O(table width) (the same live-traffic rule the
+        # bf16 fallback keeps via gather_kv_pages(live_pages=))
+        Wc = min(W, -(-c // pg) + 1)
+        j0 = lengths // pg                              # (b,)
+        wj = j0[:, None] + jnp.arange(Wc)[None, :]      # (b, Wc) logical
+        in_range = wj < W
+        wjc = jnp.where(in_range, wj, W - 1)
+        wtbl = jnp.take_along_axis(page_table, wjc, axis=1)
+        takes_w = jnp.take_along_axis(takes, wjc, axis=1) & in_range
+        has_prior = (lengths[:, None] > wj * pg) & in_range
+        # window-local chunk-token coordinates (real tokens only: posc
+        # >= lengths >= j0*pg and posc < lengths + c <= (j0+Wc)*pg)
+        lpos = jnp.clip(posc - (j0 * pg)[:, None], 0, Wc * pg - 1)
+        lpidx = lpos // pg                              # (b, c)
+        dst = jnp.where(takes_w, wtbl, 0)
+
+        def merge(pages, old_scales, new_scales, x):
+            # requantize window pages under their new scales, then
+            # scatter the chunk's quantized rows into the flat view
+            old_q = pages[wtbl]                       # (b, Wc, nkv, pg, hd)
+            old_s = old_scales[wtbl]                  # (b, Wc, nkv)
+            new_s = new_scales[wtbl]
+            ratio = jnp.where(has_prior[..., None], old_s / new_s, 0.0)
+            req = kv_requant(old_q, ratio[..., None, None])
+            row_s = jnp.take_along_axis(new_s, lpidx[:, :, None], axis=1)
+            q_rows = kv_quantize(x, row_s[..., None])  # (b, c, nkv, hd)
+            view = jnp.moveaxis(req, 3, 2).reshape(b, Wc * pg, nkv, hd)
+            view = jnp.concatenate(                    # pad slot for pads
+                [view, jnp.zeros((b, 1, nkv, hd), view.dtype)], axis=1)
+            idx = jnp.where(real, lpos, Wc * pg)
+            view = view.at[jnp.arange(b)[:, None], idx].set(q_rows)
+            merged = jnp.moveaxis(
+                view[:, :-1].reshape(b, Wc, pg, nkv, hd), 2, 3
+            )
+            return pages.at[dst].set(merged.astype(pages.dtype))
+
+        k_pages = merge(k_pages, k_scale, ks_new, k)
+        v_pages = merge(v_pages, v_scale, vs_new, v)
+        k_scale, v_scale = ks_new, vs_new
+        tokens = jnp.minimum(lengths + (c - pad), W * pg)
+        kk, vv = gather_kv_pages(
+            k_pages, v_pages, page_table,
+            jnp.maximum((tokens + pg - 1) // pg, 1),
+            k_scale=k_scale, v_scale=v_scale, dtype=compute_dtype,
+        )
+        out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
     else:
         pidx = jnp.clip(posc // pg, 0, W - 1)
         phys = jnp.where(
@@ -471,4 +719,6 @@ def attention_mixer_chunk(params: dict, cfg: ModelConfig, u: jax.Array,
         )
         out = _sdpa_positions(q, kk, vv, jnp.minimum(posc, W * pg - 1))
     y = linear(params["out_proj"], out.reshape(b, c, nh * hd), compute_dtype)
+    if quant:
+        return y, (k_pages, v_pages, k_scale, v_scale)
     return y, (k_pages, v_pages)

@@ -42,12 +42,31 @@ def init_linear(key: jax.Array, d_in: int, d_out: int, bias: bool = False,
 
 
 def linear(params: dict, x: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
-    """bf16 matmul with fp32 accumulation (MXU-native), bf16 output."""
+    """bf16 matmul with fp32 accumulation (MXU-native), bf16 output.
+
+    Int8-quantized kernels (ops/quant.py: ``{"kernel": int8, "scale":
+    f32}``, scale keeping the kernel's rank with the reduced axis sized
+    1) dequantize AT USE — the scale folds into the output for
+    column-scaled weights (``(x @ q) * scale``) or into the activation
+    for row-scaled ones (``(x * scale) @ q``), so no full-precision
+    weight copy is ever materialized (int8 values are exact in bf16:
+    the cast feeding the dot is lossless).
+    """
+    w = params["kernel"]
+    scale = params.get("scale")
+    if scale is not None and scale.shape[-1] == 1:
+        # per-input-row scales (row-parallel weights): fold into x —
+        # exact (diag(scale) commutes through the contraction)
+        x = (x.astype(jnp.float32) * scale[..., 0].astype(jnp.float32))
+        scale = None
     y = jnp.dot(
         x.astype(compute_dtype),
-        params["kernel"].astype(compute_dtype),
+        w.astype(compute_dtype),
         preferred_element_type=jnp.float32,
     )
+    if scale is not None:
+        # per-output-column scales: fold into the fp32 accumulator
+        y = y * scale.astype(jnp.float32)
     if "bias" in params:
         y = y + params["bias"].astype(jnp.float32)
     return y.astype(compute_dtype)

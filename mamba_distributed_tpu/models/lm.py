@@ -107,6 +107,40 @@ def _init_block(key: jax.Array, cfg: ModelConfig, attn: bool) -> dict:
     return p
 
 
+def _embed(params: dict, ids: jax.Array, compute_dtype) -> jax.Array:
+    """Embedding lookup, transparent to int8 serving quantization
+    (ops/quant.py): a quantized embedding is ``{"kernel": int8 (V, d),
+    "scale": f32 (V, 1)}`` with one scale per vocab row, so the lookup
+    dequantizes just the gathered rows."""
+    emb = params["embedding"]
+    if isinstance(emb, dict):
+        # dequantize in f32 (scales keep full precision — same rule as
+        # linear() and _tied_logits), then cast once
+        rows = emb["kernel"][ids].astype(jnp.float32) * emb["scale"][ids]
+        return rows.astype(compute_dtype)
+    return emb[ids].astype(compute_dtype)
+
+
+def _tied_logits(params: dict, normed: jax.Array, compute_dtype) -> jax.Array:
+    """Tied LM head: ``normed @ embedding.T`` with fp32 accumulation.
+    A quantized embedding's per-vocab-row scales become per-OUTPUT
+    scales of the head matmul — ``(x @ q.T) * scale`` on the fp32
+    accumulator, no dequantized weight copy (ops/quant.py)."""
+    emb = params["embedding"]
+    if isinstance(emb, dict):
+        y = jnp.dot(
+            normed.astype(compute_dtype),
+            emb["kernel"].T.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return y * emb["scale"][:, 0].astype(jnp.float32)
+    return jnp.dot(
+        normed.astype(compute_dtype),
+        emb.T.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _gated_mlp(params: dict, x: jax.Array, compute_dtype) -> jax.Array:
     """GatedMLP (mamba_ssm modules/mlp.py): fc2(y * silu(gate))."""
     yz = linear(params["fc1"], x, compute_dtype)
@@ -301,11 +335,7 @@ def _final_logits(params, cfg: ModelConfig, hidden, residual):
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     normed = _final_norm(params, cfg, hidden, residual)
     if cfg.tie_embeddings:
-        return jnp.dot(
-            normed.astype(compute_dtype),
-            params["embedding"].T.astype(compute_dtype),
-            preferred_element_type=jnp.float32,
-        )
+        return _tied_logits(params, normed, compute_dtype)
     return linear(params["lm_head"], normed, compute_dtype).astype(jnp.float32)
 
 
@@ -394,7 +424,7 @@ def _backbone(
     the blocked-CE loss path)."""
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
-    hidden = params["embedding"][input_ids].astype(compute_dtype)
+    hidden = _embed(params, input_ids, compute_dtype)
     # Single-carry form: the layer loop carries ONE post-add fp32 stream
     # instead of the (hidden, residual) pair.  The pair made every remat
     # boundary save the stream twice — stacked bf16 AND fp32 copies per
@@ -583,7 +613,7 @@ def lm_loss_pipelined(
 
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     residual_dtype = jnp.float32 if cfg.residual_in_fp32 else compute_dtype
-    hidden = params["embedding"][input_ids].astype(compute_dtype)  # (mb,b,t,d)
+    hidden = _embed(params, input_ids, compute_dtype)  # (mb,b,t,d)
     # single-carry post-add stream (see lm_forward)
     res = hidden.astype(residual_dtype)
 
@@ -663,7 +693,7 @@ def lm_prefill(params: dict, cfg: ModelConfig, input_ids: jax.Array,
             f"hybrid prefill needs KV capacity beyond the prompt: "
             f"max_len={max_len} <= prompt length {t}"
         )
-    hidden = params["embedding"][input_ids].astype(compute_dtype)
+    hidden = _embed(params, input_ids, compute_dtype)
     residual = None
 
     def to_pages(state):
@@ -805,7 +835,7 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     ``lm_prefill``.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    hidden = params["embedding"][input_ids].astype(compute_dtype)
+    hidden = _embed(params, input_ids, compute_dtype)
     residual = jnp.zeros_like(
         hidden, dtype=jnp.float32 if cfg.residual_in_fp32 else compute_dtype
     )
@@ -999,7 +1029,7 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array,
     decode loop) writes every row.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
-    hidden = params["embedding"][token].astype(compute_dtype)
+    hidden = _embed(params, token, compute_dtype)
     residual = None
 
     def mbody(carry, xs):
@@ -1090,11 +1120,7 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array,
 
     normed, _ = add_rms_norm(hidden, residual, params["norm_f"]["weight"], cfg.norm_eps)
     if cfg.tie_embeddings:
-        logits = jnp.dot(
-            normed.astype(compute_dtype),
-            params["embedding"].T.astype(compute_dtype),
-            preferred_element_type=jnp.float32,
-        )
+        logits = _tied_logits(params, normed, compute_dtype)
     else:
         logits = linear(params["lm_head"], normed, compute_dtype).astype(jnp.float32)
     return logits.astype(jnp.float32), new_state

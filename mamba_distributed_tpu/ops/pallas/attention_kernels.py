@@ -523,11 +523,25 @@ TRACE_COUNTS = {"ragged_decode": 0, "ragged_prefill": 0}
 
 
 def _rpa_kernel(
-    tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, den_scr, acc_scr,
-    *, nw: int, pg: int, sm_scale: float,
+    tbl_ref, len_ref, *rest,
+    nw: int, pg: int, sm_scale: float, quant: bool = False,
 ):
-    """One (slot, kv-head, page) cell of the ragged decode forward."""
+    """One (slot, kv-head, page) cell of the ragged decode forward.
+
+    ``quant`` (int8 page pools): two extra scalar-prefetched (P, nkv)
+    f32 scale arrays ride between the metadata and the tensor refs; the
+    page tile is read as int8 and dequantized IN-REGISTER — the K
+    scale folds into the score block's scalar multiply, the V scale
+    into the accumulator update — one scalar each per (page, head)
+    cell, no dequantized page ever materializes in VMEM.
+    """
+    if quant:
+        ks_ref, vs_ref, q_ref, k_ref, v_ref, o_ref, m_scr, den_scr, \
+            acc_scr = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, den_scr, acc_scr = rest
     s = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -544,10 +558,20 @@ def _rpa_kernel(
     def _():
         q = q_ref[0, 0]                                  # (R8, hd)
         k = k_ref[0, 0]                                  # (pg, hd)
-        scores = jax.lax.dot_general(                    # (R8, pg) fp32
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * sm_scale
+        if quant:
+            phys = tbl_ref[s, j]
+            # int8 tile -> fp32 dot; the per-(page, head) K scale is a
+            # SCALAR for the whole block, folded into the score scale
+            scores = jax.lax.dot_general(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * (ks_ref[phys, h] * sm_scale)
+        else:
+            scores = jax.lax.dot_general(                # (R8, pg) fp32
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
         kpos = jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1
         ) + j * pg
@@ -560,10 +584,17 @@ def _rpa_kernel(
         p = jnp.where(scores > _NEG_INF, jnp.exp(scores - m_new), 0.0)
 
         v = v_ref[0, 0]                                  # (pg, hd)
-        acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        if quant:
+            # V dequant: one scalar multiply on the fp32 accumulator
+            acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
+                p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * vs_ref[tbl_ref[s, j], h]
+        else:
+            acc_scr[...] = acc_scr[...] * scale + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
         den_scr[...] = den_scr[...] * scale + jnp.sum(
             p, axis=1, keepdims=True
         )
@@ -584,6 +615,8 @@ def ragged_paged_decode_attention(
     v_pages: jax.Array,
     page_table: jax.Array,
     kv_len: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Paged decode attention with per-row lengths.
@@ -594,14 +627,22 @@ def ragged_paged_decode_attention(
     per row (INCLUDING any token written this step).  Returns
     (S, nh, hd).
 
+    ``k_scale``/``v_scale`` (int8 pools: (P, nkv) f32, one symmetric
+    scale per (physical page, kv head)) ride the scalar-prefetch channel
+    next to the page table, and the kernel dequantizes each visited
+    int8 tile in-register — the per-page scalar folds into the score
+    multiply (K) and the accumulator update (V), so page-walk HBM
+    traffic is the int8 bytes and nothing widened ever round-trips.
+
     Numerics match the lax fallback (gather + masked SDPA,
-    models/attention._sdpa_positions) to fp tolerance; one jit trace
-    covers every occupancy / length mix at a fixed (S, W) layout
-    (``TRACE_COUNTS["ragged_decode"]``).  ``interpret=None``
-    auto-selects the Pallas interpreter off-TPU.
+    models/attention._sdpa_positions; int8: dequantizing gather) to fp
+    tolerance; one jit trace covers every occupancy / length mix at a
+    fixed (S, W) layout (``TRACE_COUNTS["ragged_decode"]``).
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
     """
     interpret = resolve_interpret(interpret)
     TRACE_COUNTS["ragged_decode"] += 1
+    quant = k_scale is not None
     S, nh, hd = q.shape
     P, nkv, pg, _ = k_pages.shape
     W = page_table.shape[1]
@@ -619,18 +660,25 @@ def ragged_paged_decode_attention(
     # off the table: no per-call transpose of the pool on the hot path
 
     grid = (S, nkv, W)
+    # index maps take the grid ids plus EVERY scalar-prefetch operand
+    # (2 plain, 4 with the int8 scales) — *pf absorbs the difference
     q_spec = pl.BlockSpec(
-        (1, 1, R8, hd), lambda s, h, j, tbl, ln: (s, h, 0, 0)
+        (1, 1, R8, hd), lambda s, h, j, tbl, *pf: (s, h, 0, 0)
     )
     kv_spec = pl.BlockSpec(
-        (1, 1, pg, hd), lambda s, h, j, tbl, ln: (tbl[s, j], h, 0, 0)
+        (1, 1, pg, hd), lambda s, h, j, tbl, *pf: (tbl[s, j], h, 0, 0)
     )
+    prefetch = (page_table.astype(jnp.int32), kv_len.astype(jnp.int32))
+    if quant:
+        prefetch += (k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32))
     out = pl.pallas_call(
         functools.partial(
-            _rpa_kernel, nw=W, pg=pg, sm_scale=1.0 / math.sqrt(hd)
+            _rpa_kernel, nw=W, pg=pg, sm_scale=1.0 / math.sqrt(hd),
+            quant=quant,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(prefetch),
             grid=grid,
             in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=q_spec,
@@ -645,8 +693,7 @@ def ragged_paged_decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), kv_len.astype(jnp.int32),
-      qh, k_pages, v_pages)
+    )(*prefetch, qh, k_pages, v_pages)
     return out[:, :, :rep].reshape(S, nh, hd)
 
 
@@ -673,12 +720,30 @@ def ragged_paged_decode_attention(
 
 
 def _rpp_kernel(
-    tbl_ref, len_ref, creal_ref, q_ref, kc_ref, vc_ref, kp_ref, vp_ref,
-    o_ref, ko_ref, vo_ref, m_scr, den_scr, acc_scr,
-    *, nw: int, pg: int, c: int, rep: int, sm_scale: float,
+    tbl_ref, len_ref, creal_ref, *rest,
+    nw: int, pg: int, c: int, rep: int, sm_scale: float,
+    quant: bool = False,
 ):
-    """One (row, kv-head, page) cell of the fused prefill forward."""
+    """One (row, kv-head, page) cell of the fused prefill forward.
+
+    ``quant`` (int8 page pools): four extra scalar-prefetched (P, nkv)
+    f32 scale arrays — OLD and NEW for K and V.  The NEW scales are
+    planned outside (models/attention._chunk_page_scales — no page
+    reads needed, so nothing extra streams through the kernel); the
+    kernel re-expresses the old int8 rows under the new scale
+    (``round(q_old * old/new)``), quantizes the chunk's fresh rows
+    BEFORE the one-hot merge, flushes the merged int8 page, and attends
+    on the dequantized merged tile (scale * int8, in-register).
+    """
+    if quant:
+        (kso_ref, ksn_ref, vso_ref, vsn_ref, q_ref, kc_ref, vc_ref,
+         kp_ref, vp_ref, o_ref, ko_ref, vo_ref, m_scr, den_scr,
+         acc_scr) = rest
+    else:
+        (q_ref, kc_ref, vc_ref, kp_ref, vp_ref, o_ref, ko_ref, vo_ref,
+         m_scr, den_scr, acc_scr) = rest
     r = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -714,18 +779,52 @@ def _rpp_kernel(
     )
     kpos_col = jax.lax.broadcasted_iota(jnp.int32, (pg, 1), 0) + j * pg
     written = (kpos_col >= ln) & (kpos_col < total)       # (pg, 1)
-    merged_k = jnp.where(written, k_rows.astype(kp_ref.dtype), kp_ref[0, 0])
-    merged_v = jnp.where(written, v_rows.astype(vp_ref.dtype), vp_ref[0, 0])
-    # every cell writes its out block (an unwritten block would flush
-    # undefined VMEM); the out index map sends no-write cells to trash
-    ko_ref[0, 0] = merged_k
-    vo_ref[0, 0] = merged_v
+    if quant:
+        from mamba_distributed_tpu.ops.quant import kv_quantize, kv_requant
+
+        phys = tbl_ref[r, j]
+        kso, ksn = kso_ref[phys, h], ksn_ref[phys, h]
+        vso, vsn = vso_ref[phys, h], vsn_ref[phys, h]
+        has_prior = ln > j * pg
+        # old rows re-express under the (possibly grown) new scale; a
+        # page with NO prior content of this sequence ignores its stale
+        # scale outright (recycled-page garbage can't leak in).  The
+        # round/clip math is the SHARED ops/quant helpers — the same
+        # functions the lax fallback and the decode-step write call —
+        # so the two paths can never disagree on a stored value.
+        ratio_k = jnp.where(has_prior, kso / ksn, 0.0)
+        ratio_v = jnp.where(has_prior, vso / vsn, 0.0)
+        merged_k_q = jnp.where(
+            written, kv_quantize(k_rows, ksn), kv_requant(kp_ref[0, 0],
+                                                          ratio_k))
+        merged_v_q = jnp.where(
+            written, kv_quantize(v_rows, vsn), kv_requant(vp_ref[0, 0],
+                                                          ratio_v))
+        ko_ref[0, 0] = merged_k_q.astype(ko_ref.dtype)
+        vo_ref[0, 0] = merged_v_q.astype(vo_ref.dtype)
+        # attend on what storage now holds: dequantized requantized rows
+        merged_k = merged_k_q * ksn                       # (pg, hd) fp32
+        merged_v = merged_v_q * vsn
+    else:
+        merged_k = jnp.where(
+            written, k_rows.astype(kp_ref.dtype), kp_ref[0, 0]
+        )
+        merged_v = jnp.where(
+            written, v_rows.astype(vp_ref.dtype), vp_ref[0, 0]
+        )
+        # every cell writes its out block (an unwritten block would
+        # flush undefined VMEM); the out index map sends no-write cells
+        # to trash
+        ko_ref[0, 0] = merged_k
+        vo_ref[0, 0] = merged_v
 
     # ---- attend: whole pages at/past the row's post-write extent are
     # SKIPPED — chunk cost tracks live tokens (an all-pad row skips all)
     @pl.when(j * pg < total)
     def _():
         q = q_ref[0, 0]                                  # (Q8, hd)
+        if quant:
+            q = q.astype(jnp.float32)  # merged tile is dequantized fp32
         scores = jax.lax.dot_general(                    # (Q8, pg) fp32
             q, merged_k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -775,6 +874,10 @@ def ragged_paged_prefill_attention(
     page_table: jax.Array,
     lengths: jax.Array,
     chunk_real: jax.Array,
+    k_scale_old: jax.Array | None = None,
+    v_scale_old: jax.Array | None = None,
+    k_scale_new: jax.Array | None = None,
+    v_scale_new: jax.Array | None = None,
     interpret: bool | None = None,
 ):
     """Fused paged prefill: write one chunk's K/V into each row's pages,
@@ -790,16 +893,26 @@ def ragged_paged_prefill_attention(
     every query attends positions ``[0, its own position]`` — the causal
     rule over prefix + fresh chunk.
 
+    Int8 page pools pass the four (P, nkv) f32 scale arrays — OLD and
+    NEW per K/V, the NEW ones pre-planned by
+    ``models/attention._chunk_page_scales`` (the caller scatters them
+    into its scale arrays; this kernel only READS scales) — and the
+    fused write quantizes the chunk's K/V before the one-hot merge
+    while old rows requantize under the grown scale; the attend runs
+    on the dequantized merged tile.
+
     Returns (o (b, c, nh, hd), k_pages', v_pages').  The page-pool
     outputs alias their inputs (in-place under the chunk step's state
     donation).  Numerics match the lax fallback (scatter + gather +
-    ``models/attention._sdpa_positions``) to fp tolerance; one jit trace
-    covers every (lengths, chunk_real) mix at a fixed (b, c, W) layout
+    ``models/attention._sdpa_positions``; int8: requant-merge +
+    dequantizing gather) to fp tolerance; one jit trace covers every
+    (lengths, chunk_real) mix at a fixed (b, c, W) layout
     (``TRACE_COUNTS["ragged_prefill"]``).  ``interpret=None``
     auto-selects the Pallas interpreter off-TPU.
     """
     interpret = resolve_interpret(interpret)
     TRACE_COUNTS["ragged_prefill"] += 1
+    quant = k_scale_old is not None
     b, c, nh, hd = q.shape
     P, nkv, pg, _ = k_pages.shape
     W = page_table.shape[1]
@@ -823,17 +936,19 @@ def ragged_paged_prefill_attention(
         kc, vc = jnp.pad(kc, cpad), jnp.pad(vc, cpad)
 
     grid = (b, nkv, W)
+    # index maps take the grid ids plus EVERY scalar-prefetch operand
+    # (3 plain, 7 with the int8 scale arrays) — *pf absorbs the extras
     q_spec = pl.BlockSpec(
-        (1, 1, Q8, hd), lambda r, h, j, tbl, ln, cr: (r, h, 0, 0)
+        (1, 1, Q8, hd), lambda r, h, j, tbl, *pf: (r, h, 0, 0)
     )
     c_spec = pl.BlockSpec(
-        (1, 1, C8, hd), lambda r, h, j, tbl, ln, cr: (r, h, 0, 0)
+        (1, 1, C8, hd), lambda r, h, j, tbl, *pf: (r, h, 0, 0)
     )
     kv_in_spec = pl.BlockSpec(
-        (1, 1, pg, hd), lambda r, h, j, tbl, ln, cr: (tbl[r, j], h, 0, 0)
+        (1, 1, pg, hd), lambda r, h, j, tbl, *pf: (tbl[r, j], h, 0, 0)
     )
 
-    def kv_out_idx(r, h, j, tbl, ln, cr):
+    def kv_out_idx(r, h, j, tbl, ln, cr, *pf):
         # only the one cell owning a chunk-written page may flush to it;
         # everything else (pure-prefix pages, pages past the extent)
         # flushes its block to the trash page — whose content is garbage
@@ -843,13 +958,21 @@ def ragged_paged_prefill_attention(
 
     kv_out_spec = pl.BlockSpec((1, 1, pg, hd), kv_out_idx)
 
+    prefetch = (page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+                chunk_real.astype(jnp.int32))
+    if quant:
+        prefetch += (k_scale_old.astype(jnp.float32),
+                     k_scale_new.astype(jnp.float32),
+                     v_scale_old.astype(jnp.float32),
+                     v_scale_new.astype(jnp.float32))
+    npre = len(prefetch)
     out, kp, vp = pl.pallas_call(
         functools.partial(
             _rpp_kernel, nw=W, pg=pg, c=c, rep=rep,
-            sm_scale=1.0 / math.sqrt(hd),
+            sm_scale=1.0 / math.sqrt(hd), quant=quant,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=npre,
             grid=grid,
             in_specs=[q_spec, c_spec, c_spec, kv_in_spec, kv_in_spec],
             out_specs=[q_spec, kv_out_spec, kv_out_spec],
@@ -864,15 +987,15 @@ def ragged_paged_prefill_attention(
             jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
             jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
         ],
-        # page-pool inputs (post-scalar-prefetch indices 6/7) alias the
-        # page-pool outputs: the write is in place under donation
-        input_output_aliases={6: 1, 7: 2},
+        # the page-pool inputs (last two operands after the scalar
+        # prefetch block) alias the page-pool outputs: the write is in
+        # place under donation
+        input_output_aliases={npre + 3: 1, npre + 4: 2},
         compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      chunk_real.astype(jnp.int32), qh, kc, vc, k_pages, v_pages)
+    )(*prefetch, qh, kc, vc, k_pages, v_pages)
 
     o = out[:, :, :Q].reshape(b, nkv, c, rep, hd)
     o = jnp.moveaxis(o, 1, 2).reshape(b, c, nh, hd)

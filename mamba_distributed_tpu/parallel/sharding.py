@@ -197,18 +197,34 @@ def serving_param_specs(params, model_shards: int):
     Slot/page state is NOT covered here — it partitions over ``data``
     only (``slot_pool_specs``); the two spec families compose because
     they name disjoint mesh axes.
+
+    Int8-quantized serving trees (ops/quant.py) are covered too: a
+    quantized leaf is ``{"kernel": int8, "scale": f32}`` whose scale
+    keeps the kernel's rank with every non-channel axis sized 1 and
+    whose CHANNEL axis is by construction the kernel's tensor-parallel
+    axis — so a ``scale`` leaf simply rides its sibling kernel's rule
+    (same path, same axis) and scales shard with their weights, no
+    cross-shard rescale.  The quantized embedding's dict form
+    (``embedding/kernel`` + ``embedding/scale``) keeps the vocab axis
+    column-parallel exactly like the bare-array form.
     """
     def leaf_spec(path, leaf):
         names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
         shape = np.shape(leaf)
         spec: list = [None] * len(shape)
         if model_shards > 1 and shape:
-            stacked = "blocks" in names or "attn_blocks" in names
-            ax = _tp_axis(names, len(shape), stacked)
+            lookup = names
+            if names and names[-1] == "scale":
+                # an int8 scale shards its kernel's axis (rank matches:
+                # the scale keeps the kernel's rank, channel axis full)
+                lookup = names[:-1] + ["kernel"]
+            stacked = "blocks" in lookup or "attn_blocks" in lookup
+            ax = _tp_axis(lookup, len(shape), stacked)
             if ax is None:
-                if names[-1] == "embedding":
+                if (lookup[-1] == "embedding"
+                        or lookup[-2:] == ["embedding", "kernel"]):
                     ax = 0  # (V, d): vocab axis
-                elif names[-2:] == ["lm_head", "kernel"]:
+                elif lookup[-2:] == ["lm_head", "kernel"]:
                     ax = len(shape) - 1  # (d, V): vocab axis
             if ax is not None and shape[ax] % model_shards == 0:
                 spec[ax] = "model"

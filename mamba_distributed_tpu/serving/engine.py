@@ -497,6 +497,31 @@ class ServingEngine:
                         self.pool["state"]["attn_blocks"])
                 ))
             self.metrics.configure_prefix_cache()
+        # --- quantized serving (ops/quant.py; docs/SERVING.md
+        # "Quantized serving"): resident-bytes gauges, installed only
+        # when quant is on so bf16 engines' records/summaries stay
+        # byte-stable.  weight bytes are the device-resident decoded
+        # tree (int8 kernels + f32 scales when quantized); page-pool
+        # bytes the hybrid KV pools incl. their scale arrays.
+        self.quantized_weights = cfg.serving_weight_dtype == "int8"
+        self.quantized_kv = self.hybrid and cfg.kv_quantized
+        if self.quantized_weights or self.quantized_kv:
+            from mamba_distributed_tpu.ops.quant import param_bytes
+
+            self._weight_bytes = param_bytes(self._params)
+            self._pool_bytes = (
+                sum(int(x.nbytes) for x in
+                    jax.tree.leaves(self.pool["state"]["attn_blocks"]))
+                if self.hybrid else None
+            )
+            self._quant_stamp = {"weights": cfg.serving_weight_dtype,
+                                 "kv": cfg.kv_page_dtype}
+            self.metrics.configure_memory(
+                weight_bytes=self._weight_bytes,
+                page_pool_bytes=self._pool_bytes or 0,
+                weight_dtype=cfg.serving_weight_dtype,
+                kv_dtype=cfg.kv_page_dtype,
+            )
         self._pc_hits = 0  # per-window gauges -> serving_tick records
         self._pc_misses = 0
         self._pc_saved_tokens = 0
@@ -1654,6 +1679,16 @@ class ServingEngine:
             self._pc_hits = 0
             self._pc_misses = 0
             self._pc_saved_tokens = 0
+        quant_gauges = {}
+        if self.quantized_weights or self.quantized_kv:
+            # int8 serving stamps its dtype pair + resident-bytes
+            # gauges on every tick record (absent otherwise — records
+            # stay byte-stable with quant off)
+            quant_gauges = dict(
+                quantized=self._quant_stamp,
+                weight_bytes=self._weight_bytes,
+                page_pool_bytes=self._pool_bytes,
+            )
         self.metrics.record_tick(
             occupied=occupied, queue_depth=self.scheduler.depth,
             tokens_emitted=len(events), dt_s=dt,
@@ -1672,6 +1707,7 @@ class ServingEngine:
             migrations_in=self._migrations_in,
             **pc_gauges,
             **kv_gauges,
+            **quant_gauges,
         )
         self._preemptions = 0
         self._migrations_out = 0

@@ -52,7 +52,14 @@ page; head-major is the Pallas kernels' native block layout, so the
 decode/prefill page walks read pages without any per-call transpose)
 — while the page table and per-slot lengths stay HOST-side on the
 engine (they change only between ticks, and the tick takes them as
-plain array arguments).  ``PagePool`` is the host allocator: admission
+plain array arguments).  With ``cfg.kv_page_dtype="int8"`` each layer's
+tuple grows per-(page, kv-head) f32 scale arrays ``(A, P, nkv)``
+alongside the int8 pages (models/attention.py "Int8 KV page
+quantization"); every page-granular helper below — ``copy_page``,
+``read_pages``, ``write_pages``, the slot-pool shardings — treats the
+scales as just more page-axis-1 leaves, so CoW sharing, migration
+artifacts and the data-axis tiling carry the scales with their pages
+automatically.  ``PagePool`` is the host allocator: admission
 reserves ceil((prompt + max_new) / page) pages up front (so a request
 can never run out mid-flight), eviction recycles them.  KV HBM is
 therefore O(pages in use), not O(capacity * max_len), and slots at

@@ -194,6 +194,19 @@ class ServingMetrics:
         self.prefix_saved_tokens = 0
         self.prefix_ttft_hit_ms = StreamingHistogram()
         self.prefix_ttft_miss_ms = StreamingHistogram()
+        # quantized serving (ops/quant.py; docs/SERVING.md "Quantized
+        # serving"): the engine calls configure_memory() when either
+        # weight or KV quantization is on, unlocking summary()["memory"]
+        # — resident weight bytes, page-pool bytes and the dtype pair —
+        # and the greedy-token-disagreement counter the divergence-
+        # sentinel-backed parity checker (ops/quant.assert_stream_close)
+        # bumps when a quantized stream drifts from its reference
+        self._memory_on = False
+        self.weight_bytes: int | None = None
+        self.page_pool_bytes: int | None = None
+        self.weight_dtype: str | None = None
+        self.kv_dtype: str | None = None
+        self.greedy_token_disagreements = 0
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
         # disaggregated prefill/decode handoffs (docs/SERVING.md
@@ -286,6 +299,25 @@ class ServingMetrics:
         """One priority swap-out (serving/engine._preempt)."""
         self.preemptions += 1
 
+    # --------------------------------------------------- quantized serving
+
+    def configure_memory(self, weight_bytes: int, page_pool_bytes: int,
+                         weight_dtype: str, kv_dtype: str) -> None:
+        """Install the resident-bytes gauges (engine construction, only
+        when quantization is on — ``summary()["memory"]`` stays None and
+        tick records byte-stable otherwise)."""
+        self._memory_on = True
+        self.weight_bytes = int(weight_bytes)
+        self.page_pool_bytes = int(page_pool_bytes)
+        self.weight_dtype = weight_dtype
+        self.kv_dtype = kv_dtype
+
+    def record_greedy_disagreement(self, n: int = 1) -> None:
+        """``n`` greedy tokens on which a quantized stream disagreed
+        with its reference (fed by ops/quant.assert_stream_close — the
+        divergence sentinels keep the flight-recorder side)."""
+        self.greedy_token_disagreements += n
+
     def record_migration_out(self) -> None:
         """One prefill-complete carry exported to another replica
         (serving/engine._migrate_ready on a prefill-tier engine)."""
@@ -344,6 +376,9 @@ class ServingMetrics:
         kv_pages_used: int | None = None,
         kv_pages_capacity: int | None = None,
         kv_page_allocs: int = 0, kv_page_frees: int = 0,
+        quantized: dict | None = None,
+        weight_bytes: int | None = None,
+        page_pool_bytes: int | None = None,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -385,7 +420,12 @@ class ServingMetrics:
         ``kv_pages_used``/``kv_pages_capacity`` (hybrid paged-KV
         engines) gauge the page pool at this tick, with
         ``kv_page_allocs``/``kv_page_frees`` the allocator churn in the
-        window — rendered by scripts/obs_report.py."""
+        window — rendered by scripts/obs_report.py.
+        ``quantized`` (int8 serving only — None keeps records
+        byte-stable) is the ``{"weights": dtype, "kv": dtype}`` stamp,
+        with ``weight_bytes``/``page_pool_bytes`` the resident-bytes
+        gauges behind the capacity story (docs/SERVING.md "Quantized
+        serving")."""
         self.ticks += 1
         self.decode_tokens += tokens_emitted
         self.decode_time_s += dt_s
@@ -460,6 +500,11 @@ class ServingMetrics:
                 "kv_page_allocs": kv_page_allocs,
                 "kv_page_frees": kv_page_frees,
             })
+        if quantized is not None:
+            record["quantized"] = quantized
+            record["weight_bytes"] = weight_bytes
+            if page_pool_bytes is not None:
+                record["page_pool_bytes"] = page_pool_bytes
         if self.jsonl_path:
             self._write_jsonl(record)
 
@@ -540,6 +585,14 @@ class ServingMetrics:
                         and self._fpt_decode is not None) else None
                 ),
             },
+            "memory": (None if not self._memory_on else {
+                "weight_bytes": self.weight_bytes,
+                "page_pool_bytes": self.page_pool_bytes,
+                "weight_dtype": self.weight_dtype,
+                "kv_dtype": self.kv_dtype,
+                "greedy_token_disagreements":
+                    self.greedy_token_disagreements,
+            }),
             "kv_pages": (
                 None if self.kv_pages_used is None else {
                     "used": self.kv_pages_used,

@@ -72,6 +72,10 @@ def _hybrid_paged_bench(args) -> dict:
     cfg = get_preset(preset).model
     if not cfg.attn_layer_idx:
         raise SystemExit(f"--hybrid-paged needs a hybrid preset, got {preset}")
+    from mamba_distributed_tpu.ops.quant import apply_dtype_overrides
+
+    cfg = apply_dtype_overrides(cfg, weight_dtype=args.weight_dtype,
+                                kv_dtype=args.kv_dtype)
     if os.environ.get("DECODE_KV_SLOT"):
         # per-slot KV budget = the dense fallback's read span; raising it
         # models a longer-context pool (dense pays more, paged doesn't)
@@ -107,11 +111,20 @@ def _hybrid_paged_bench(args) -> dict:
     nkv, hd = cfg.effective_attn_num_kv_heads, cfg.effective_attn_head_dim
     n_pages = state_cache.hybrid_pool_pages(cfg, S)
     key = jax.random.PRNGKey(1)
-    kv = jax.random.normal(key, (A, n_pages + 1, nkv, pg, hd),
-                           jnp.dtype(cfg.compute_dtype))
+    if cfg.kv_quantized:
+        # int8 pools: random int8 pages + per-(page, head) scales — the
+        # serving layout the kernels dequantize in-register
+        kq = jax.random.randint(key, (A, n_pages + 1, nkv, pg, hd),
+                                -127, 128, jnp.int8)
+        ks = 0.01 * jnp.ones((A, n_pages + 1, nkv), jnp.float32)
+        attn_blocks = (kq, kq, ks, ks)
+    else:
+        kv = jax.random.normal(key, (A, n_pages + 1, nkv, pg, hd),
+                               jnp.dtype(cfg.compute_dtype))
+        attn_blocks = (kv, kv)
     state_blocks = {
         "blocks": init_lm_blocks_state(cfg, S),
-        "attn_blocks": (kv, kv),
+        "attn_blocks": attn_blocks,
     }
     need = -(-(kv_len0 + steps) // pg)
 
@@ -198,6 +211,9 @@ def _hybrid_paged_bench(args) -> dict:
         "kv_pool_pages": n_pages,
         "device": dev.device_kind,
     }
+    if cfg.kv_quantized or cfg.serving_weight_dtype == "int8":
+        record["quantized"] = {"weights": cfg.serving_weight_dtype,
+                               "kv": cfg.kv_page_dtype}
     if args.occupancy:
         record["occupancy_sweep"] = points
     return record
@@ -221,6 +237,14 @@ def main() -> None:
                          "(generate(mesh=); on CPU combine with "
                          "XLA_FLAGS=--xla_force_host_platform_device_"
                          "count=K)")
+    ap.add_argument("--weight-dtype", default=None,
+                    choices=["bf16", "int8"],
+                    help="decode weight dtype (cfg.serving_weight_dtype; "
+                         "int8 = per-channel quantized weights)")
+    ap.add_argument("--kv-dtype", default=None, choices=["bf16", "int8"],
+                    help="KV page dtype for --hybrid-paged "
+                         "(cfg.kv_page_dtype; int8 = quantized pages + "
+                         "per-page scales)")
     args = ap.parse_args()
 
     import jax
@@ -246,6 +270,10 @@ def main() -> None:
     new_tokens = int(os.environ.get("DECODE_NEW", "256"))
     preset = os.environ.get("BENCH_PRESET", "mamba2-280m")
     cfg = get_preset(preset).model
+    from mamba_distributed_tpu.ops.quant import apply_dtype_overrides
+
+    cfg = apply_dtype_overrides(cfg, weight_dtype=args.weight_dtype,
+                                kv_dtype=args.kv_dtype)
 
     key = jax.random.PRNGKey(0)
     params = jax.jit(lambda k: init_lm_params(k, cfg))(key)
@@ -299,6 +327,9 @@ def main() -> None:
     }
     if mesh is not None:
         record["model_shards"] = args.model_shards
+    if cfg.serving_weight_dtype == "int8":
+        record["quantized"] = {"weights": cfg.serving_weight_dtype,
+                               "kv": cfg.kv_page_dtype}
     emit_bench_record(record, args.json)
 
 

@@ -417,10 +417,34 @@ def main() -> None:
                          "count=K).  In the default mode this also times "
                          "a replicated-weights engine on the identical "
                          "workload and reports tp_vs_replicated_speedup")
+    ap.add_argument("--weight-dtype", default=None,
+                    choices=["bf16", "int8"],
+                    help="serving weight dtype (cfg.serving_weight_dtype; "
+                         "int8 = per-channel quantized weights, "
+                         "docs/SERVING.md 'Quantized serving').  Applies "
+                         "to every mode")
+    ap.add_argument("--kv-dtype", default=None, choices=["bf16", "int8"],
+                    help="KV page-pool dtype (cfg.kv_page_dtype; int8 = "
+                         "quantized pages + per-page scales; hybrid "
+                         "presets only).  Applies to every mode")
+    ap.add_argument("--quant", action="store_true",
+                    help="quantized-weights comparison: the default "
+                         "workload through an int8-weight engine vs a "
+                         "bf16 one, reporting tok/s + resident weight "
+                         "bytes for both — the BENCH_SERVING.json "
+                         "quant_weights row")
+    ap.add_argument("--quant-kv-capacity", action="store_true",
+                    help="int8 KV capacity row: pages admissible at a "
+                         "fixed pool byte budget, int8 vs bf16 pages "
+                         "(hybrid preset; expect >= 1.9x) — the "
+                         "BENCH_SERVING.json quant_kv_capacity row")
     args = ap.parse_args()
     modes = [m for m, on in [("--long-prompt", args.long_prompt),
                              ("--shared-prefix", args.shared_prefix),
                              ("--disagg", args.disagg),
+                             ("--quant", args.quant),
+                             ("--quant-kv-capacity",
+                              args.quant_kv_capacity),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
         ap.error(f"{' and '.join(modes)} are separate bench modes; "
@@ -474,6 +498,20 @@ def main() -> None:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, serving_model_shards=model_shards)
+    from mamba_distributed_tpu.ops.quant import apply_dtype_overrides
+
+    kv_dtype = args.kv_dtype or os.environ.get("SERVE_KV_DTYPE")
+    cfg = apply_dtype_overrides(
+        cfg,
+        weight_dtype=args.weight_dtype
+        or os.environ.get("SERVE_WEIGHT_DTYPE"),
+        kv_dtype=kv_dtype,
+    )
+    if kv_dtype == "int8" and not cfg.attn_layer_idx:
+        raise SystemExit(
+            f"--kv-dtype int8 needs a hybrid preset (paged KV); "
+            f"{preset} has no attention layers"
+        )
     params = jax.jit(lambda k: init_lm_params(k, cfg))(jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     _progress("params initialized")
@@ -511,6 +549,119 @@ def main() -> None:
             jax.block_until_ready(out)
         dt_seq = time.perf_counter() - t0
         return served, dt_serve, dt_seq, metrics.summary()
+
+    if args.quant_kv_capacity:
+        # pages admissible at a FIXED pool byte budget, int8 vs bf16 —
+        # a pure layout computation (no timing): bytes of one physical
+        # page across every attention layer's K+V pool (+ the int8
+        # scale rows), from the pool pytrees themselves so the row can
+        # never drift from what init_pool actually allocates
+        import dataclasses
+
+        from mamba_distributed_tpu.serving import state_cache
+
+        if not cfg.attn_layer_idx:
+            raise SystemExit(
+                f"--quant-kv-capacity needs a hybrid preset (paged KV); "
+                f"{preset} has no attention layers"
+            )
+
+        def bytes_per_page(c):
+            pool = state_cache.init_pool(c, capacity)
+            leaves = jax.tree.leaves(pool["state"]["attn_blocks"])
+            return sum(x.nbytes for x in leaves) / leaves[0].shape[1]
+
+        bf16_bpp = bytes_per_page(
+            dataclasses.replace(cfg, kv_page_dtype="bf16"))
+        int8_bpp = bytes_per_page(
+            dataclasses.replace(cfg, kv_page_dtype="int8"))
+        # budget = the bf16 pool's HBM (trash page included, like the
+        # per-page figure)
+        n_pages = state_cache.hybrid_pool_pages(cfg, capacity) + 1
+        budget = bf16_bpp * n_pages
+        pages_bf16 = int(budget // bf16_bpp)
+        pages_int8 = int(budget // int8_bpp)
+        ratio = round(pages_int8 / pages_bf16, 3)
+        record = {
+            "metric": (f"serving_quant_kv_capacity_ratio_"
+                       f"{preset.replace('-', '_')}"),
+            "value": ratio,
+            "unit": ("x pages admissible at the bf16 pool's byte "
+                     "budget, int8 vs bf16 pages"),
+            "pool_bytes_budget": int(budget),
+            "bytes_per_page_bf16": round(bf16_bpp, 1),
+            "bytes_per_page_int8": round(int8_bpp, 1),
+            "pages_bf16": pages_bf16,
+            "pages_int8": pages_int8,
+            "slots_bf16": capacity,
+            "slots_int8": int(capacity * ratio),
+            "kv_page_tokens": cfg.kv_page_tokens,
+            "kv_slot_tokens": cfg.kv_slot_tokens,
+            "capacity": capacity,
+            "device": dev.device_kind,
+        }
+        _progress(f"int8 pages/bf16 pages at fixed bytes: {ratio}x")
+        emit_bench_record(record, args.json)
+        return
+
+    if args.quant:
+        # quantized-weights comparison: the default workload through an
+        # int8-weight engine vs a bf16 one (same requests, same seeds),
+        # reporting tok/s + resident weight bytes for both.  On CPU the
+        # tok/s delta is a trajectory marker (XLA re-widens int8 to f32
+        # on the host); the BYTES column is the capacity claim.
+        import dataclasses
+
+        from mamba_distributed_tpu.ops.quant import param_bytes
+        from mamba_distributed_tpu.serving import GenerationRequest
+
+        requests = _workload(rng, n_requests, pmin, pmax, max_new,
+                             cfg.vocab_size)
+
+        def fresh():
+            return [GenerationRequest(
+                prompt_ids=np.asarray(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens, seed=r.seed,
+            ) for r in requests]
+
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        out = {}
+        for wd in ("int8", "bf16"):
+            mode_cfg = dataclasses.replace(cfg, serving_weight_dtype=wd)
+            eng = ServingEngine(params, mode_cfg, **kw)
+            eng.run(fresh())  # warm every jit signature
+            _progress(f"{wd}: warm")
+            eng = ServingEngine(params, mode_cfg, **kw)
+            t0 = time.perf_counter()
+            results = eng.run(fresh())
+            dt = time.perf_counter() - t0
+            tokens = sum(len(r.new_tokens) for r in results)
+            out[f"tokens_per_sec_{wd}"] = round(tokens / dt, 1)
+            out[f"weight_bytes_{wd}"] = param_bytes(eng._params)
+            out[f"wall_s_{wd}"] = round(dt, 3)
+            _progress(f"{wd}: {out[f'tokens_per_sec_{wd}']} tok/s, "
+                      f"{out[f'weight_bytes_{wd}']} resident weight bytes")
+        record = {
+            "metric": (f"serving_quant_weights_tokens_per_sec_"
+                       f"{preset.replace('-', '_')}"),
+            "value": out["tokens_per_sec_int8"],
+            "unit": "sampled tokens/sec (int8 per-channel weights)",
+            **out,
+            "weight_bytes_ratio": round(
+                out["weight_bytes_bf16"] / out["weight_bytes_int8"], 3),
+            "int8_vs_bf16_speedup": round(
+                out["tokens_per_sec_int8"] / out["tokens_per_sec_bf16"],
+                2),
+            "requests": n_requests,
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "max_new_tokens": max_new,
+            "kv_dtype": cfg.kv_page_dtype,
+            "device": dev.device_kind,
+        }
+        emit_bench_record(record, args.json)
+        return
 
     if args.disagg:
         from mamba_distributed_tpu.serving import GenerationRequest

@@ -229,6 +229,18 @@ def build_report(events: list[dict]) -> dict:
                     if mfu_den else None
                 ),
             }
+        # quantized-serving gauges (absent unless an int8 engine wrote
+        # the stream): the dtype stamp + resident-bytes from the last
+        # stamped tick (docs/SERVING.md "Quantized serving")
+        qticks = [e for e in ticks if e.get("quantized") is not None]
+        memory = None
+        if qticks:
+            last = qticks[-1]
+            memory = {
+                "quantized": last["quantized"],
+                "weight_bytes": last.get("weight_bytes"),
+                "page_pool_bytes": last.get("page_pool_bytes"),
+            }
         report["serving"] = {
             "ticks": len(ticks),
             "decode_tokens": tokens,
@@ -252,6 +264,7 @@ def build_report(events: list[dict]) -> dict:
             "preemptions": preemptions,
             "migrations": {"handoffs": handoffs} if handoffs else None,
             "kv_pages": kv_pages,
+            "memory": memory,
         }
 
     # --- per-replica split (the data-parallel serving fabric): tick and
@@ -540,6 +553,15 @@ def format_report(report: dict) -> str:
                 f"\nkv pages: peak {kv['peak_used']}/{_fmt(kv['capacity'])}"
                 f"   mean {kv['mean_used']}   allocs {kv['allocs']}"
                 f"   frees {kv['frees']}"
+            )
+        if s.get("memory"):
+            m = s["memory"]
+            q = m["quantized"]
+            head += (
+                f"\nquantized: weights={q.get('weights')} "
+                f"kv={q.get('kv')}   weight bytes: "
+                f"{_fmt(m['weight_bytes'])}   page pool bytes: "
+                f"{_fmt(m['page_pool_bytes'])}"
             )
         rows = [_pct_row("tick_ms", s["tick_ms"])]
         if s.get("prefill_stall_ms") is not None:
