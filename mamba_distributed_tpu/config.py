@@ -255,6 +255,32 @@ class ModelConfig:
     # bytes => ~2x pages per chip at fixed pool HBM (the
     # ``quant_kv_capacity`` bench row).
     kv_page_dtype: str = "bf16"
+    # --- speculative decoding (serving/spec_decode.py; docs/SERVING.md
+    # "Speculative decoding") ---
+    # Draft tokens verified per serving tick.  0 (default) disables —
+    # the byte-stable status quo: one token per slot per launch.  K > 0
+    # turns every decode tick into a K-token draft/verify step: a
+    # drafter proposes K cheap continuation guesses per slot and ONE
+    # chunk-machinery launch (models/lm.lm_verify_chunk) scores all
+    # K+1 positions at once, committing the longest correct prefix —
+    # up to K+2 tokens per full-model weight read instead of 1.
+    # Greedy-only (requests must use top_k=1; speculation is lossless
+    # under argmax — streams stay token-identical to non-speculative
+    # greedy).  Both the serving engine and ``generate()`` read this
+    # knob, so the two paths speculate identically (the parity
+    # contract, tests/test_spec_decode.py).
+    spec_tokens: int = 0
+    # Who proposes the K draft tokens: "ngram" (host-side prompt-lookup
+    # cache over each stream's own prompt + emitted tokens — free, and
+    # strong on repetitive/code-like text) or "model" (a small
+    # companion LM running the same ``lm_step`` at a tiny config; the
+    # engine/generate() take the ``Drafter`` instance since the
+    # companion's params aren't derivable from this config).  Draft
+    # quality only moves the acceptance rate, never the tokens.
+    spec_drafter: str = "ngram"
+    # Longest suffix n-gram the "ngram" drafter matches against the
+    # stream's history before falling back to shorter ones.
+    spec_ngram_order: int = 3
     # Tensor-parallel shards of the serving WEIGHTS over `mesh.model`
     # (the 2-D serving mesh's second axis): Mamba d_inner channels,
     # attention heads and the embedding/head vocab axis split across
@@ -380,6 +406,21 @@ class ModelConfig:
             raise ValueError(
                 f"kv_page_dtype must be 'bf16' (compute-dtype pages, the "
                 f"status quo) or 'int8', got {self.kv_page_dtype!r}"
+            )
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0 (0 disables speculative "
+                f"decoding), got {self.spec_tokens}"
+            )
+        if self.spec_drafter not in ("ngram", "model"):
+            raise ValueError(
+                f"spec_drafter must be 'ngram' or 'model', got "
+                f"{self.spec_drafter!r}"
+            )
+        if self.spec_ngram_order < 1:
+            raise ValueError(
+                f"spec_ngram_order must be >= 1, got "
+                f"{self.spec_ngram_order}"
             )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(

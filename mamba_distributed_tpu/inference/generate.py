@@ -267,6 +267,7 @@ def generate(
     length_bucketing: bool = True,
     mesh=None,
     prefix_cache=None,
+    drafter=None,
 ) -> jax.Array:
     """prompt_ids (b, t) int32 -> (b, t + max_new_tokens) sampled tokens.
 
@@ -304,6 +305,18 @@ def generate(
     snapshot is the identical computation's literal output.  Hybrid
     configs ignore the cache here (their entries pin a serving
     engine's KV page pool).
+
+    ``cfg.spec_tokens > 0`` routes greedy (``top_k=1``) batch-1 calls
+    through the SPECULATIVE path (serving/spec_decode.spec_generate):
+    the identical draft -> verify -> accept/rollback loop the serving
+    engine's spec tick runs, so engine==generate() parity holds by
+    construction there too — and greedy speculative streams are token-
+    identical to non-speculative greedy ones (speculation is lossless
+    under argmax).  ``drafter`` overrides the config-built drafter (a
+    serving/spec_decode.Drafter — required for ``spec_drafter=
+    "model"``, whose companion params aren't derivable from cfg); it
+    only moves the acceptance rate, never the tokens.  Non-greedy or
+    batched calls fall through to the normal path unchanged.
     """
     b, t = prompt_ids.shape
     hybrid = bool(cfg.attn_layer_idx)
@@ -313,6 +326,16 @@ def generate(
         # for generate() to constrain; dropping it keeps the TP-off jit
         # signatures (and pinned trace counts) identical to pre-TP
         mesh = None
+    if cfg.spec_tokens > 0 and top_k == 1 and b == 1 and length_bucketing:
+        # deferred import: serving imports this module at package-load
+        # time, so the reverse edge must stay out of import time
+        from mamba_distributed_tpu.serving.spec_decode import spec_generate
+
+        return spec_generate(
+            params, cfg, prompt_ids, max_new_tokens=max_new_tokens,
+            eos_id=eos_id, mesh=mesh, prefix_cache=prefix_cache,
+            drafter=drafter,
+        )
     if length_bucketing and (
         (chunk > 0) if hybrid else use_chunked_prefill(t, chunk)
     ):

@@ -834,6 +834,49 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     Returns (last_logits (b, V) fp32, new state) — same contract as
     ``lm_prefill``.
     """
+    hidden, residual, new_state = _chunk_backbone(
+        params, cfg, input_ids, state, token_mask
+    )
+    logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
+    return logits[:, 0].astype(jnp.float32), new_state
+
+
+def lm_verify_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
+                    state, token_mask: jax.Array | None = None):
+    """Speculative-decoding VERIFY step: the chunk machinery of
+    ``lm_prefill_chunk`` (identical carry threading, identical paged KV
+    chunk write for hybrids) but returning the logits of EVERY position
+    — ``(logits (b, c, V) fp32, new state)`` where ``logits[:, i]``
+    scores the token AFTER ``input_ids[:, i]``.
+
+    This is the whole trick (serving/spec_decode.py): one launch reads
+    the weights ONCE and prices all ``c = K+1`` positions of a drafted
+    continuation, where the decode tick would pay one full weight read
+    per token.  The caller compares ``argmax(logits[:, i-1])`` against
+    the fed draft at ``i`` to find the longest correct prefix, commits
+    it, and rolls back the carries on a rejection (the returned state
+    reflects ALL ``c`` fed tokens, so it is only committable when every
+    one of them verified — the pending-token scheme in
+    serving/spec_decode.py keeps that an all-or-nothing choice).
+
+    Hybrid note: the chunk's K/V page writes land at ``[lengths,
+    lengths + n_real)`` exactly like a prefill chunk; on rollback the
+    caller simply does not advance its ``lengths`` mirror, so the
+    written cells are dead-by-``lengths`` and the next verify rewrites
+    them — the same invariant the ragged kernels already honor for
+    masked rows."""
+    hidden, residual, new_state = _chunk_backbone(
+        params, cfg, input_ids, state, token_mask
+    )
+    logits = _final_logits(params, cfg, hidden, residual)
+    return logits.astype(jnp.float32), new_state
+
+
+def _chunk_backbone(params: dict, cfg: ModelConfig, input_ids: jax.Array,
+                    state, token_mask: jax.Array | None = None):
+    """Shared body of ``lm_prefill_chunk``/``lm_verify_chunk``: embed ->
+    carry-threaded layer stack -> (hidden, residual, new state).  One
+    implementation so the prefill and verify paths cannot diverge."""
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = _embed(params, input_ids, compute_dtype)
     residual = jnp.zeros_like(
@@ -934,8 +977,7 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
                 lambda *xs: jnp.stack(xs), *sts
             )
             new_blocks, new_a = stack(new_ms), stack(new_as)
-        logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
-        return logits[:, 0].astype(jnp.float32), {
+        return hidden, residual, {
             "blocks": new_blocks,
             "attn_blocks": new_a,
             "attn_meta": (tbl, lengths + n_real),
@@ -944,8 +986,7 @@ def lm_prefill_chunk(params: dict, cfg: ModelConfig, input_ids: jax.Array,
     (hidden, residual), state_blocks = jax.lax.scan(
         body, (hidden, residual), (params["blocks"], state["blocks"])
     )
-    logits = _final_logits(params, cfg, hidden[:, -1:], residual[:, -1:])
-    return logits[:, 0].astype(jnp.float32), {"blocks": state_blocks}
+    return hidden, residual, {"blocks": state_blocks}
 
 
 def init_lm_blocks_state(cfg: ModelConfig, batch: int):

@@ -26,6 +26,11 @@ docs/SERVING.md has the architecture; the short version:
                by prompt-prefix hash — near-zero TTFT for shared
                prompts; hybrid entries pin KV pages copy-on-write
                (docs/SERVING.md "Prefix caching & preemption")
+  spec_decode  speculative decoding on the chunk machinery: K-token
+               draft-verify ticks (one lm_verify_chunk launch commits
+               up to K+2 greedy tokens per full weight read) with
+               n-gram and companion-model drafters — lossless under
+               argmax (docs/SERVING.md "Speculative decoding")
 """
 
 from mamba_distributed_tpu.serving.engine import ServingEngine
@@ -44,6 +49,11 @@ from mamba_distributed_tpu.serving.prefill import (
     chunked_prefill,
     plan_chunks,
 )
+from mamba_distributed_tpu.serving.spec_decode import (
+    Drafter,
+    ModelDrafter,
+    NGramDrafter,
+)
 from mamba_distributed_tpu.serving.scheduler import (
     FCFSScheduler,
     GenerationRequest,
@@ -61,7 +71,10 @@ from mamba_distributed_tpu.serving.state_cache import (
 
 __all__ = [
     "ChunkPlan",
+    "Drafter",
     "EngineReplica",
+    "ModelDrafter",
+    "NGramDrafter",
     "FCFSScheduler",
     "GenerationRequest",
     "GenerationResult",

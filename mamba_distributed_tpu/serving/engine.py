@@ -22,6 +22,13 @@ admission, not counted against the chunk budget (they are at most
 requests and the ITL of running slots while a long prompt streams in —
 the head-of-line blocking ``bench_serving --long-prompt`` measures.
 
+Speculative decoding (``cfg.spec_tokens = K > 0``; serving/
+spec_decode.py, docs/SERVING.md "Speculative decoding") swaps the
+decode tick for a K-token draft-verify tick: one ``lm_verify_chunk``
+launch scores a drafter's K guesses for every live slot and commits
+the longest correct prefix — up to K+2 tokens per full weight read,
+greedy-only and token-identical to the non-speculative stream.
+
 Parity contract: a request's token stream is bit-identical to a solo
 ``generate(params, cfg, prompt[None], key, ...)`` call with the same key
 whenever ``request.top_k == engine.max_top_k`` (the static top-k width),
@@ -66,6 +73,7 @@ from mamba_distributed_tpu.models.lm import (
     lm_step,
 )
 from mamba_distributed_tpu.serving import prefix_cache as prefix_cache_mod
+from mamba_distributed_tpu.serving import spec_decode
 from mamba_distributed_tpu.serving import state_cache
 from mamba_distributed_tpu.serving.prefix_cache import PrefixCache
 from mamba_distributed_tpu.serving.prefill import (
@@ -321,6 +329,17 @@ class ServingEngine:
         and ``state_cache.restore`` — the resumed stream is bit-exact
         (the preempt/resume contract, tests/test_disagg.py).
 
+      drafter: a ``serving/spec_decode.Drafter`` for speculative
+        decoding (only read when ``cfg.spec_tokens > 0``).  None builds
+        the config's drafter (``spec_drafter="ngram"``; ``"model"``
+        REQUIRES an explicit ``ModelDrafter(draft_params, draft_cfg)``
+        — the companion's params aren't derivable from cfg).  Draft
+        quality moves the acceptance rate, never the tokens (greedy
+        speculation is lossless), so any drafter is parity-safe.
+        Drafter streams are keyed by ENGINE-LOCAL request ids — give
+        each engine/replica its own instance rather than sharing one
+        across a router fabric.
+
     Priority + preemption: requests carry a ``priority`` (higher wins;
     default ``cfg.serving_default_priority``).  When the queue's best
     request outranks a resident DECODING slot and no slot is free, the
@@ -352,6 +371,7 @@ class ServingEngine:
         mesh=None,
         prefix_cache: PrefixCache | None = None,
         migrate_hook=None,
+        drafter: spec_decode.Drafter | None = None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -460,6 +480,34 @@ class ServingEngine:
         # --- hybrid paged-KV bookkeeping (host-owned; the tick takes the
         # sliced table + lengths as plain arguments, so admission/evict
         # page moves are pure host work) ---
+        # --- speculative decoding (serving/spec_decode.py; docs/
+        # SERVING.md "Speculative decoding").  K = cfg.spec_tokens > 0
+        # swaps the decode tick for a draft-verify tick: one
+        # lm_verify_chunk launch of width W = K+1 per step, committing
+        # the longest correct prefix (up to W+1 tokens) per full weight
+        # read.  Greedy-only — submit() rejects top_k != 1.  K = 0 is
+        # the byte-stable status quo: no spec state, no record stamps,
+        # identical traces.
+        self.spec = cfg.spec_tokens > 0
+        if self.spec:
+            # tokens_per_tick paces the NON-speculative tick; in spec
+            # mode each step runs exactly one verify launch instead
+            self.spec_width = cfg.spec_tokens + 1
+            self.drafter = (drafter if drafter is not None
+                            else spec_decode.make_drafter(cfg))
+            self._spec_drafted = 0  # per-window gauges -> serving_tick
+            self._spec_accepted = 0
+            self._spec_streams = 0  # live slot-launches in the window
+            # verify lanes the LAST tick computed: debited from the next
+            # step's chunk-prefill budget so speculation's extra per-step
+            # work is accounted against the same interleaving bound
+            # (the serving_mfu / ITL honesty contract)
+            self._spec_budget_debt = 0
+            self.metrics.configure_speculation(
+                cfg.spec_tokens, cfg.spec_drafter
+            )
+        else:
+            self.drafter = None
         self.hybrid = bool(cfg.attn_layer_idx)
         if self.hybrid:
             self.page_pool = state_cache.PagePool(
@@ -467,8 +515,15 @@ class ServingEngine:
                                               self.num_shards),
                 num_shards=self.num_shards,
             )
+            # spec mode appends one permanent trash column: the verify
+            # chunk may write up to W tokens past a slot's reservation
+            # (drafts beyond its budget), and those writes must clamp
+            # onto a trash entry — never wrap onto the slot's own last
+            # live page (attention_mixer_chunk clips page indices to
+            # the table width)
             self._page_tbl = np.zeros(
-                (capacity, cfg.kv_pages_per_slot), np.int32
+                (capacity, cfg.kv_pages_per_slot + (1 if self.spec else 0)),
+                np.int32,
             )
             self._kv_len = np.zeros((capacity,), np.int32)
             self._page_allocs = 0  # per-step gauges -> serving_tick
@@ -562,6 +617,14 @@ class ServingEngine:
                 f"request top_k={request.top_k} must be in "
                 f"[1, max_top_k={self.max_top_k}]"
             )
+        if self.spec and request.top_k != 1:
+            raise ValueError(
+                f"speculative decoding (cfg.spec_tokens="
+                f"{self.cfg.spec_tokens}) is greedy-only: request "
+                f"top_k={request.top_k} must be 1 (argmax).  Sampling-"
+                f"mode rejection sampling is a ROADMAP residual; serve "
+                f"sampled requests on a spec_tokens=0 engine"
+            )
         if self.hybrid:
             need = len(request.prompt_ids) + request.max_new_tokens
             if need > self.cfg.kv_slot_tokens:
@@ -612,6 +675,21 @@ class ServingEngine:
         if snapshot.get("t_submit") is not None:
             tracked.t_submit = snapshot["t_submit"]
         return tracked.request_id
+
+    def _seed_spec(self, tracked: _Tracked, logits) -> None:
+        """Seed a freshly-decodable slot's pending queue with the greedy
+        argmax of its prefill logits — the exact token the first
+        non-speculative tick would emit, and the anchor the drafter
+        needs to propose continuations.  The ``np.asarray`` fetch is
+        the one extra host sync speculation costs per REQUEST (every
+        subsequent next-token comes back inside the tick's own greedy
+        fetch).  No-op when speculation is off."""
+        if not self.spec:
+            return
+        tracked.spec_pending = [spec_decode.greedy_token(
+            np.asarray(logits).reshape(-1), self.cfg.vocab_size
+        )]
+        tracked.spec_pending_emitted = 0
 
     def _slot_shard(self, slot: int) -> int:
         """Which data shard holds ``slot``'s pool rows (NamedSharding
@@ -784,6 +862,7 @@ class ServingEngine:
                         r.temperature,
                         -1 if r.eos_id is None else r.eos_id,
                     )
+                    self._seed_spec(tracked, entry.logits)
             elif entry is not None:
                 # partial hit: seed the cached carry; chunking resumes
                 # at the first uncached chunk (the remaining chunks run
@@ -822,6 +901,7 @@ class ServingEngine:
                         r.max_new_tokens, r.top_k, r.temperature,
                         -1 if r.eos_id is None else r.eos_id,
                     )
+                    self._seed_spec(tracked, logits)
                     if self.prefix_cache is not None:
                         # snapshot the one-shot prefill's output (state
                         # was NOT donated by insert — safe to retain):
@@ -964,6 +1044,7 @@ class ServingEngine:
                 self.pool = state_cache.finish_prefill(
                     self.pool, slot, state, logits
                 )
+                self._seed_spec(tracked, logits)
                 self._prefill_queue.remove(slot)
                 tracked.status = RequestStatus.DECODE
                 # a partial hit seeded prefill_seeded_tokens of this
@@ -1284,6 +1365,13 @@ class ServingEngine:
             self._free.sort()
             self.scheduler.requeue(tracked)
             raise
+        if self.spec and not tracked.spec_pending:
+            # a MIGRATED-in request arrives with a fresh tracker: derive
+            # its first pending token from the artifact's logits — the
+            # same bits the source engine's seed would have used, so the
+            # resumed stream matches a never-migrated one exactly.  A
+            # locally-preempted request keeps its surviving pending.
+            self._seed_spec(tracked, snap["logits"])
         tracked.snapshot = None
         tracked.slot = slot
         tracked.status = RequestStatus.DECODE
@@ -1372,6 +1460,10 @@ class ServingEngine:
                 del self._slots[slot]
                 self._free.append(slot)
                 self._free.sort()
+                if self.spec:
+                    # the target engine reseeds from the artifact's
+                    # logits and restarts its own drafter stream
+                    self.drafter.forget(tracked.request_id)
                 self._migrations_out += 1
                 self.metrics.record_migration_out()
             else:
@@ -1471,6 +1563,14 @@ class ServingEngine:
                         break
         budget = self.prefill_tokens_per_tick
         left = float("inf") if budget == 0 else float(budget)
+        if self.spec and budget:
+            # verify ticks consume token lanes of interleaving budget
+            # too (the previous tick computed live * (K+1) chunk-width
+            # lanes): debit them so speculation on + chunked prefill
+            # never exceeds the per-step work bound the knob promises.
+            # The >=1-chunk progress guarantee below still holds.
+            left = max(0.0, left - self._spec_budget_debt)
+            self._spec_budget_debt = 0
         chunks_run = 0
         while self._prefill_queue and (left > 0 or chunks_run == 0):
             left = self._advance_prefill(self._pick_prefill_slot(), left)
@@ -1487,6 +1587,143 @@ class ServingEngine:
     def pending(self) -> int:
         """Requests not yet finished (queued + in-flight)."""
         return self.scheduler.depth + len(self._slots)
+
+    def _spec_tick(self):
+        """One speculative draft-verify tick (serving/spec_decode.py).
+
+        Per live slot: compose the feed (its pending committed tokens +
+        up to K drafter proposals, zero-filled to the static width W),
+        run ONE ``spec_verify`` launch over the whole pool, fetch the
+        (S, W) greedy matrix — the tick's one host sync — and decide
+        per slot: a full verification commits the launch's carries and
+        final logits outright (the state advanced W tokens) plus one
+        bonus token from the final position's argmax; any rejection
+        rolls the slot back to its pre-tick carries (``spec_commit``'s
+        per-row select) and banks the accepted prefix + the model's
+        correction token as the next tick's trusted feed — every launch
+        commits >= 1 token per live slot.  Mid-prefill/empty/done slots
+        are masked (their KV writes flush to trash, their garbage
+        carries are discarded by the rollback select), exactly like the
+        non-speculative tick's ``write_mask``.
+
+        Returns ``(tokens, emitted, done)`` shaped (W+1, S) — the same
+        matrices the compiled tick yields, so ``step()``'s event/
+        latency/finish plumbing is shared verbatim."""
+        W = self.spec_width
+        S = self.capacity
+        live = {s: t for s, t in self._slots.items()
+                if t.status is RequestStatus.DECODE}
+        ids = np.zeros((S, W), np.int32)
+        tmask = np.zeros((S, W), np.float32)
+        trusted: dict[int, int] = {}
+        for slot, tr in live.items():
+            rid = tr.request_id
+            if tr.spec_observed == 0:
+                # fresh (or restarted-after-requeue) stream: drop any
+                # stale drafter state before re-observing from scratch
+                self.drafter.forget(rid)
+            # committed history the drafter must know is prompt +
+            # emitted + the still-unemitted pending (fresh tok0);
+            # spec_observed counts how much of that concatenation the
+            # drafter has seen, so only the SUFFIX is materialized —
+            # never the whole history (O(new tokens) per tick, not
+            # O(prompt + stream))
+            pend = tr.spec_pending[tr.spec_pending_emitted:]
+            plen = len(tr.request.prompt_ids)
+            total = plen + len(tr.new_tokens) + len(pend)
+            if total > tr.spec_observed:
+                k = tr.spec_observed - plen
+                if k < 0:
+                    delta = (tr.request.prompt_ids[k:].tolist()
+                             + tr.new_tokens + pend)
+                elif k <= len(tr.new_tokens):
+                    delta = tr.new_tokens[k:] + pend
+                else:
+                    delta = pend[k - len(tr.new_tokens):]
+                self.drafter.observe(rid, delta)
+                tr.spec_observed = total
+            n = W - len(tr.spec_pending)
+            drafts = (list(self.drafter.draft(rid, n))[:n] if n > 0
+                      else [])
+            self._spec_drafted += n
+            ids[slot] = spec_decode.build_feed(tr.spec_pending, drafts, W)
+            tmask[slot] = 1.0
+            trusted[slot] = len(tr.spec_pending)
+        state_in = dict(self.pool["state"])
+        if self.hybrid:
+            # +1 past the largest allocation so a fully-reserved slot's
+            # overshoot writes clamp onto a zero (trash) table entry —
+            # the table rows carry a permanent spare column for exactly
+            # this (see __init__)
+            largest = max(
+                (len(t.pages) for t in self._slots.values() if t.pages),
+                default=1,
+            )
+            bucket = min(next_pow2_bucket(largest + 1, min_bucket=1),
+                         self._page_tbl.shape[1])
+            state_in["attn_meta"] = (
+                jnp.asarray(self._page_tbl[:, :bucket]),
+                jnp.asarray(self._kv_len),
+            )
+        greedy_d, final_logits, new_state, old = spec_decode.spec_verify(
+            self._params, state_in, jnp.asarray(ids), jnp.asarray(tmask),
+            cfg=self.cfg, mesh=self._tp_mesh,
+        )
+        greedy = np.asarray(greedy_d)  # (S, W) — the host sync point
+        tokens = np.zeros((W + 1, S), np.int32)
+        emitted = np.zeros((W + 1, S), bool)
+        done = np.zeros((W + 1, S), bool)
+        advance = np.zeros((S,), bool)
+        for slot, tr in live.items():
+            nt = trusted[slot]
+            fed = ids[slot].tolist()
+            a, adv, nxt = spec_decode.verify_greedy(fed, greedy[slot], nt)
+            self._spec_accepted += a
+            pending = tr.spec_pending
+            stream = (pending[tr.spec_pending_emitted:]
+                      + fed[nt:nt + a] + [nxt])
+            r = tr.request
+            emitted_now: list[int] = []
+            finished = False
+            for tok in stream:
+                emitted_now.append(tok)
+                # the same finish rule the compiled tick applies: the
+                # eos/budget token itself is emitted, nothing after it
+                if r.eos_id is not None and tok == r.eos_id:
+                    finished = True
+                    break
+                if (len(tr.new_tokens) + len(emitted_now)
+                        >= r.max_new_tokens):
+                    finished = True
+                    break
+            for j, tok in enumerate(emitted_now):
+                tokens[j, slot] = tok
+                emitted[j, slot] = True
+            if finished:
+                done[len(emitted_now) - 1, slot] = True
+            elif adv:
+                advance[slot] = True
+                tr.spec_pending = [nxt]
+                tr.spec_pending_emitted = 1
+            else:
+                tr.spec_pending = pending + fed[nt:nt + a] + [nxt]
+                tr.spec_pending_emitted = len(tr.spec_pending)
+        # next step's chunk budget pays for this tick's verify lanes
+        self._spec_budget_debt = len(live) * W
+        self._spec_streams += len(live)
+        new_state = {k: v for k, v in new_state.items()
+                     if k != "attn_meta"}
+        self.pool = spec_decode.spec_commit(
+            new_state, old["blocks"], self.pool["logits"],
+            self.pool["meta"], final_logits, jnp.asarray(advance),
+            jnp.int32(W),
+        )
+        if self.hybrid:
+            # lengths advance by the full chunk width on accepted rows
+            # only; rejected rows' freshly written cells stay dead-by-
+            # lengths and the next verify overwrites them
+            self._kv_len += (W * advance).astype(np.int32)
+        return tokens, emitted, done
 
     def step(self) -> list[TokenEvent]:
         """One engine iteration: prefill phase (admissions + chunk
@@ -1524,39 +1761,47 @@ class ServingEngine:
         t0 = time.perf_counter()
         with self.tracer.span("serving_tick", occupied=occupied,
                               traces=live_traces):
-            tick_kv = ()
-            if self.hybrid:
-                # page-count BUCKET: pow2 of the largest resident
-                # allocation, so the tick's attention reads scale with
-                # what is actually live (one trace per bucket; bucket
-                # width changes never perturb token streams — masked
-                # attention is bit-stable across page-bucket widths,
-                # models/attention.py)
-                largest = max(
-                    (len(t.pages) for t in self._slots.values()
-                     if t.pages), default=1,
+            if self.spec:
+                # speculative draft-verify tick: one lm_verify_chunk
+                # launch commits up to spec_width+1 tokens per slot
+                # (serving/spec_decode.py); _spec_tick owns the hybrid
+                # lengths mirror (it advances by the chunk width only
+                # on full accepts)
+                tokens, emitted, done = self._spec_tick()
+            else:
+                tick_kv = ()
+                if self.hybrid:
+                    # page-count BUCKET: pow2 of the largest resident
+                    # allocation, so the tick's attention reads scale
+                    # with what is actually live (one trace per bucket;
+                    # bucket width changes never perturb token streams —
+                    # masked attention is bit-stable across page-bucket
+                    # widths, models/attention.py)
+                    largest = max(
+                        (len(t.pages) for t in self._slots.values()
+                         if t.pages), default=1,
+                    )
+                    bucket = min(next_pow2_bucket(largest, min_bucket=1),
+                                 self._page_tbl.shape[1])
+                    tick_kv = (jnp.asarray(self._page_tbl[:, :bucket]),
+                               jnp.asarray(self._kv_len))
+                self.pool, tokens, emitted, done = _tick(
+                    self._params, self.pool, *tick_kv, cfg=self.cfg,
+                    k_max=self.max_top_k, steps=self.tokens_per_tick,
+                    mesh=self.mesh,
                 )
-                bucket = min(next_pow2_bucket(largest, min_bucket=1),
-                             self._page_tbl.shape[1])
-                tick_kv = (jnp.asarray(self._page_tbl[:, :bucket]),
-                           jnp.asarray(self._kv_len))
-            self.pool, tokens, emitted, done = _tick(
-                self._params, self.pool, *tick_kv, cfg=self.cfg,
-                k_max=self.max_top_k, steps=self.tokens_per_tick,
-                mesh=self.mesh,
-            )
-            tokens = np.asarray(tokens)  # (steps, S) — the host sync point
-            emitted = np.asarray(emitted)
-            done = np.asarray(done)
-        if self.hybrid:
-            # mirror the device-side lengths advance: +1 per live
-            # sub-step, which is exactly what `emitted` marks
-            self._kv_len += emitted.sum(axis=0).astype(np.int32)
+                tokens = np.asarray(tokens)  # (steps, S) — the host sync
+                emitted = np.asarray(emitted)
+                done = np.asarray(done)
+                if self.hybrid:
+                    # mirror the device-side lengths advance: +1 per
+                    # live sub-step, exactly what `emitted` marks
+                    self._kv_len += emitted.sum(axis=0).astype(np.int32)
         t_now = time.perf_counter()
         dt = t_now - t0
 
         events: list[TokenEvent] = []
-        for j in range(self.tokens_per_tick):
+        for j in range(tokens.shape[0]):
             for slot, tracked in self._slots.items():
                 if not emitted[j, slot]:
                     continue
@@ -1608,6 +1853,8 @@ class ServingEngine:
             self.pool = state_cache.evict(self.pool, slot)
             self._release_pages(slot, tracked)
             self._free.append(slot)
+            if self.spec:
+                self.drafter.forget(tracked.request_id)
             r = tracked.request
             request_record = {
                 "request_id": tracked.request_id,
@@ -1679,6 +1926,19 @@ class ServingEngine:
             self._pc_hits = 0
             self._pc_misses = 0
             self._pc_saved_tokens = 0
+        spec_gauges = {}
+        if self.spec:
+            # draft/accept counters ride every tick record when
+            # speculation is on (absent at K=0 — records byte-stable);
+            # obs_report.py renders the "speculation:" roll-up line
+            spec_gauges = dict(
+                spec_drafted=self._spec_drafted,
+                spec_accepted=self._spec_accepted,
+                spec_streams=self._spec_streams,
+            )
+            self._spec_drafted = 0
+            self._spec_accepted = 0
+            self._spec_streams = 0
         quant_gauges = {}
         if self.quantized_weights or self.quantized_kv:
             # int8 serving stamps its dtype pair + resident-bytes
@@ -1698,7 +1958,8 @@ class ServingEngine:
             prefill_real_tokens=self._pending_chunk_real_tokens,
             prefill_oneshot_tokens=self._pending_oneshot_real_tokens,
             prefill_oneshot_lanes=self._pending_oneshot_lanes,
-            slot_lanes=self.capacity * self.tokens_per_tick,
+            slot_lanes=self.capacity * (self.spec_width if self.spec
+                                        else self.tokens_per_tick),
             traces=live_traces,
             model_shards=(self.model_shards if self.model_shards > 1
                           else None),
@@ -1708,6 +1969,7 @@ class ServingEngine:
             **pc_gauges,
             **kv_gauges,
             **quant_gauges,
+            **spec_gauges,
         )
         self._preemptions = 0
         self._migrations_out = 0

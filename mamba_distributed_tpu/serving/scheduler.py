@@ -168,6 +168,16 @@ class _Tracked:
     migrations: int = 0  # prefill->decode handoffs this request took
     migration_ms: float = 0.0  # host time spent packaging + restoring
     migration_source: int | None = None  # replica id that prefilled
+    # --- speculative decoding (serving/spec_decode.py, the pending-
+    # token scheme): tokens committed to the stream but not yet folded
+    # into the device state, how many of them the consumer has already
+    # received, and how much committed history the drafter has
+    # observed.  All three survive preemption (the snapshot pairs with
+    # them) and are reset by requeue() only when the request will
+    # re-prefill from scratch.
+    spec_pending: list = dataclasses.field(default_factory=list)
+    spec_pending_emitted: int = 0
+    spec_observed: int = 0
 
 
 class FCFSScheduler:
@@ -262,6 +272,15 @@ class FCFSScheduler:
         tracked.prefill_dt = 0.0
         tracked.prefill_seeded_tokens = 0
         tracked.prefill_skipped = 0
+        if tracked.snapshot is None:
+            # a re-prefill re-derives the first pending token from the
+            # fresh prefill logits; the drafter stream restarts too
+            # (spec_observed=0 tells the engine's spec tick to forget
+            # it).  A PREEMPTED request keeps all three — its snapshot
+            # restores the exact state the pending tokens pair with.
+            tracked.spec_pending = []
+            tracked.spec_pending_emitted = 0
+            tracked.spec_observed = 0
         self._queue.appendleft(tracked)
 
     @property

@@ -1,0 +1,548 @@
+"""Speculative decoding on the chunk machinery: K-token draft-verify.
+
+Decode is weight-bandwidth-bound: every serving tick launches the full
+weight read to emit ONE token per slot, so inter-token latency at small
+batch is priced by weight bytes, not math.  The chunked-prefill path
+already pushes a K-token chunk through the conv/SSM carries and the
+ragged paged-attention write in one launch — exactly the VERIFIER
+speculative decoding needs (``models/lm.lm_verify_chunk`` is that chunk
+step returning per-position logits).  A cheap drafter proposes K
+continuation guesses, one launch scores all of them, and the longest
+correct prefix commits: up to K+2 tokens per full weight read.
+
+Greedy-only, and LOSSLESS: under argmax sampling an accepted draft is by
+definition the token the model would have emitted, and rejections are
+replaced by the model's own argmax at the rejected position — so
+speculative streams are token-identical to non-speculative greedy
+streams whatever the drafter proposes (draft quality only moves the
+acceptance rate).  Sampling-mode rejection sampling is a ROADMAP
+residual.
+
+The pending-token scheme (what makes rollback O(1))
+---------------------------------------------------
+
+The verify chunk advances the carries through ALL K+1 fed tokens, so a
+partial acceptance cannot keep the returned state.  Instead of
+recomputing the accepted prefix, commitment is decoupled from state
+advance:
+
+  * each stream carries ``pending`` — tokens already COMMITTED to the
+    output (emitted, final) but not yet folded into the device state;
+  * a verify tick feeds ``pending + drafts`` (static width ``W = K+1``);
+    pending tokens are trusted, drafts verify against the previous
+    position's argmax;
+  * if EVERY fed token verified, the returned carries commit as-is (the
+    state advanced W tokens) and the final position's argmax is one
+    bonus committed token — the new 1-token pending;
+  * on the FIRST rejection the pre-tick carries are restored (a per-row
+    ``jnp.where`` — the rollback primitive the PR-9/10 snapshot/restore
+    machinery established) and the accepted prefix plus the model's
+    correction token become the new pending: the next tick re-feeds
+    them as trusted tokens, so every launch still commits >= 1 token.
+
+Hybrid stacks need no KV rollback at all: the verify chunk writes the
+fed tokens' K/V at ``[lengths, lengths + W)``, and a rejected tick just
+does not advance the host ``lengths`` mirror — the written cells are
+dead-by-``lengths`` (the invariant the ragged kernels already honor)
+and the next verify overwrites them.  The engine's page-table rows gain
+one permanent trash column in spec mode so a fully-allocated slot's
+overshoot writes land on the trash page, never on a live cell.
+
+Drafters
+--------
+
+``NGramDrafter`` — host-side prompt-lookup: match the stream's trailing
+n-gram against its OWN history (prompt + emitted tokens) and propose
+the continuation that followed the most recent occurrence.  Free, and
+strong on repetitive/code-like text.  ``ModelDrafter`` — a small
+companion model running the same ``lm_step`` at a tiny config; drafts
+are its greedy rollout.  Both are deterministic, which is what lets the
+engine and ``generate()`` speculate identically (same drafts -> same
+accept pattern -> same verify-chunk splits -> bit-identical streams —
+the parity-by-construction contract, tests/test_spec_decode.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference.bucketing import (
+    next_pow2_bucket,
+    pad_to_bucket,
+)
+from mamba_distributed_tpu.inference.generate import vocab_pad_mask
+from mamba_distributed_tpu.models.lm import (
+    lm_prefill,
+    lm_step,
+    lm_verify_chunk,
+)
+from mamba_distributed_tpu.serving.prefill import (
+    cast_decode_params,
+    chunked_prefill,
+    plan_chunks,
+)
+
+# Python-side-effect trace counters (one bump per jit trace): the verify
+# and commit steps run at ONE static shape per engine, so speculation
+# adds zero retraces across any accept/reject/occupancy mix — pinned by
+# tests/test_spec_decode.py.  The draft-model jits count separately
+# (they run the COMPANION config's shapes).
+TRACE_COUNTS = {
+    "verify": 0,
+    "commit": 0,
+    "prefill": 0,
+    "draft_prefill": 0,
+    "draft_step": 0,
+    "draft_rollout": 0,
+}
+
+
+# --------------------------------------------------------------- jitted steps
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"),
+                   donate_argnums=(1,))
+def spec_verify(params: dict, state, ids: jax.Array, token_mask: jax.Array,
+                cfg: ModelConfig, mesh=None):
+    """The verify launch: feed every row's ``ids`` (b, W) through
+    ``lm_verify_chunk`` from ``state`` and score all W positions.
+
+    ``state`` is donated (hybrid page pools write in place, exactly like
+    the prefill chunk step); the PRE-tick conv/SSM carries — and, for
+    hybrids, the pre-tick ``attn_meta`` — come back as ``old`` so the
+    caller can roll rejected rows back without ever copying host-side.
+    ``token_mask`` rows are all-1 for live slots and all-0 for masked
+    ones (empty/done/mid-prefill): masked rows' KV writes flush to the
+    trash page and their garbage carries are discarded by
+    ``spec_commit``'s per-row select.
+
+    Returns ``(greedy (b, W) int32, final_logits (b, V) fp32, new_state,
+    old)`` where ``greedy[:, i]`` is the argmax (over the real vocab)
+    after fed token i — the entire accept/reject decision input, small
+    enough that fetching it is the tick's one host sync.
+
+    ``mesh`` (static; a 2-D serving mesh with model > 1, else None)
+    re-asserts the tensor-parallel weight layout — the same constraint
+    the prefill chunk step applies, so speculative and non-speculative
+    launches partition identically at ``serving_model_shards > 1``.
+    """
+    TRACE_COUNTS["verify"] += 1
+    if mesh is not None:
+        from mamba_distributed_tpu.parallel.sharding import (
+            constrain_serving_params,
+        )
+
+        params = constrain_serving_params(params, mesh)
+    old = {"blocks": state["blocks"]}
+    if "attn_meta" in state:
+        old["attn_meta"] = state["attn_meta"]
+    pos_logits, new_state = lm_verify_chunk(
+        params, cfg, ids, state, token_mask=token_mask
+    )
+    pad_mask = vocab_pad_mask(cfg)
+    greedy = jnp.argmax(
+        pos_logits + pad_mask[None, None, :], axis=-1
+    ).astype(jnp.int32)
+    return greedy, pos_logits[:, -1], new_state, old
+
+
+# donate one side of each per-row select only (the output aliases it);
+# donating both sides would leave half the buffers unusable
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def spec_commit(new_state, old_blocks, logits, meta, final_logits,
+                advance, width):
+    """Per-row accept/rollback select: rows with ``advance`` keep the
+    verify step's carries and final logits (their state moved ``width``
+    tokens), the rest keep the pre-tick ``old_blocks``/``logits`` —
+    all-or-nothing per row, which is what the pending-token scheme buys.
+    Hybrid attention pages always ride forward from ``new_state`` (they
+    were written in place; rejected rows' cells are dead-by-lengths).
+    Returns the reassembled slot pool."""
+    TRACE_COUNTS["commit"] += 1
+    keep = lambda n, o: jnp.where(
+        advance.reshape((1, -1) + (1,) * (n.ndim - 2)), n, o
+    )
+    blocks = jax.tree.map(keep, new_state["blocks"], old_blocks)
+    state = {**new_state, "blocks": blocks}
+    new_logits = jnp.where(advance[:, None], final_logits, logits)
+    new_meta = {
+        **meta,
+        "step": meta["step"]
+        + jnp.where(advance, width, 0).astype(jnp.int32),
+    }
+    return {"state": state, "logits": new_logits, "meta": new_meta}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _spec_prefill(params: dict, ids: jax.Array, mask: jax.Array,
+                  cfg: ModelConfig, mesh=None):
+    """Bucketed one-shot prompt prefill for ``spec_generate`` (params
+    already decode-cast).  The same ``lm_prefill`` computation the
+    serving engine's admission runs, in its own jit so the spec path
+    never perturbs the engine/generate trace counters."""
+    TRACE_COUNTS["prefill"] += 1
+    if mesh is not None:
+        from mamba_distributed_tpu.parallel.sharding import (
+            constrain_serving_params,
+        )
+
+        params = constrain_serving_params(params, mesh)
+    return lm_prefill(params, cfg, ids, token_mask=mask)
+
+
+# ------------------------------------------------------------ host-side logic
+
+
+def greedy_token(logits, vocab_size: int) -> int:
+    """argmax of one logits row over the REAL vocab columns — the exact
+    token a greedy (top_k=1) sampler emits (vocab padding rows carry
+    logit 0.0 from the zero-padded tied embedding and must not win).
+    Host mirror of the device-side ``argmax(logits + vocab_pad_mask)``;
+    both break ties toward the lowest index."""
+    row = np.asarray(logits).reshape(-1)
+    return int(np.argmax(row[:vocab_size]))
+
+
+def verify_greedy(fed, greedy, n_trusted: int):
+    """The accept/rollback decision for one stream.
+
+    ``fed`` (W,) are the tick's fed tokens — the first ``n_trusted``
+    are committed (pending) tokens that need no verification, the rest
+    are drafts.  ``greedy`` (W,) are the model's argmaxes, ``greedy[i]``
+    scoring the position AFTER ``fed[i]``.  Draft ``fed[i]`` is correct
+    iff it equals ``greedy[i-1]`` and every earlier draft was too.
+
+    Returns ``(accepted, advance, next_token)``: the accepted draft
+    count, whether EVERY fed token verified (state commits) and the
+    model's next token after the last valid fed position — the bonus
+    token on a full accept, the correction at the first rejection.
+    ``n_trusted >= 1`` always (the pending queue is never empty for a
+    live stream), so the index is in range.  Shared verbatim by the
+    engine and ``spec_generate`` — one copy of the decision rule.
+    """
+    a = 0
+    for i in range(n_trusted, len(fed)):
+        if int(fed[i]) == int(greedy[i - 1]):
+            a += 1
+        else:
+            break
+    advance = n_trusted + a == len(fed)
+    return a, advance, int(greedy[n_trusted + a - 1])
+
+
+def build_feed(pending, drafts, width: int):
+    """Compose one verify row: pending (trusted) + drafts, zero-filled
+    to the static ``width``.  Fill tokens are just more drafts — they
+    verify like any other guess and are almost always rejected, so a
+    short draft never needs masking (masking a SUFFIX would corrupt the
+    conv carry; the chunk machinery only supports left pads)."""
+    fed = [int(t) for t in pending] + [int(t) for t in drafts]
+    fed = fed[:width]
+    fed += [0] * (width - len(fed))
+    return fed
+
+
+# ------------------------------------------------------------------- drafters
+
+
+class Drafter:
+    """Draft-token source interface.  One drafter serves many streams
+    (keyed by an opaque stream id); all methods are host-side.
+
+    ``observe(stream, tokens)`` appends committed tokens (the prompt
+    first, then emissions) to the stream's history; ``draft(stream, n)``
+    proposes up to ``n`` continuation guesses — fewer (or none) is
+    always legal, correctness never depends on draft quality;
+    ``forget(stream)`` drops the stream's state."""
+
+    def observe(self, stream, tokens) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def draft(self, stream, n: int) -> list:  # pragma: no cover
+        raise NotImplementedError
+
+    def forget(self, stream) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup drafting: match the stream's trailing ``order``-gram
+    (falling back to shorter ones) against its own history and propose
+    the tokens that followed the MOST RECENT earlier occurrence.  Zero
+    model cost; acceptance is high exactly when decode is predictable
+    (repeated boilerplate, code, the argmax cycles greedy decoding
+    falls into) — which is when the bandwidth win matters most."""
+
+    def __init__(self, order: int = 3):
+        if order < 1:
+            raise ValueError(f"order must be >= 1, got {order}")
+        self.order = order
+        self._hist: dict = {}
+
+    def observe(self, stream, tokens) -> None:
+        self._hist.setdefault(stream, []).extend(int(t) for t in tokens)
+
+    def draft(self, stream, n: int) -> list:
+        h = self._hist.get(stream)
+        if n <= 0 or h is None or len(h) < 2:
+            return []
+        arr = np.asarray(h, np.int64)
+        for k in range(min(self.order, arr.size - 1), 0, -1):
+            pat = arr[-k:]
+            # windows over arr[:-1]: every match ends before the final
+            # token, so it has >= 1 continuation token and can never be
+            # the query suffix itself
+            win = np.lib.stride_tricks.sliding_window_view(arr[:-1], k)
+            hits = np.nonzero((win == pat).all(axis=1))[0]
+            if hits.size:
+                # most recent occurrence with a FULL n-token
+                # continuation, else the longest available — a match
+                # near the history end (the common case in a periodic
+                # tail) would otherwise truncate the draft to a token
+                # or two and cap the acceptance run length
+                cont = np.minimum(n, arr.size - (hits + k))
+                full = hits[cont >= n]
+                i = int(full[-1]) if full.size else int(
+                    hits[len(hits) - 1 - np.argmax(cont[::-1])]
+                )
+                return arr[i + k : i + k + n].tolist()
+        return []
+
+    def forget(self, stream) -> None:
+        self._hist.pop(stream, None)
+
+
+class ModelDrafter(Drafter):
+    """Companion-model drafting: a small LM (its own params + config —
+    pure-SSM, so its decode state is O(1)) shadows each stream through
+    the same ``lm_step`` the big model uses, and drafts are its greedy
+    rollout from the stream's last committed token.  The rollout runs as
+    ONE jitted scan (never mutating the stored per-stream state), so a
+    draft costs K tiny-model steps against the big model's one saved
+    full-width launch per accepted token."""
+
+    def __init__(self, params: dict, cfg: ModelConfig):
+        if cfg.attn_layer_idx:
+            raise ValueError(
+                "ModelDrafter companions are pure-SSM (an O(1)-state "
+                "shadow per stream); hybrid draft configs would need "
+                "their own paged KV plumbing"
+            )
+        self.cfg = cfg
+        self.params = cast_decode_params(params, cfg=cfg)
+        self._streams: dict = {}
+        self._rollout_steps = 1
+
+    def observe(self, stream, tokens) -> None:
+        toks = [int(t) for t in tokens]
+        if not toks:
+            return
+        st = self._streams.get(stream)
+        if st is None:
+            # first observation is the prompt (plus anything already
+            # emitted): one bucketed prefill instead of len(toks) steps
+            ids = jnp.asarray(toks, jnp.int32)[None, :]
+            padded, mask = pad_to_bucket(ids, next_pow2_bucket(len(toks)))
+            logits, state = _draft_prefill(self.params, padded, mask,
+                                           cfg=self.cfg)
+            self._streams[stream] = {"state": state, "logits": logits}
+            return
+        for t in toks:
+            logits, state = _draft_step(
+                self.params, st["state"], jnp.full((1,), t, jnp.int32),
+                cfg=self.cfg,
+            )
+            st["state"], st["logits"] = state, logits
+
+    def draft(self, stream, n: int) -> list:
+        st = self._streams.get(stream)
+        if st is None or n <= 0:
+            return []
+        # fixed rollout width (grown lazily to the largest request) so
+        # repeated drafting never retraces; the prefix is what's used
+        self._rollout_steps = max(self._rollout_steps, n)
+        toks = _draft_rollout(self.params, st["state"], st["logits"],
+                              cfg=self.cfg, steps=self._rollout_steps)
+        return np.asarray(toks)[:n].tolist()
+
+    def forget(self, stream) -> None:
+        self._streams.pop(stream, None)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _draft_prefill(params: dict, ids: jax.Array, mask: jax.Array,
+                   cfg: ModelConfig):
+    TRACE_COUNTS["draft_prefill"] += 1
+    return lm_prefill(params, cfg, ids, token_mask=mask)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _draft_step(params: dict, state, token: jax.Array, cfg: ModelConfig):
+    TRACE_COUNTS["draft_step"] += 1
+    return lm_step(params, cfg, state, token)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps"))
+def _draft_rollout(params: dict, state, logits: jax.Array,
+                   cfg: ModelConfig, steps: int):
+    """Greedy ``steps``-token rollout from (state, logits) WITHOUT
+    consuming them (nothing is donated — the stored stream state lives
+    on; drafting must never commit)."""
+    TRACE_COUNTS["draft_rollout"] += 1
+    pad_mask = vocab_pad_mask(cfg)
+
+    def one(carry, _):
+        state, logits = carry
+        tok = jnp.argmax(logits + pad_mask[None, :], axis=-1).astype(
+            jnp.int32
+        )
+        logits, state = lm_step(params, cfg, state, tok)
+        return (state, logits), tok
+
+    (_, _), toks = jax.lax.scan(one, (state, logits), None, length=steps)
+    return toks[:, 0]
+
+
+def make_drafter(cfg: ModelConfig) -> Drafter:
+    """The drafter ``cfg`` asks for, when none was passed explicitly.
+    ``"model"`` cannot be built from the config alone (the companion's
+    params aren't derivable from it) — callers must pass a
+    ``ModelDrafter`` instance; the error says so."""
+    if cfg.spec_drafter == "model":
+        raise ValueError(
+            "spec_drafter='model' needs an explicit drafter instance — "
+            "the companion model's params are not derivable from the "
+            "config; pass drafter=ModelDrafter(draft_params, draft_cfg) "
+            "or set spec_drafter='ngram'"
+        )
+    return NGramDrafter(cfg.spec_ngram_order)
+
+
+# ------------------------------------------------------- generate() spec path
+
+
+def spec_generate(
+    params: dict,
+    cfg: ModelConfig,
+    prompt_ids,
+    max_new_tokens: int = 32,
+    eos_id: int | None = None,
+    mesh=None,
+    prefix_cache=None,
+    drafter: Drafter | None = None,
+):
+    """The solo-``generate()`` speculative path (batch-1, greedy): the
+    IDENTICAL draft -> verify -> accept/rollback loop the serving
+    engine's spec tick runs — same prefill layouts, same
+    ``spec_verify`` step, same ``verify_greedy`` decision — so
+    engine==generate() token parity holds by construction when both use
+    the same (deterministic) drafter.  ``inference.generate`` routes
+    here when ``cfg.spec_tokens > 0`` and the request is greedy.
+
+    Returns (1, t + max_new_tokens) int32, the ``generate()`` contract:
+    with ``eos_id`` set, the suffix past a sampled eos deterministically
+    repeats it."""
+    prompt = np.asarray(prompt_ids, np.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    if prompt.shape[0] != 1:
+        raise ValueError("spec_generate is batch-1 (the serving engine "
+                         "is the batched speculative path)")
+    t = prompt.shape[1]
+    hybrid = bool(cfg.attn_layer_idx)
+    W = cfg.spec_tokens + 1
+    dparams = cast_decode_params(params, cfg=cfg)
+    plan = plan_chunks(t, cfg.effective_prefill_chunk_tokens, force=hybrid)
+    from_cache = prefix_cache is not None and not hybrid
+    if hybrid:
+        # page capacity covers prompt + budget + the verify overshoot
+        # (the last tick may feed up to W tokens past the budget; they
+        # must land in allocated-but-dead pages, never clamp onto a
+        # live one)
+        logits, state = chunked_prefill(
+            params, cfg, prompt, max_len=t + max_new_tokens + W, mesh=mesh,
+        )
+    elif plan is not None:
+        logits, state = chunked_prefill(
+            params, cfg, prompt, mesh=mesh, prefix_cache=prefix_cache,
+        )
+    else:
+        hit = (prefix_cache.lookup(prompt[0], None)
+               if from_cache else None)
+        if hit is not None:
+            entry = hit[0]
+            logits, state = entry.logits, {"blocks": entry.state["blocks"]}
+        else:
+            padded, mask = pad_to_bucket(
+                jnp.asarray(prompt), next_pow2_bucket(t)
+            )
+            logits, state = _spec_prefill(dparams, padded, mask, cfg=cfg,
+                                          mesh=mesh)
+    if from_cache:
+        # the verify step DONATES its state; a cache-sourced carry must
+        # not be destroyed (the entry lives on) — copy the tiny blocks
+        state = {**state, "blocks": jax.tree.map(jnp.copy, state["blocks"])}
+
+    if drafter is None:
+        drafter = make_drafter(cfg)
+    sid = object()  # private stream key; never collides across calls
+
+    pending = [greedy_token(np.asarray(logits)[0], cfg.vocab_size)]
+    pending_emitted = 0
+    emitted: list[int] = []
+    observed = 0
+    finished = False
+    while not finished:
+        # the drafter sees prompt + emitted + unemitted pending — the
+        # IDENTICAL observation rule (and therefore identical drafts,
+        # accept patterns and verify-chunk splits) as the engine's
+        # _spec_tick, which is what "parity by construction" rests on
+        hist = (prompt[0].tolist() + emitted
+                + pending[pending_emitted:])
+        if len(hist) > observed:
+            drafter.observe(sid, hist[observed:])
+            observed = len(hist)
+        n = W - len(pending)
+        drafts = list(drafter.draft(sid, n))[:n] if n > 0 else []
+        fed = build_feed(pending, drafts, W)
+        greedy_d, final_logits, new_state, old = spec_verify(
+            dparams, state, jnp.asarray(fed, jnp.int32)[None, :],
+            jnp.ones((1, W), jnp.float32), cfg=cfg, mesh=mesh,
+        )
+        a, advance, nxt = verify_greedy(
+            fed, np.asarray(greedy_d)[0], len(pending)
+        )
+        stream = (pending[pending_emitted:]
+                  + fed[len(pending):len(pending) + a] + [nxt])
+        for tok in stream:
+            emitted.append(tok)
+            if eos_id is not None and tok == eos_id:
+                finished = True
+                break
+            if len(emitted) >= max_new_tokens:
+                finished = True
+                break
+        if finished:
+            break
+        if advance:
+            state = new_state
+            pending = [nxt]
+            pending_emitted = 1
+        else:
+            state = {**new_state, "blocks": old["blocks"]}
+            if "attn_meta" in old:
+                state["attn_meta"] = old["attn_meta"]
+            pending = pending + fed[len(pending):len(pending) + a] + [nxt]
+            pending_emitted = len(pending)
+    drafter.forget(sid)
+    if eos_id is not None:
+        emitted += [eos_id] * (max_new_tokens - len(emitted))
+    out = np.concatenate(
+        [prompt[0], np.asarray(emitted[:max_new_tokens], np.int32)]
+    )
+    return jnp.asarray(out, jnp.int32)[None, :]

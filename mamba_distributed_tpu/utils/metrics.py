@@ -207,6 +207,20 @@ class ServingMetrics:
         self.weight_dtype: str | None = None
         self.kv_dtype: str | None = None
         self.greedy_token_disagreements = 0
+        # speculative decoding (serving/spec_decode.py): the engine
+        # calls configure_speculation() when cfg.spec_tokens > 0,
+        # unlocking summary()["speculation"] — draft/accept counters,
+        # the per-tick acceptance-rate histogram and the headline
+        # accepted-tokens-per-tick (committed tokens per full-model
+        # launch; > 1 is the bandwidth win).  Off by default so K=0
+        # summaries/records stay byte-stable.
+        self._spec_on = False
+        self.spec_tokens_cfg: int | None = None
+        self.spec_drafter: str | None = None
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_stream_ticks = 0  # Σ live streams over verify ticks
+        self.spec_accept_rate = StreamingHistogram(lo=1e-2, hi=200.0)
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
         # disaggregated prefill/decode handoffs (docs/SERVING.md
@@ -299,6 +313,16 @@ class ServingMetrics:
         """One priority swap-out (serving/engine._preempt)."""
         self.preemptions += 1
 
+    # ------------------------------------------------ speculative decoding
+
+    def configure_speculation(self, spec_tokens: int, drafter: str) -> None:
+        """Mark speculative decoding live (engine construction):
+        ``summary()`` gains its ``speculation`` section and tick
+        records their ``spec_drafted``/``spec_accepted`` stamps."""
+        self._spec_on = True
+        self.spec_tokens_cfg = spec_tokens
+        self.spec_drafter = drafter
+
     # --------------------------------------------------- quantized serving
 
     def configure_memory(self, weight_bytes: int, page_pool_bytes: int,
@@ -379,6 +403,9 @@ class ServingMetrics:
         quantized: dict | None = None,
         weight_bytes: int | None = None,
         page_pool_bytes: int | None = None,
+        spec_drafted: int | None = None,
+        spec_accepted: int | None = None,
+        spec_streams: int | None = None,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -505,6 +532,22 @@ class ServingMetrics:
             record["weight_bytes"] = weight_bytes
             if page_pool_bytes is not None:
                 record["page_pool_bytes"] = page_pool_bytes
+        if spec_drafted is not None:
+            # speculative-decoding window counters (stamped only when
+            # speculation is on — K=0 records stay byte-stable): draft
+            # lanes fed to the verify step and how many verified.  The
+            # acceptance-rate histogram records the window's rate in
+            # PERCENT (0-100) so the geometric buckets resolve it.
+            self.spec_drafted += spec_drafted
+            self.spec_accepted += spec_accepted or 0
+            if spec_drafted:
+                self.spec_accept_rate.record(
+                    100.0 * (spec_accepted or 0) / spec_drafted
+                )
+            self.spec_stream_ticks += spec_streams or 0
+            record["spec_drafted"] = spec_drafted
+            record["spec_accepted"] = spec_accepted
+            record["spec_streams"] = spec_streams
         if self.jsonl_path:
             self._write_jsonl(record)
 
@@ -585,6 +628,26 @@ class ServingMetrics:
                         and self._fpt_decode is not None) else None
                 ),
             },
+            "speculation": (None if not self._spec_on else {
+                "spec_tokens": self.spec_tokens_cfg,
+                "drafter": self.spec_drafter,
+                "drafted": self.spec_drafted,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (
+                    round(self.spec_accepted / self.spec_drafted, 4)
+                    if self.spec_drafted else None
+                ),
+                "acceptance_rate_pct_hist":
+                    self.spec_accept_rate.summary(),
+                # committed tokens per STREAM per full-model launch —
+                # the launches-per-token headline inverted (> 1.5 is
+                # the bench gate on the repetitive-suffix workload; a
+                # non-speculative tick is pinned at exactly 1.0)
+                "accepted_tokens_per_tick": (
+                    round(self.decode_tokens / self.spec_stream_ticks, 2)
+                    if self.spec_stream_ticks else None
+                ),
+            }),
             "memory": (None if not self._memory_on else {
                 "weight_bytes": self.weight_bytes,
                 "page_pool_bytes": self.page_pool_bytes,

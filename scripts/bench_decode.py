@@ -245,6 +245,14 @@ def main() -> None:
                     help="KV page dtype for --hybrid-paged "
                          "(cfg.kv_page_dtype; int8 = quantized pages + "
                          "per-page scales)")
+    ap.add_argument("--spec-tokens", type=int, default=0, metavar="K",
+                    help="speculative greedy decode (cfg.spec_tokens=K; "
+                         "batch-1 n-gram drafting over a repetitive "
+                         "prompt): times the spec generate() path vs "
+                         "the non-speculative greedy baseline — "
+                         "token-identical streams, fewer full-model "
+                         "launches (docs/SERVING.md 'Speculative "
+                         "decoding')")
     args = ap.parse_args()
 
     import jax
@@ -295,6 +303,63 @@ def main() -> None:
         params = jax.device_put(params, serving_param_shardings(params, mesh))
         jax.block_until_ready(params)
         _progress(f"weights tensor-parallel over {args.model_shards} shards")
+
+    if args.spec_tokens:
+        # batch-1 greedy speculative decode on a repetitive prompt (the
+        # workload n-gram drafting predicts): spec vs non-spec greedy,
+        # streams asserted token-identical (speculation is lossless)
+        import dataclasses
+
+        import numpy as np
+
+        pattern = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=8).astype(np.int32)
+        prompt = jnp.asarray(
+            np.tile(pattern, -(-prompt_len // 8))[:prompt_len]
+        )[None, :]
+        # fp32 compute keeps spec == baseline exactly token-identical
+        # (bf16 chunk-vs-step rounding can flip a rare near-tie argmax;
+        # docs/SERVING.md "Speculative decoding") — CPU XLA widens bf16
+        # anyway, so the timing comparison is unaffected
+        cfg = dataclasses.replace(cfg, compute_dtype="float32")
+        spec_cfg = dataclasses.replace(cfg, spec_tokens=args.spec_tokens)
+        iters = int(os.environ.get("BENCH_ITERS", "3"))
+        out = {}
+        streams = {}
+        for name, c in (("spec", spec_cfg), ("baseline", cfg)):
+            run = lambda c=c: generate(params, c, prompt,
+                                       jax.random.PRNGKey(2),
+                                       max_new_tokens=new_tokens,
+                                       top_k=1)
+            res = run()
+            jax.block_until_ready(res)  # warm every signature
+            t0 = time.time()
+            for _ in range(iters):
+                res = run()
+            jax.block_until_ready(res)
+            dt = (time.time() - t0) / iters
+            streams[name] = jnp.asarray(res)[0, prompt_len:].tolist()
+            out[f"tokens_per_sec_{name}"] = round(new_tokens / dt, 1)
+            _progress(f"{name}: {out[f'tokens_per_sec_{name}']} tok/s")
+        assert streams["spec"] == streams["baseline"], \
+            "speculative stream diverged from greedy baseline"
+        record = {
+            "metric": (f"decode_spec_tokens_per_sec_"
+                       f"{preset.replace('-', '_')}"),
+            "value": out["tokens_per_sec_spec"],
+            "unit": ("sampled tokens/sec (batch-1 greedy, "
+                     f"K={args.spec_tokens} ngram drafts)"),
+            **out,
+            "spec_vs_baseline_speedup": round(
+                out["tokens_per_sec_spec"]
+                / out["tokens_per_sec_baseline"], 2),
+            "spec_tokens": args.spec_tokens,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "device": dev.device_kind,
+        }
+        emit_bench_record(record, args.json)
+        return
 
     kp, kg = jax.random.split(jax.random.PRNGKey(1))
     prompt = jax.random.randint(kp, (B, prompt_len), 0, cfg.vocab_size, jnp.int32)

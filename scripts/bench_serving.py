@@ -438,6 +438,23 @@ def main() -> None:
                          "fixed pool byte budget, int8 vs bf16 pages "
                          "(hybrid preset; expect >= 1.9x) — the "
                          "BENCH_SERVING.json quant_kv_capacity row")
+    ap.add_argument("--spec-tokens", type=int, default=0, metavar="K",
+                    help="speculative decoding comparison "
+                         "(cfg.spec_tokens=K; docs/SERVING.md "
+                         "'Speculative decoding'): a repetitive-suffix "
+                         "greedy workload through a K-draft verify-tick "
+                         "engine vs the K=0 baseline, reporting "
+                         "accepted-tokens-per-tick and full-model "
+                         "launches per token for both — the "
+                         "BENCH_SERVING.json spec_ngram row.  "
+                         "SERVE_SPEC_PATTERN (8) sets the repeated "
+                         "pattern length")
+    ap.add_argument("--spec-drafter", default="ngram",
+                    choices=["ngram", "model"],
+                    help="drafter for --spec-tokens: 'ngram' (prompt-"
+                         "lookup over each stream's own history) or "
+                         "'model' (a half-depth pure-SSM companion of "
+                         "the preset, built here)")
     args = ap.parse_args()
     modes = [m for m, on in [("--long-prompt", args.long_prompt),
                              ("--shared-prefix", args.shared_prefix),
@@ -445,6 +462,7 @@ def main() -> None:
                              ("--quant", args.quant),
                              ("--quant-kv-capacity",
                               args.quant_kv_capacity),
+                             ("--spec-tokens", bool(args.spec_tokens)),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
         ap.error(f"{' and '.join(modes)} are separate bench modes; "
@@ -549,6 +567,144 @@ def main() -> None:
             jax.block_until_ready(out)
         dt_seq = time.perf_counter() - t0
         return served, dt_serve, dt_seq, metrics.summary()
+
+    if args.spec_tokens:
+        # speculative decoding: a REPETITIVE-SUFFIX greedy workload
+        # (prompts tile one short pattern, and greedy decode from tiny
+        # models settles into argmax cycles — both shapes the n-gram
+        # drafter predicts well) through a K-draft verify-tick engine
+        # vs the K=0 baseline.  Greedy speculation is lossless, so the
+        # two runs' token streams are asserted identical — the bench
+        # measures launches, not luck.
+        import dataclasses
+
+        from mamba_distributed_tpu.serving import (
+            GenerationRequest,
+            ModelDrafter,
+        )
+
+        # the workload knobs: a SMALL vocab makes the random-weight
+        # bench model's greedy stream settle into short argmax cycles —
+        # the stand-in for the repetitive/code-like text a trained
+        # checkpoint emits (prompt-lookup's sweet spot); fp32 compute
+        # keeps the K>0 and K=0 streams exactly token-identical (under
+        # bf16 the chunk-vs-step rounding can flip a rare near-tie
+        # argmax — docs/SERVING.md "Speculative decoding"; CPU XLA
+        # widens bf16 anyway, so fp32 costs nothing here)
+        if "SERVE_MAX_NEW" not in os.environ:
+            # the random-weight bench model's greedy stream needs a ramp
+            # before it settles into its n-gram-predictable argmax cycle
+            # (a trained checkpoint's repetitive text needs none); the
+            # default horizon lets the predictable tail dominate
+            max_new = 256
+        spec_vocab = int(os.environ.get("SERVE_SPEC_VOCAB", "256"))
+        spec_dtype = os.environ.get("SERVE_SPEC_DTYPE", "float32")
+        cfg = dataclasses.replace(cfg, vocab_size=spec_vocab,
+                                  compute_dtype=spec_dtype)
+        params = jax.jit(lambda k: init_lm_params(k, cfg))(
+            jax.random.PRNGKey(0)
+        )
+        jax.block_until_ready(params)
+
+        pattern_len = int(os.environ.get("SERVE_SPEC_PATTERN", "8"))
+        pattern = rng.integers(0, cfg.vocab_size,
+                               size=pattern_len).astype(np.int32)
+        prompts = []
+        for i in range(n_requests):
+            plen = int(rng.integers(pmin, pmax + 1))
+            prompts.append(
+                np.tile(pattern, -(-plen // pattern_len))[:plen]
+            )
+
+        def fresh():
+            return [GenerationRequest(prompt_ids=p.copy(),
+                                      max_new_tokens=max_new, top_k=1,
+                                      seed=1000 + i)
+                    for i, p in enumerate(prompts)]
+
+        spec_cfg = dataclasses.replace(
+            cfg, spec_tokens=args.spec_tokens,
+            spec_drafter=args.spec_drafter,
+        )
+
+        def make_drafter():
+            if args.spec_drafter != "model":
+                return None  # the engine builds the n-gram drafter
+            # companion: half the layers of the preset, pure-SSM
+            draft_cfg = dataclasses.replace(
+                cfg, n_layer=max(1, cfg.n_layer // 2),
+                attn_layer_idx=(), spec_tokens=0,
+            )
+            draft_params = jax.jit(
+                lambda k: init_lm_params(k, draft_cfg)
+            )(jax.random.PRNGKey(1))
+            return ModelDrafter(draft_params, draft_cfg)
+
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        out = {}
+        streams = {}
+        spec_summary = None
+        for mode_name, mode_cfg in (("spec", spec_cfg),
+                                    ("baseline", cfg)):
+            ServingEngine(params, mode_cfg, drafter=make_drafter(),
+                          **kw).run(fresh())
+            _progress(f"{mode_name}: warm")
+            metrics = ServingMetrics(
+                capacity,
+                jsonl_path=args.jsonl if mode_name == "spec" else None,
+            )
+            eng = ServingEngine(params, mode_cfg, metrics=metrics,
+                                drafter=make_drafter(), **kw)
+            t0 = time.perf_counter()
+            results = eng.run(fresh())
+            dt = time.perf_counter() - t0
+            tokens = sum(len(r.new_tokens) for r in results)
+            streams[mode_name] = [r.new_tokens.tolist() for r in results]
+            s = metrics.summary()
+            out[f"tokens_per_sec_{mode_name}"] = round(tokens / dt, 1)
+            out[f"wall_s_{mode_name}"] = round(dt, 3)
+            out[f"ticks_{mode_name}"] = s["ticks"]
+            if mode_name == "spec":
+                spec_summary = s["speculation"]
+                # full-model launches per STREAM per emitted token: one
+                # verify launch commits accepted_tokens_per_tick tokens
+                # per live stream, where a non-speculative sub-step —
+                # one lm_step weight read — commits exactly 1.0
+                out["launches_per_token_spec"] = round(
+                    1.0 / spec_summary["accepted_tokens_per_tick"], 3)
+                out["launches_per_token_baseline"] = 1.0
+            _progress(f"{mode_name}: {tokens} tokens, {s['ticks']} "
+                      f"ticks")
+        # lossless-speculation check: identical greedy streams
+        assert streams["spec"] == streams["baseline"], \
+            "speculative streams diverged from greedy baseline"
+        record = {
+            "metric": (f"serving_spec_accepted_tokens_per_tick_"
+                       f"{preset.replace('-', '_')}"),
+            "value": spec_summary["accepted_tokens_per_tick"],
+            "unit": ("committed tokens per full-model launch "
+                     f"(K={args.spec_tokens} {args.spec_drafter} "
+                     f"drafts, greedy, repetitive-suffix workload)"),
+            **out,
+            "fewer_launches_vs_baseline": round(
+                out["launches_per_token_baseline"]
+                / out["launches_per_token_spec"], 2),
+            "acceptance_rate": spec_summary["acceptance_rate"],
+            "spec_tokens": args.spec_tokens,
+            "spec_drafter": args.spec_drafter,
+            "spec_ngram_order": cfg.spec_ngram_order,
+            "pattern_len": pattern_len,
+            "requests": n_requests,
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "max_new_tokens": max_new,
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
 
     if args.quant_kv_capacity:
         # pages admissible at a FIXED pool byte budget, int8 vs bf16 —

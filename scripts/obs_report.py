@@ -229,6 +229,31 @@ def build_report(events: list[dict]) -> dict:
                     if mfu_den else None
                 ),
             }
+        # speculative-decoding gauges (absent unless a spec-enabled
+        # engine wrote the stream): draft/accept totals and committed
+        # tokens per verify launch — the launches-per-token headline
+        # (docs/SERVING.md "Speculative decoding")
+        spticks = [e for e in ticks if e.get("spec_drafted") is not None]
+        speculation = None
+        if spticks:
+            drafted = sum(e["spec_drafted"] for e in spticks)
+            accepted = sum(e.get("spec_accepted", 0) for e in spticks)
+            sp_tokens = sum(e.get("tokens_emitted", 0) for e in spticks)
+            # per STREAM per launch (a non-speculative tick would be
+            # exactly 1.0); older records without spec_streams fall
+            # back to the per-tick figure
+            streams = sum(e.get("spec_streams") or 0 for e in spticks)
+            speculation = {
+                "ticks": len(spticks),
+                "drafted": drafted,
+                "accepted": accepted,
+                "acceptance_rate": (
+                    round(accepted / drafted, 4) if drafted else None
+                ),
+                "accepted_tokens_per_tick": round(
+                    sp_tokens / (streams or len(spticks)), 2
+                ),
+            }
         # quantized-serving gauges (absent unless an int8 engine wrote
         # the stream): the dtype stamp + resident-bytes from the last
         # stamped tick (docs/SERVING.md "Quantized serving")
@@ -261,6 +286,7 @@ def build_report(events: list[dict]) -> dict:
             ),
             "goodput": goodput,
             "prefix_cache": prefix,
+            "speculation": speculation,
             "preemptions": preemptions,
             "migrations": {"handoffs": handoffs} if handoffs else None,
             "kv_pages": kv_pages,
@@ -540,6 +566,16 @@ def format_report(report: dict) -> str:
                 f"saved prefill tokens: {pc['saved_prefill_tokens']}   "
                 f"entries: {_fmt(pc['entries'])}   "
                 f"bytes: {_fmt(pc['bytes'])}"
+            )
+        if s.get("speculation"):
+            sp = s["speculation"]
+            rate = sp["acceptance_rate"]
+            head += (
+                f"\nspeculation: {sp['accepted']} / {sp['drafted']} "
+                f"drafts accepted "
+                f"({'-' if rate is None else f'{rate * 100:.1f}%'})   "
+                f"accepted tokens/tick: "
+                f"{_fmt(sp['accepted_tokens_per_tick'])}"
             )
         if s.get("preemptions"):
             head += f"\npreemptions: {s['preemptions']}"

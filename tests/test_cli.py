@@ -134,6 +134,46 @@ def test_bench_serving_long_prompt_smoke(tmp_path):
 
 
 @pytest.mark.serving
+@pytest.mark.spec
+def test_bench_serving_spec_smoke(tmp_path):
+    """CI smoke for the speculative-decoding bench: ``--spec-tokens``
+    must run the K-draft and K=0 engines end-to-end (streams asserted
+    identical inside the bench), report the launches-per-token pair,
+    and leave a tick stream whose speculation line obs_report.py
+    renders (ISSUE 12 satellites: bench + CI registration)."""
+    import json
+
+    jsonl = str(tmp_path / "spec.jsonl")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="3", SERVE_CAPACITY="2",
+               SERVE_PROMPT_MIN="8", SERVE_PROMPT_MAX="16",
+               SERVE_MAX_NEW="24", SERVE_TOKENS_PER_TICK="2")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--spec-tokens", "3", "--jsonl", jsonl],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["spec_tokens"] == 3
+    assert rec["spec_drafter"] == "ngram"
+    assert rec["value"] >= 1.0  # every launch commits >= 1 token/stream
+    assert rec["launches_per_token_baseline"] == 1.0
+    assert rec["launches_per_token_spec"] <= 1.0
+    assert rec["fewer_launches_vs_baseline"] >= 1.0
+    ticks = [json.loads(ln) for ln in open(jsonl)
+             if json.loads(ln).get("kind") == "serving_tick"]
+    assert ticks and all("spec_drafted" in t for t in ticks)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "speculation:" in r.stdout
+
+
+@pytest.mark.serving
 def test_bench_serving_shared_prefix_smoke(tmp_path):
     """CI smoke for the prefix-cache headline bench: ``--shared-prefix``
     must run cache-off and cache-warm end-to-end, report the TTFT
