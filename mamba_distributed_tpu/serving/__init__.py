@@ -31,6 +31,12 @@ docs/SERVING.md has the architecture; the short version:
                up to K+2 greedy tokens per full weight read) with
                n-gram and companion-model drafters — lossless under
                argmax (docs/SERVING.md "Speculative decoding")
+  service/     the deployable shape of all of the above: versioned
+               wire codec, one replica per worker PROCESS, an asyncio
+               HTTP/SSE front end running the UNCHANGED router, and
+               heartbeat-driven failover over the wire
+               (docs/SERVING.md "Deploying as a service";
+               scripts/serve_worker.py + scripts/serve_fabric.py)
 """
 
 from mamba_distributed_tpu.serving.engine import ServingEngine

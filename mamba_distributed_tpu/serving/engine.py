@@ -672,9 +672,30 @@ class ServingEngine:
         tracked.snapshot = snapshot
         tracked.no_migrate = True  # never bounce back to a prefill tier
         tracked.migration_source = source_replica
-        if snapshot.get("t_submit") is not None:
+        now = time.perf_counter()
+        if snapshot.get("t_submit_age_s") is not None:
+            # cross-host-safe: reconstruct the original stamps on THIS
+            # host's monotonic clock from their ages at packaging (raw
+            # perf_counter values don't transport between hosts);
+            # t_admit is localized in place so the restore path's
+            # existing read consumes it unchanged
+            tracked.t_submit = now - snapshot["t_submit_age_s"]
+            if snapshot.get("t_admit_age_s") is not None:
+                snapshot["t_admit"] = now - snapshot["t_admit_age_s"]
+        elif snapshot.get("t_submit") is not None:
             tracked.t_submit = snapshot["t_submit"]
         return tracked.request_id
+
+    def withdraw_queued(self) -> list[int]:
+        """Pull every queued-but-UNSTARTED request (status QUEUED, no
+        resume/migration snapshot) out of the admission queue and
+        return their request ids — the drain shutdown path
+        (``EngineReplica.drain(requeue=True)``): the router re-places
+        withdrawn work on surviving replicas instead of stranding it
+        behind a retiring engine's queue.  Requests already holding a
+        slot, a preemption snapshot, or a migrated-in artifact are NOT
+        withdrawn — their state lives here and finishes here."""
+        return [t.request_id for t in self.scheduler.withdraw_unstarted()]
 
     def _seed_spec(self, tracked: _Tracked, logits) -> None:
         """Seed a freshly-decodable slot's pending queue with the greedy
@@ -1420,6 +1441,16 @@ class ServingEngine:
             "step": len(tracked.new_tokens),
             "t_submit": tracked.t_submit,
             "t_admit": tracked.t_admit,
+            # clock-transportable journey stamps: raw perf_counter
+            # values are meaningless on another HOST (each machine has
+            # its own monotonic epoch), so the artifact also carries
+            # AGES at packaging time — the receiver reconstructs
+            # equivalent local stamps, keeping queue-wait/TTFT/e2e
+            # correct across genuine host boundaries (the wire transit
+            # itself lands in the journey, as it should)
+            "t_submit_age_s": t0 - tracked.t_submit,
+            "t_admit_age_s": (None if tracked.t_admit is None
+                              else t0 - tracked.t_admit),
         }
         if self.hybrid:
             kv_len = int(self._kv_len[slot])

@@ -101,11 +101,23 @@ class EngineReplica:
         held is the router's to requeue)."""
         return self.engine.pending if self.alive else 0
 
-    def drain(self) -> None:
+    def drain(self, requeue: bool = False) -> list[int]:
         """Stop accepting placements; in-flight (and already-queued)
-        requests run to completion via normal ``step()`` calls."""
+        requests run to completion via normal ``step()`` calls.
+
+        With ``requeue`` the engine's queued-but-UNSTARTED requests
+        (status QUEUED, no resume snapshot) are withdrawn and their
+        engine-local ids returned for the ROUTER to re-place on the
+        survivors — the drain shutdown path
+        (``RequestRouter.drain(requeue_queued=True)``): previously a
+        drain initiated from outside ``serve()`` only let in-flight
+        work survive, stranding the queue unless something kept
+        stepping the retiring replica."""
         if self.state is ReplicaState.ACTIVE:
             self.state = ReplicaState.DRAINING
+        if not requeue:
+            return []
+        return self.engine.withdraw_queued()
 
     def mark_dead(self) -> None:
         self.state = ReplicaState.DEAD
@@ -137,10 +149,13 @@ class EngineReplica:
             load -= eng.prefix_hit_fraction(request.prompt_ids)
         return load
 
-    def submit(self, request) -> int:
+    def submit(self, request, force: bool = False) -> int:
         """Place a request here; returns the ENGINE-local request id
-        (the router maps it back to its global id)."""
-        if not self.accepting:
+        (the router maps it back to its global id).  ``force`` bypasses
+        the accepting check — ONLY for the router's drain fallback,
+        which returns a withdrawn-but-unplaceable request to the
+        draining replica it came from rather than losing it."""
+        if not self.accepting and not force:
             raise RuntimeError(
                 f"replica {self.replica_id} is {self.state.value}, not "
                 f"accepting placements"

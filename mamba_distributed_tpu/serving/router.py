@@ -138,8 +138,31 @@ class RequestRouter:
                  capacity: int = 8, *, jsonl_path: str | None = None,
                  tracer=NULL_TRACER, replica_tracers=None,
                  retain_results: bool = True, roles=None,
-                 disagg_prompt_threshold: int | None = None, **engine_kw):
-        if num_replicas is None:
+                 disagg_prompt_threshold: int | None = None,
+                 replicas=None, **engine_kw):
+        if replicas is not None:
+            # pre-built placement units — the cross-host service path
+            # (serving/service/remote.RemoteReplica duck-types
+            # EngineReplica), or any caller owning replica construction.
+            # Per-replica knobs live with the replicas themselves, so
+            # the local-construction arguments must not also be given.
+            clashing = [name for name, val in [
+                ("roles", roles), ("replica_tracers", replica_tracers),
+                ("jsonl_path", jsonl_path),
+            ] if val] + list(engine_kw)
+            if clashing:
+                raise ValueError(
+                    f"replicas= supplies pre-built replicas; {clashing} "
+                    f"configure local replica construction and cannot "
+                    f"be combined with it"
+                )
+            if num_replicas is not None and num_replicas != len(replicas):
+                raise ValueError(
+                    f"num_replicas={num_replicas} != len(replicas)="
+                    f"{len(replicas)}"
+                )
+            num_replicas = len(replicas)
+        elif num_replicas is None:
             num_replicas = cfg.serving_replicas
         if num_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {num_replicas}")
@@ -160,20 +183,31 @@ class RequestRouter:
             cfg.disagg_prompt_threshold if disagg_prompt_threshold is None
             else disagg_prompt_threshold
         )
-        if jsonl_path:
-            open(jsonl_path, "w").close()  # one fresh stream, all replicas
-        self.replicas: list[EngineReplica] = []
-        for i in range(num_replicas):
-            metrics = ServingMetrics(capacity, jsonl_path=jsonl_path,
-                                     replica=i)
+        if replicas is not None:
+            self.replicas: list[EngineReplica] = list(replicas)
+            ids = [r.replica_id for r in self.replicas]
+            if ids != list(range(num_replicas)):
+                raise ValueError(
+                    f"injected replica ids must be 0..{num_replicas - 1} "
+                    f"in order (the router indexes replicas by id), got "
+                    f"{ids}"
+                )
+        else:
             if jsonl_path:
-                metrics.preserve_history()  # router already truncated
-            self.replicas.append(EngineReplica(
-                i, params, cfg, metrics=metrics,
-                tracer=(replica_tracers[i] if replica_tracers else tracer),
-                role=(roles[i] if roles else "mixed"),
-                capacity=capacity, retain_results=False, **engine_kw,
-            ))
+                open(jsonl_path, "w").close()  # one fresh stream
+            self.replicas = []
+            for i in range(num_replicas):
+                metrics = ServingMetrics(capacity, jsonl_path=jsonl_path,
+                                         replica=i)
+                if jsonl_path:
+                    metrics.preserve_history()  # router already truncated
+                self.replicas.append(EngineReplica(
+                    i, params, cfg, metrics=metrics,
+                    tracer=(replica_tracers[i] if replica_tracers
+                            else tracer),
+                    role=(roles[i] if roles else "mixed"),
+                    capacity=capacity, retain_results=False, **engine_kw,
+                ))
         if self.disagg_prompt_threshold > 0:
             # threshold 0 keeps roles inert — no role filter AND no
             # migration, the exact pre-disagg fabric
@@ -338,10 +372,52 @@ class RequestRouter:
 
     # ------------------------------------------------------------ lifecycle
 
-    def drain(self, replica_id: int) -> None:
+    def drain(self, replica_id: int, *,
+              requeue_queued: bool = False) -> list[int]:
         """Gracefully retire a replica: no new placements; everything it
-        already holds finishes through normal stepping."""
-        self.replicas[replica_id].drain()
+        already holds finishes through normal stepping.
+
+        ``requeue_queued`` additionally withdraws the replica's
+        queued-but-UNSTARTED requests (no slot, no resume snapshot) and
+        re-places them on the surviving replicas — the rolling-restart
+        shutdown path: without it, a drain initiated from outside
+        ``serve()`` strands the retiring replica's queue until someone
+        keeps stepping it.  Started work (resident slots, preemption
+        snapshots, migrated-in artifacts) always finishes in place.
+        Returns the re-placed global ids.  When no OTHER replica is
+        accepting, nothing is withdrawn (the drain still finishes its
+        queue locally — graceful degradation, never a stranded
+        request)."""
+        rep = self.replicas[replica_id]
+        requeue = requeue_queued and any(
+            r.accepting for r in self.replicas if r is not rep
+        )
+        withdrawn = rep.drain(requeue=requeue)
+        moved = []
+        for local_id in withdrawn:
+            routed = self._by_local.pop((replica_id, local_id), None)
+            if routed is None:
+                continue  # not router-managed (direct engine submit)
+            try:
+                self._place(routed)
+            except Exception:  # noqa: BLE001 — a withdrawn request is
+                # already OUT of the retiring queue; if the survivors
+                # vanished mid-drain (wire death, concurrent failure)
+                # it must go BACK rather than be lost.  force bypasses
+                # the draining replica's accepting check; its queue
+                # then finishes locally, exactly as a no-survivor
+                # drain would have.
+                prev_trace = routed.request.trace_id
+                routed.request.trace_id = routed.trace_id
+                try:
+                    new_local = rep.submit(routed.request, force=True)
+                finally:
+                    routed.request.trace_id = prev_trace
+                routed.replica_id, routed.local_id = replica_id, new_local
+                self._by_local[(replica_id, new_local)] = routed
+                continue
+            moved.append(routed.global_id)
+        return moved
 
     def fail(self, replica_id: int) -> list[int]:
         """Failover: mark the replica dead and requeue its unfinished

@@ -257,6 +257,25 @@ class FCFSScheduler:
                 return t
         return None
 
+    def withdraw_unstarted(self) -> list[_Tracked]:
+        """Remove and return every queued request that has NOT started:
+        status QUEUED and no resume/migration snapshot.  The drain
+        shutdown path (serving/router.drain(requeue_queued=True)) uses
+        this to hand queued-but-unplaced work back to the router — a
+        draining replica previously stranded its queue unless something
+        kept stepping it.  Preempted/migrated entries (snapshot
+        holders) stay: their state lives HERE and re-placing them
+        elsewhere would either lose it or re-deliver tokens."""
+        keep: deque[_Tracked] = deque()
+        out: list[_Tracked] = []
+        for t in self._queue:
+            if t.status is RequestStatus.QUEUED and t.snapshot is None:
+                out.append(t)
+            else:
+                keep.append(t)
+        self._queue = keep
+        return out
+
     def requeue(self, tracked: _Tracked) -> None:
         """Put a popped-but-not-admitted request back at the queue head
         (a failed prefill must not drop it; a preempted request resumes

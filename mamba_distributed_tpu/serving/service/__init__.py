@@ -1,0 +1,70 @@
+"""Cross-host serving service: the deployable shape of the fabric.
+
+Everything below ``serving/`` up to PR 12 is a LIBRARY — router,
+replica lifecycle, failover replay, tier migration — entered by a
+Python call in one process.  This package deploys it (docs/SERVING.md
+"Deploying as a service"):
+
+  wire      versioned stdlib wire codec: requests, token events,
+            replay cursors, the PR-10 migration artifact (carry +
+            logits + KV pages + int8 scales) across host boundaries
+  worker    one EngineReplica behind a TCP listener; one process per
+            replica (scripts/serve_worker.py), SIGTERM -> drain
+  remote    RemoteReplica: the EngineReplica duck-type that lets
+            RequestRouter run UNCHANGED over worker processes
+  server    FabricController (the router's thread) + the asyncio
+            HTTP/SSE front end: POST /v1/generate streams tokens,
+            /healthz, /drain/<replica>, /metrics-summary
+            (scripts/serve_fabric.py)
+  health    HeartbeatMonitor: probes drive the existing ACTIVE/
+            DRAINING/DEAD lifecycle — a dead worker triggers the PR-5
+            failover replay over the wire; rolling_drain is the
+            restart runbook primitive
+  client    stdlib HTTP/SSE client (tests + bench --service)
+
+The engine/tick/kernel layers are untouched: a remote stream is the
+same pure function of (prompt, key) as a local one, which is why the
+service keeps the bit-parity pins (tests/test_service.py diffs
+wire-served streams — including across a worker SIGKILL and a
+wire-crossed migration — against solo ``generate()``).
+"""
+
+from mamba_distributed_tpu.serving.service.wire import (
+    MAX_FRAME_BYTES,
+    WIRE_VERSION,
+    UnknownWireVersionError,
+    WireClosedError,
+    WireError,
+    decode_array,
+    decode_event,
+    decode_msg,
+    decode_request,
+    decode_tree,
+    encode_array,
+    encode_event,
+    encode_msg,
+    encode_request,
+    encode_tree,
+    recv_msg,
+    send_msg,
+)
+
+__all__ = [
+    "MAX_FRAME_BYTES",
+    "WIRE_VERSION",
+    "UnknownWireVersionError",
+    "WireClosedError",
+    "WireError",
+    "decode_array",
+    "decode_event",
+    "decode_msg",
+    "decode_request",
+    "decode_tree",
+    "encode_array",
+    "encode_event",
+    "encode_msg",
+    "encode_request",
+    "encode_tree",
+    "recv_msg",
+    "send_msg",
+]

@@ -1,0 +1,91 @@
+"""Tiny stdlib HTTP/SSE client for the fabric front end.
+
+Used by the tests, ``scripts/bench_serving.py --service`` and any
+operator tooling that wants to drive the service without pulling in an
+HTTP library: ``http.client`` with ``Connection: close`` streaming —
+the SSE body is read line-by-line off the socket, so TTFT/ITL stamps
+taken here measure the full wire path (HTTP parse + SSE framing + the
+worker RPC hop), which is exactly what the ``service_overhead_cpu``
+bench row prices.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+
+def http_json(host: str, port: int, method: str, path: str,
+              body: dict | None = None, timeout: float = 60.0) -> dict:
+    """One non-streaming JSON request/response."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        payload = None if body is None else json.dumps(body)
+        conn.request(method, path, body=payload,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        out = json.loads(data.decode("utf-8")) if data else {}
+        out["_status"] = resp.status
+        return out
+    finally:
+        conn.close()
+
+
+def stream_generate(host: str, port: int, spec: dict,
+                    timeout: float = 300.0, on_event=None) -> dict:
+    """POST /v1/generate and consume the SSE stream to completion.
+
+    Returns {"tokens": [...], "finish_reason": ..., "events": [...],
+    "ttft_ms": ..., "itl_ms": [...]} — client-side latency stamps per
+    token.  ``on_event`` (if given) sees each event as it arrives —
+    the failover tests use it to know when a stream is mid-flight.
+    Raises RuntimeError on an in-stream {"error": ...} event or a
+    non-200 status."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        t0 = time.perf_counter()
+        conn.request("POST", "/v1/generate", body=json.dumps(spec),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"/v1/generate -> {resp.status}: "
+                f"{resp.read().decode('utf-8', 'replace')[:500]}"
+            )
+        tokens, events, stamps = [], [], []
+        finish_reason = None
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            line = line.decode("utf-8").strip()
+            if not line.startswith("data:"):
+                continue
+            ev = json.loads(line[len("data:"):].strip())
+            if "error" in ev:
+                raise RuntimeError(f"stream error: {ev['error']}")
+            if on_event is not None:
+                on_event(ev)
+            events.append(ev)
+            tokens.append(ev["token"])
+            stamps.append(time.perf_counter())
+            if ev.get("done"):
+                finish_reason = ev.get("finish_reason")
+                break
+        if finish_reason is None:
+            raise RuntimeError(
+                f"SSE stream ended without a done event after "
+                f"{len(tokens)} token(s)"
+            )
+        return {
+            "tokens": tokens,
+            "finish_reason": finish_reason,
+            "events": events,
+            "ttft_ms": (stamps[0] - t0) * 1000.0 if stamps else None,
+            "itl_ms": [(b - a) * 1000.0
+                       for a, b in zip(stamps, stamps[1:])],
+        }
+    finally:
+        conn.close()

@@ -1,0 +1,188 @@
+"""Heartbeat-driven lifecycle for the cross-host fabric.
+
+The in-process fabric already has the full ACTIVE/DRAINING/DEAD state
+machine and the failover replay that keeps streams no-loss/no-dup
+(serving/replica.py, serving/router.py) — what a multi-process fabric
+adds is DETECTION: a worker process can die without anyone calling
+``router.fail``.  The ``HeartbeatMonitor`` closes that loop:
+
+  * every ``interval_ms`` it pings each remote replica (a ``ping``
+    RPC); a reply stamps ``heartbeat_ms`` (round-trip) and refreshes
+    the replica's load stats,
+  * a failed probe counts a MISSED beat; ``miss_threshold`` consecutive
+    misses — or a wire death already observed by ``submit``/``step`` —
+    escalates to ``router.fail(replica_id)``, which requeues the dead
+    worker's unfinished requests onto survivors where replay-cursor
+    dedup keeps every consumer stream contiguous and duplicate-free,
+  * every beat, miss, and lifecycle transition is emitted as a
+    ``kind="serving_health"`` record on the obs stream
+    (docs/OBSERVABILITY.md "Fabric health") — the records
+    ``scripts/obs_report.py`` renders as the fabric-health table.
+
+``rolling_drain`` is the rolling-restart primitive (docs/SERVING.md
+runbook): drain one replica — queued-but-unstarted work requeues to
+the survivors immediately, resident work finishes in place — wait for
+it to empty, and only then move to the next, so a fleet restarts with
+zero dropped requests and at most one replica's capacity offline.
+"""
+
+from __future__ import annotations
+
+import time
+
+from mamba_distributed_tpu.serving.replica import ReplicaState
+from mamba_distributed_tpu.serving.service import wire
+
+
+class HeartbeatMonitor:
+    """Probe remote replicas; drive lifecycle transitions + records.
+
+    Args:
+      router: the ``RequestRouter`` owning the replicas — ``fail`` is
+        called here so failover uses the exact replay path the
+        in-process tests pin.
+      interval_ms: per-replica probe spacing (``tick()`` itself can be
+        called as often as the controller loop likes — probes are
+        rate-limited internally).
+      miss_threshold: consecutive missed beats before failover.
+      emit: callback taking one record dict (already stamped with
+        ``kind="serving_health"``); None drops records.  Wire it to
+        ``obs.append_jsonl`` for the reportable stream.
+      clock: injectable monotonic clock (tests).
+    """
+
+    def __init__(self, router, *, interval_ms: float = 200.0,
+                 miss_threshold: int = 3, emit=None, clock=time.monotonic):
+        if miss_threshold < 1:
+            raise ValueError("miss_threshold must be >= 1")
+        self.router = router
+        self.interval_s = interval_ms / 1000.0
+        self.miss_threshold = miss_threshold
+        self.emit = emit
+        self.clock = clock
+        self.missed: dict[int, int] = {}
+        self.last_beat_at: dict[int, float] = {}
+        self.last_rtt_ms: dict[int, float] = {}
+        self._last_probe: dict[int, float] = {}
+        self._last_state: dict[int, str] = {}
+        self._failed: set[int] = set()
+
+    # ------------------------------------------------------------- records
+
+    def _emit(self, event: str, rep, **fields) -> None:
+        if self.emit is None:
+            return
+        rec = {"kind": "serving_health", "t": time.time(), "event": event,
+               "replica": rep.replica_id, "state": rep.state.value,
+               "missed": self.missed.get(rep.replica_id, 0), **fields}
+        self.emit(rec)
+
+    # -------------------------------------------------------------- probing
+
+    def tick(self) -> list[int]:
+        """One monitor pass: observe lifecycle transitions, probe due
+        replicas, escalate wire deaths / missed-beat thresholds to
+        ``router.fail``.  Returns the replica ids failed over in this
+        pass.  Safe to call every controller iteration."""
+        failed = []
+        now = self.clock()
+        for rep in self.router.replicas:
+            rid = rep.replica_id
+            state = rep.state.value
+            prev = self._last_state.get(rid)
+            if prev is not None and prev != state:
+                self._emit("lifecycle", rep, transition=f"{prev}->{state}")
+            self._last_state[rid] = state
+            if rep.state is ReplicaState.DEAD:
+                continue
+            if getattr(rep, "wire_dead", False):
+                if self._fail(rep, reason="wire_dead"):
+                    failed.append(rid)
+                continue
+            if not hasattr(rep, "ping"):
+                continue  # in-process replica: no probe needed
+            if now - self._last_probe.get(rid, -1e9) < self.interval_s:
+                continue
+            self._last_probe[rid] = now
+            try:
+                rtt_ms, _stats = rep.ping()
+            except wire.WireError as e:
+                self.missed[rid] = self.missed.get(rid, 0) + 1
+                self._emit("missed", rep, error=str(e))
+                if self.missed[rid] >= self.miss_threshold:
+                    if self._fail(rep, reason="missed_beats"):
+                        failed.append(rid)
+                continue
+            self.missed[rid] = 0
+            self.last_beat_at[rid] = now
+            self.last_rtt_ms[rid] = round(rtt_ms, 3)
+            self._emit("beat", rep, heartbeat_ms=round(rtt_ms, 3))
+        return failed
+
+    def _fail(self, rep, *, reason: str) -> bool:
+        """Escalate one dead worker to router failover (once)."""
+        rid = rep.replica_id
+        if rid in self._failed:
+            return False
+        try:
+            moved = self.router.fail(rid)
+        except RuntimeError as e:
+            # no accepting survivor: record it loudly; the router's
+            # stranded-request check surfaces the stall to the caller
+            self._emit("failover_error", rep, reason=reason, error=str(e))
+            self._failed.add(rid)
+            return False
+        self._failed.add(rid)
+        self._emit("failover", rep, reason=reason, requeued=moved)
+        return True
+
+    # ------------------------------------------------------------ snapshot
+
+    def snapshot(self) -> dict:
+        """Per-replica health view (the /healthz payload)."""
+        now = self.clock()
+        out = {}
+        for rep in self.router.replicas:
+            rid = rep.replica_id
+            out[rid] = {
+                "state": rep.state.value,
+                "role": rep.role,
+                "pending": rep.pending,
+                "missed": self.missed.get(rid, 0),
+                "heartbeat_ms": self.last_rtt_ms.get(rid),
+                "last_beat_s_ago": (
+                    round(now - self.last_beat_at[rid], 3)
+                    if rid in self.last_beat_at else None
+                ),
+                "wire_dead": bool(getattr(rep, "wire_dead", False)),
+            }
+        return out
+
+
+def rolling_drain(router, controller=None, *, requeue_queued: bool = True,
+                  poll_s: float = 0.02, timeout_s: float = 300.0):
+    """Rolling-restart drain: one replica at a time — drain it (its
+    queued-but-unstarted work requeues to the survivors), wait until it
+    holds nothing, yield its id so the operator can restart it, then
+    continue.  ``controller`` (service/server.FabricController) keeps
+    the fabric stepping while we wait; without one the caller must be
+    stepping the router itself."""
+    for rep in list(router.replicas):
+        if rep.state is ReplicaState.DEAD:
+            continue
+        if controller is not None:
+            controller.call(
+                lambda rid=rep.replica_id: router.drain(
+                    rid, requeue_queued=requeue_queued)
+            ).result(timeout_s)
+        else:
+            router.drain(rep.replica_id, requeue_queued=requeue_queued)
+        deadline = time.monotonic() + timeout_s
+        while rep.pending:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"replica {rep.replica_id} still holds "
+                    f"{rep.pending} request(s) after {timeout_s}s drain"
+                )
+            time.sleep(poll_s)
+        yield rep.replica_id

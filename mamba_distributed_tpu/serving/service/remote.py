@@ -1,0 +1,374 @@
+"""RemoteReplica: an EngineReplica duck-type backed by a worker socket.
+
+The whole point of the service layer is that ``RequestRouter`` — the
+placement, drain, failover-replay and tier-migration logic PRs 5–10
+built and pinned — runs UNCHANGED in front of worker processes.  A
+``RemoteReplica`` exposes the exact surface the router reads off an
+in-process ``EngineReplica``:
+
+  replica_id / role / state / accepting / alive / pending
+  place_cost(request=None)      from the worker's last-reported stats
+                                (load + page pressure; the prefix-cache
+                                affinity probe is an O(prompt) host walk
+                                that does not cross the wire — remote
+                                placement is load-only)
+  submit / step / drain / mark_dead
+  engine.scheduler.depth, engine.hybrid, engine.page_pool.free_pages
+  engine.metrics.summary(), engine.submit_migrated(...)
+  engine.migrate_hook = hook    the router installs its in-process
+                                migration closure here; the proxy's
+                                setter rewires it as the wire callback
+                                ``step()`` invokes when the worker
+                                sends a migrate_offer
+
+so ``RequestRouter(params=None, cfg, replicas=[RemoteReplica(...),
+...])`` IS the cross-host fabric.
+
+Failure semantics: a wire failure during ``submit``/``step`` marks the
+replica wire-dead — ``alive`` flips False, the router stops stepping
+it, and the heartbeat monitor (service/health.py) drives
+``router.fail`` so every unfinished request replays on a survivor
+(replay-cursor dedup keeps the merged stream no-loss/no-dup).  A
+failed heartbeat ``ping`` only closes the socket — the next probe
+reconnects (workers keep state across controller sessions), and only
+``miss_threshold`` consecutive failures escalate to failover.  A step
+TIMEOUT is treated as death, not slowness: resyncing a half-finished
+step RPC could drop already-emitted tokens, and failover replay is the
+path that provably loses nothing.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from mamba_distributed_tpu.serving.replica import REPLICA_ROLES, ReplicaState
+from mamba_distributed_tpu.serving.service import wire
+
+
+class _Shim:
+    """Minimal tracked-request stand-in for router._migrate_from."""
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+
+
+class _SchedulerProxy:
+    def __init__(self, rep: "RemoteReplica"):
+        self._rep = rep
+
+    @property
+    def depth(self) -> int:
+        return int(self._rep.stats.get("depth", 0))
+
+
+class _PagePoolProxy:
+    def __init__(self, rep: "RemoteReplica"):
+        self._rep = rep
+
+    @property
+    def free_pages(self) -> int:
+        return int(self._rep.stats.get("free_pages", 0))
+
+    @property
+    def num_pages(self) -> int:
+        return int(self._rep.stats.get("num_pages", 0))
+
+    @property
+    def pages_in_use(self) -> int:
+        return int(self._rep.stats.get("pages_in_use", 0))
+
+
+class _MetricsProxy:
+    def __init__(self, rep: "RemoteReplica"):
+        self._rep = rep
+
+    def summary(self) -> dict:
+        return self._rep.summary()
+
+
+class _EngineProxy:
+    """The slice of ``ServingEngine`` the router touches, by RPC."""
+
+    def __init__(self, rep: "RemoteReplica"):
+        self._rep = rep
+        self.scheduler = _SchedulerProxy(rep)
+        self.page_pool = _PagePoolProxy(rep)
+        self.metrics = _MetricsProxy(rep)
+
+    @property
+    def hybrid(self) -> bool:
+        return bool(self._rep.info.get("hybrid"))
+
+    @property
+    def migrate_hook(self):
+        return self._rep.on_migrate_offer
+
+    @migrate_hook.setter
+    def migrate_hook(self, hook) -> None:
+        # the router's in-process closure is hook(tracked, package);
+        # the wire callback receives (local_id, decoded snapshot) —
+        # adapt so router._migrate_from runs verbatim
+        rep = self._rep
+        if hook is None:
+            rep.on_migrate_offer = None
+        else:
+            rep.on_migrate_offer = (
+                lambda local_id, snap: hook(_Shim(local_id), lambda: snap)
+            )
+
+    def submit_migrated(self, request, snapshot: dict, *,
+                        source_replica: int | None = None) -> int:
+        payload = self._rep._rpc("submit_migrated", {
+            "request": wire.encode_request(request),
+            "snapshot": wire.encode_tree(snapshot),
+            "source_replica": source_replica,
+        }, expect="submit_ack")
+        return int(payload["request_id"])
+
+
+class RemoteReplica:
+    """One worker process, as the router's placement unit."""
+
+    def __init__(self, replica_id: int, address: tuple[str, int], *,
+                 role: str = "mixed", connect_timeout_s: float = 30.0,
+                 rpc_timeout_s: float = 300.0, ping_timeout_s: float = 2.0):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"role must be one of {REPLICA_ROLES}, got {role!r}"
+            )
+        self.replica_id = replica_id
+        self.address = address
+        self.role = role
+        self.rpc_timeout_s = rpc_timeout_s
+        self.ping_timeout_s = ping_timeout_s
+        self.state = ReplicaState.ACTIVE
+        self.wire_dead = False
+        self.stats: dict = {}
+        self.info: dict = {}
+        self.last_wire_error: str | None = None
+        self.on_migrate_offer = None
+        self.engine = _EngineProxy(self)
+        self._offer_exc: Exception | None = None
+        self._sock: socket.socket | None = None
+        self._connect(deadline=time.monotonic() + connect_timeout_s)
+        if self.role != self.info.get("role", self.role):
+            raise ValueError(
+                f"replica {replica_id}: connected worker reports role "
+                f"{self.info.get('role')!r}, expected {role!r} — fabric "
+                f"and worker disagree on the tier layout"
+            )
+
+    # ------------------------------------------------------------ transport
+
+    def _connect(self, deadline: float | None = None) -> None:
+        """(Re)connect and re-hello.  Workers keep replica state across
+        controller sessions, so reconnecting resumes, not restarts."""
+        last_err: Exception | None = None
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    self.address, timeout=self.ping_timeout_s
+                )
+                break
+            except OSError as e:
+                last_err = e
+                self._sock = None
+                if deadline is None or time.monotonic() >= deadline:
+                    raise wire.WireError(
+                        f"replica {self.replica_id}: cannot connect to "
+                        f"worker at {self.address}: {last_err}"
+                    ) from last_err
+                time.sleep(0.05)
+        # the hello is bounded tightly and NON-fatal: a wedged worker
+        # mid-reconnect must neither freeze the controller loop for a
+        # full rpc_timeout nor bypass the heartbeat miss threshold —
+        # the OUTER call's fatality decides what a failure here means
+        self.info = self._rpc("hello", {}, expect="hello",
+                              timeout=min(self.rpc_timeout_s, 10.0),
+                              fatal=False)
+        self._update_stats(self.info.get("stats"))
+
+    def _close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _wire_died(self, err: Exception) -> None:
+        self.wire_dead = True
+        self._close()
+        self.last_wire_error = str(err)
+
+    def _update_stats(self, stats: dict | None) -> None:
+        if not stats:
+            return
+        self.stats = stats
+        # the worker's lifecycle is authoritative for ACTIVE/DRAINING
+        # (a SIGTERM'd worker self-drains); DEAD is the router's call
+        if self.state is not ReplicaState.DEAD and stats.get("state"):
+            self.state = ReplicaState(stats["state"])
+
+    def _rpc(self, mtype: str, payload: dict, *, expect: str,
+             timeout: float | None = None, fatal: bool = True) -> dict:
+        """One request/response exchange.  ``migrate_offer`` sub-
+        messages (only ever during ``step``) are dispatched inline.  On
+        wire failure: ``fatal`` marks the replica wire-dead (failover
+        replays everything — the no-loss path); non-fatal (heartbeat
+        probes) just closes so the next probe reconnects."""
+        if self.wire_dead or self.state is ReplicaState.DEAD:
+            raise wire.WireError(
+                f"replica {self.replica_id} is "
+                f"{'wire-dead' if self.wire_dead else 'dead'}"
+            )
+        offer_exc: Exception | None = None
+        try:
+            if self._sock is None:
+                self._connect()
+            self._sock.settimeout(timeout or self.rpc_timeout_s)
+            wire.send_msg(self._sock, mtype, payload)
+            while True:
+                rtype, rpayload = wire.recv_msg(self._sock)
+                if rtype == "migrate_offer":
+                    accepted = False
+                    if self.on_migrate_offer is not None:
+                        snap = wire.decode_tree(rpayload["snapshot"])
+                        try:
+                            accepted = bool(self.on_migrate_offer(
+                                int(rpayload["request_id"]), snap))
+                        except Exception as e:  # noqa: BLE001
+                            # ack False FIRST — the worker is blocked on
+                            # it and an unacked offer would wedge the
+                            # step RPC; surface after the step closes
+                            # (NOT raised here: a CANDIDATE replica's
+                            # failure must not mark THIS socket dead)
+                            offer_exc = e
+                    wire.send_msg(self._sock, "migrate_ack",
+                                  {"accepted": accepted})
+                    continue
+                if rtype == "error":
+                    err_cls = (ValueError if rpayload.get("retriable")
+                               else RuntimeError)
+                    raise err_cls(
+                        f"replica {self.replica_id} "
+                        f"{rpayload.get('error_type', 'error')}: "
+                        f"{rpayload.get('error')}"
+                    )
+                if rtype != expect:
+                    raise wire.WireError(
+                        f"replica {self.replica_id}: expected {expect!r} "
+                        f"reply to {mtype!r}, got {rtype!r}"
+                    )
+                self._update_stats(rpayload.get("stats"))
+                self._offer_exc = offer_exc
+                return rpayload
+        except (wire.WireError, socket.timeout, OSError) as e:
+            if fatal:
+                self._wire_died(e)
+            else:
+                self._close()
+            raise wire.WireError(
+                f"replica {self.replica_id} wire failure during "
+                f"{mtype}: {e}"
+            ) from e
+
+    # --------------------------------------------------- EngineReplica face
+
+    @property
+    def accepting(self) -> bool:
+        return self.state is ReplicaState.ACTIVE and not self.wire_dead
+
+    @property
+    def alive(self) -> bool:
+        return self.state is not ReplicaState.DEAD and not self.wire_dead
+
+    @property
+    def pending(self) -> int:
+        return int(self.stats.get("pending", 0)) if self.alive else 0
+
+    def place_cost(self, request=None) -> float:
+        """Load + hybrid page pressure from the last-reported stats —
+        the in-process cost minus the prefix-affinity probe (an
+        O(prompt) engine-side walk the wire deliberately skips)."""
+        s = self.stats
+        cap = max(1, int(s.get("capacity", 1)))
+        load = (int(s.get("depth", 0)) + int(s.get("resident", 0))) / cap
+        if s.get("hybrid") and s.get("num_pages"):
+            load += int(s.get("pages_in_use", 0)) / int(s["num_pages"])
+        return load
+
+    def submit(self, request, force: bool = False) -> int:
+        if not self.accepting and not force:
+            raise RuntimeError(
+                f"replica {self.replica_id} is {self.state.value}, not "
+                f"accepting placements"
+            )
+        payload = self._rpc("submit", {
+            "request": wire.encode_request(request),
+            "force": force,
+        }, expect="submit_ack")
+        return int(payload["request_id"])
+
+    def step(self) -> list:
+        """One remote engine iteration.  Wire failure mid-step returns
+        the empty list with the replica marked wire-dead — the
+        heartbeat monitor escalates to router.fail, and failover replay
+        re-derives anything the lost step_result held."""
+        if not self.alive:
+            return []
+        try:
+            payload = self._rpc("step", {}, expect="step_result")
+        except wire.WireError:
+            return []
+        exc, self._offer_exc = self._offer_exc, None
+        if exc is not None:
+            raise exc  # a migrate-offer callback bug, not a wire fault
+        return [wire.decode_event(d) for d in payload["events"]]
+
+    def drain(self, requeue: bool = False) -> list[int]:
+        """Graceful retire; with ``requeue`` the worker withdraws its
+        queued-but-unstarted requests and returns their local ids for
+        the router to re-place (the rolling-restart path)."""
+        if self.state is ReplicaState.ACTIVE:
+            self.state = ReplicaState.DRAINING
+        if not self.alive:
+            return []
+        try:
+            payload = self._rpc("drain", {"requeue": requeue},
+                                expect="drain_ack")
+        except wire.WireError:
+            return []
+        return [int(i) for i in payload.get("withdrawn", [])]
+
+    def mark_dead(self) -> None:
+        self.state = ReplicaState.DEAD
+        self._close()
+
+    # ----------------------------------------------------------- telemetry
+
+    def ping(self) -> tuple[float, dict]:
+        """Heartbeat probe: round-trip ms + fresh stats.  Non-fatal on
+        failure (closes the socket; the next probe reconnects) — only
+        the monitor's miss threshold escalates to failover."""
+        t0 = time.perf_counter()
+        payload = self._rpc("ping", {}, expect="pong",
+                            timeout=self.ping_timeout_s, fatal=False)
+        return (time.perf_counter() - t0) * 1000.0, payload.get("stats", {})
+
+    def summary(self) -> dict:
+        if not self.alive:
+            return {}
+        try:
+            payload = self._rpc("summary", {}, expect="summary_result")
+        except wire.WireError:
+            return {}
+        return payload.get("summary", {})
+
+    def shutdown(self) -> None:
+        """Best-effort worker process exit (post-drain)."""
+        try:
+            self._rpc("shutdown", {}, expect="bye", fatal=False)
+        except (wire.WireError, RuntimeError):
+            pass
+        self._close()

@@ -1,0 +1,411 @@
+"""Asyncio HTTP/SSE front end + fabric controller for the service.
+
+Two halves:
+
+``FabricController`` — a thread that OWNS the ``RequestRouter`` (the
+router is deliberately single-threaded: placement, failover replay and
+migration bookkeeping are plain Python state).  Everything else talks
+to the fabric through it: HTTP handlers enqueue closures (``call``)
+or submissions (``submit_request``) and get ``concurrent.futures``
+back; the loop drains commands, runs one ``HeartbeatMonitor`` pass,
+steps the router, and fans TokenEvents out to per-request sink queues.
+One controller iteration is exactly one fabric iteration — the same
+serial order as the in-process ``router.serve()`` the parity tests
+pin, which is why remote streams can be token-identical to solo
+``generate()``.
+
+``FabricHTTPServer`` — a stdlib-only asyncio HTTP/1.1 server:
+
+  POST /v1/generate      JSON body -> SSE stream, one ``data:`` event
+                         per token ({request_id, token, index, done,
+                         finish_reason}), connection closes at done
+  GET  /healthz          fabric + per-replica health (heartbeat ages,
+                         missed beats, lifecycle states)
+  POST /drain/<replica>  graceful retire; queued-but-unplaced work
+                         requeues to survivors (rolling restarts)
+  GET  /metrics-summary  per-replica engine metrics summaries
+
+Request JSON: {"prompt_ids": [int, ...], "max_new_tokens": 32,
+"top_k": 50, "temperature": 1.0, "eos_id": null, "seed": 0,
+"priority": null} — the same knobs ``GenerationRequest`` takes; seed
+(not a key) selects the sampling stream, so a request is reproducible
+by a solo ``generate()`` call with ``PRNGKey(seed)``.
+
+SSE was chosen over chunked JSON because failover is invisible in it:
+the router's replay cursor suppresses re-derived duplicates BEFORE
+events reach the sink, so a consumer mid-stream across a worker death
+sees one contiguous token sequence — no reconnect, no gap, no dup
+(tests/test_service.py kills a worker mid-stream and diffs against
+solo ``generate()``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import queue
+import threading
+import time
+
+import numpy as np
+
+from mamba_distributed_tpu.obs import jsonable
+from mamba_distributed_tpu.serving.scheduler import GenerationRequest
+
+# a sink item is either a token-event dict or an {"error": ...}
+# terminator; an SSE handler waiting longer than this for the next one
+# errors its stream out rather than holding the connection forever
+_EVENT_POLL_S = 120.0
+
+
+class FabricController(threading.Thread):
+    """Single-threaded owner of the router; see module docstring."""
+
+    def __init__(self, router, *, health=None, poll_s: float = 0.002):
+        super().__init__(daemon=True, name="fabric-controller")
+        self.router = router
+        self.health = health
+        self.poll_s = poll_s
+        self._commands: queue.Queue = queue.Queue()
+        self._sinks: dict[int, queue.Queue] = {}
+        self._stop_requested = threading.Event()
+        self.stepped = 0  # fabric iterations (bench/debug gauge)
+
+    # ------------------------------------------------------- thread-safe API
+
+    def call(self, fn) -> concurrent.futures.Future:
+        """Run ``fn()`` on the controller thread; Future of its result."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._commands.put((fn, fut))
+        return fut
+
+    def submit_request(self, request: GenerationRequest
+                       ) -> concurrent.futures.Future:
+        """Admit a request; Future of (global_id, sink queue).  The sink
+        receives one dict per token and, on fabric-level failure, an
+        {"error": ...} terminator."""
+
+        def _do():
+            sink: queue.Queue = queue.Queue()
+            gid = self.router.submit(request)
+            self._sinks[gid] = sink
+            return gid, sink
+
+        return self.call(_do)
+
+    def stop(self) -> None:
+        self._stop_requested.set()
+
+    # ------------------------------------------------------------ the loop
+
+    def run(self) -> None:
+        while not self._stop_requested.is_set():
+            worked = self._drain_commands()
+            if self.health is not None:
+                try:
+                    self.health.tick()
+                except RuntimeError as e:
+                    # failover with zero survivors: surface to every
+                    # waiting stream rather than dying silently
+                    self._error_out(str(e))
+            if self.router.pending:
+                try:
+                    events = self.router.step()
+                except RuntimeError as e:
+                    # stranded requests (dead replicas, no survivors):
+                    # terminate the waiting streams, then back off —
+                    # pending stays nonzero so without the sleep this
+                    # would busy-spin re-raising the same error
+                    self._error_out(str(e))
+                    time.sleep(max(self.poll_s, 0.05))
+                    continue
+                self.stepped += 1
+                for ev in events:
+                    sink = self._sinks.get(ev.request_id)
+                    if sink is None:
+                        continue
+                    sink.put({
+                        "request_id": ev.request_id, "token": int(ev.token),
+                        "index": int(ev.index), "done": bool(ev.done),
+                        "finish_reason": ev.finish_reason,
+                    })
+                    if ev.done:
+                        del self._sinks[ev.request_id]
+            elif not worked:
+                time.sleep(self.poll_s)
+        # controller exiting with streams open: terminate them cleanly
+        self._error_out("fabric controller stopped")
+
+    def _drain_commands(self) -> bool:
+        worked = False
+        while True:
+            try:
+                fn, fut = self._commands.get_nowait()
+            except queue.Empty:
+                return worked
+            worked = True
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — delivered to caller
+                fut.set_exception(e)
+
+    def _error_out(self, message: str) -> None:
+        for gid, sink in list(self._sinks.items()):
+            sink.put({"error": message, "request_id": gid, "done": True})
+            del self._sinks[gid]
+
+
+# ----------------------------------------------------------------- HTTP/SSE
+
+
+def _http_response(status: str, body: bytes,
+                   content_type: str = "application/json") -> bytes:
+    return (
+        f"HTTP/1.1 {status}\r\nContent-Type: {content_type}\r\n"
+        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+    ).encode("ascii") + body
+
+
+def _json_response(status: str, obj) -> bytes:
+    return _http_response(
+        status, (json.dumps(obj) + "\n").encode("utf-8")
+    )
+
+
+class FabricHTTPServer:
+    """The stdlib asyncio front end; see module docstring."""
+
+    def __init__(self, controller: FabricController,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.controller = controller
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def start_background(self) -> int:
+        """Run the server on its own thread + loop; returns the bound
+        port (tests and the bench drive the fabric this way)."""
+        started = threading.Event()
+
+        def _run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def _main():
+                await self.start()
+                started.set()
+                await self._server.serve_forever()
+
+            try:
+                loop.run_until_complete(_main())
+            except asyncio.CancelledError:
+                pass
+            finally:
+                loop.close()
+
+        self._thread = threading.Thread(target=_run, daemon=True,
+                                        name="fabric-http")
+        self._thread.start()
+        if not started.wait(30):
+            raise RuntimeError("HTTP server failed to start within 30s")
+        return self.port
+
+    def stop(self) -> None:
+        if self._loop is not None and self._server is not None:
+            def _shutdown():
+                self._server.close()
+                for task in asyncio.all_tasks(self._loop):
+                    task.cancel()
+
+            self._loop.call_soon_threadsafe(_shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # ------------------------------------------------------------- handling
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = (await reader.readline()).decode("latin-1")
+            if not request_line.strip():
+                return
+            try:
+                method, path, _version = request_line.split()
+            except ValueError:
+                writer.write(_json_response(
+                    "400 Bad Request", {"error": "malformed request line"}))
+                return
+            headers = {}
+            while True:
+                line = (await reader.readline()).decode("latin-1")
+                if line in ("\r\n", "\n", ""):
+                    break
+                k, _, v = line.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            n = int(headers.get("content-length", 0) or 0)
+            if n:
+                body = await reader.readexactly(n)
+            try:
+                await self._route(method, path, body, writer)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                raise
+            except Exception as e:  # noqa: BLE001 — a handler bug must
+                # surface as a 500, not a silently dropped connection
+                writer.write(_json_response(
+                    "500 Internal Server Error",
+                    {"error": f"{type(e).__name__}: {e}"}))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _route(self, method: str, path: str, body: bytes,
+                     writer: asyncio.StreamWriter) -> None:
+        ctrl = self.controller
+        if method == "POST" and path == "/v1/generate":
+            await self._generate(body, writer)
+        elif method == "GET" and path == "/healthz":
+            snap = await asyncio.wrap_future(ctrl.call(self._health_payload))
+            writer.write(_json_response("200 OK", snap))
+        elif method == "GET" and path == "/metrics-summary":
+            summary = await asyncio.wrap_future(
+                ctrl.call(lambda: jsonable(ctrl.router.summary()))
+            )
+            writer.write(_json_response("200 OK", summary))
+        elif method == "POST" and path.startswith("/drain/"):
+            try:
+                rid = int(path.rsplit("/", 1)[1])
+            except ValueError:
+                writer.write(_json_response(
+                    "400 Bad Request",
+                    {"error": f"bad replica id in {path!r}"}))
+                return
+            try:
+                moved = await asyncio.wrap_future(ctrl.call(
+                    lambda: ctrl.router.drain(rid, requeue_queued=True)
+                ))
+            except (IndexError, KeyError):
+                writer.write(_json_response(
+                    "404 Not Found", {"error": f"no replica {rid}"}))
+                return
+            except Exception as e:  # noqa: BLE001 — drain hit a wire
+                # fault mid-requeue; the router kept the requests (see
+                # router.drain's fallback) — report, don't crash
+                writer.write(_json_response(
+                    "500 Internal Server Error",
+                    {"error": f"drain failed: {e}"}))
+                return
+            writer.write(_json_response(
+                "200 OK", {"replica": rid, "requeued": moved}))
+        else:
+            writer.write(_json_response(
+                "404 Not Found",
+                {"error": f"no route for {method} {path}"}))
+        await writer.drain()
+
+    def _health_payload(self) -> dict:
+        router = self.controller.router
+        payload = {
+            "pending": router.pending,
+            "migrations": router.migrations,
+            "replicas": {
+                str(r.replica_id): {"state": r.state.value, "role": r.role,
+                                    "pending": r.pending}
+                for r in router.replicas
+            },
+        }
+        if self.controller.health is not None:
+            for rid, h in self.controller.health.snapshot().items():
+                payload["replicas"][str(rid)].update(h)
+        payload["ok"] = any(
+            r.accepting for r in router.replicas
+        )
+        return payload
+
+    async def _generate(self, body: bytes,
+                        writer: asyncio.StreamWriter) -> None:
+        try:
+            spec = json.loads(body.decode("utf-8"))
+            request = GenerationRequest(
+                prompt_ids=np.asarray(spec["prompt_ids"], np.int32),
+                max_new_tokens=int(spec.get("max_new_tokens", 32)),
+                top_k=int(spec.get("top_k", 50)),
+                temperature=float(spec.get("temperature", 1.0)),
+                eos_id=spec.get("eos_id"),
+                seed=int(spec.get("seed", 0)),
+                priority=spec.get("priority"),
+            )
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            # TypeError covers non-dict JSON bodies (`123`, `[1,2]`):
+            # json.loads succeeds, the field access doesn't
+            writer.write(_json_response(
+                "400 Bad Request", {"error": f"bad request body: {e}"}))
+            return
+        try:
+            gid, sink = await asyncio.wrap_future(
+                self.controller.submit_request(request)
+            )
+        except (ValueError, RuntimeError) as e:
+            # invalid request, or nothing accepting (all draining/dead)
+            status = ("400 Bad Request" if isinstance(e, ValueError)
+                      else "503 Service Unavailable")
+            writer.write(_json_response(status, {"error": str(e)}))
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        # one dedicated pump thread per stream, bridging the blocking
+        # sink queue into the loop: the shared default executor would
+        # cap concurrent streams at its thread count (each blocked in
+        # sink.get), head-of-line-starving every stream beyond it
+        loop = asyncio.get_running_loop()
+        aq: asyncio.Queue = asyncio.Queue()
+
+        def _pump():
+            while True:
+                try:
+                    ev = sink.get(timeout=_EVENT_POLL_S)
+                except queue.Empty:
+                    ev = {"error": f"no token within {_EVENT_POLL_S}s",
+                          "request_id": gid, "done": True}
+                loop.call_soon_threadsafe(aq.put_nowait, ev)
+                if ev.get("done") or "error" in ev:
+                    return
+
+        threading.Thread(target=_pump, daemon=True,
+                         name=f"sse-pump-{gid}").start()
+        while True:
+            ev = await aq.get()
+            writer.write(f"data: {json.dumps(ev)}\n\n".encode("utf-8"))
+            await writer.drain()
+            if ev.get("done") or "error" in ev:
+                return

@@ -1,0 +1,286 @@
+"""Versioned wire codec for the cross-host serving service.
+
+One frame = a 4-byte big-endian length prefix + one UTF-8 JSON object:
+
+  {"v": WIRE_VERSION, "type": "<message type>", "payload": {...}}
+
+Everything the fabric ships between processes rides this one codec —
+``GenerationRequest`` submissions (trace_id and priority preserved, so
+a request's journey keeps one trace id and one admission class across
+host boundaries), ``TokenEvent`` streams, heartbeat pings, replay
+cursors, and the PR-10 migration artifact (the O(1) conv/SSM carry +
+last logits + hybrid KV page contents + their int8 scales) — so the
+schema has exactly one version number to negotiate and exactly one
+place to evolve.  Strictly stdlib + numpy: no protobuf, no msgpack,
+nothing the container doesn't already have.
+
+Arrays are tagged dicts (``{"__nd__": dtype, "shape": [...], "data":
+base64(tobytes)}``) and tuples are tagged (``{"__tuple__": [...]}``)
+so an arbitrary carry pytree — nested dicts/lists/tuples of ndarrays,
+bf16 and int8 included — survives JSON with its treedef AND its bytes
+intact: ``decode_tree(encode_tree(x))`` is structurally identical to
+``jax.device_get(x)``, which is what makes the wire-crossed migration
+artifact bit-exact (tests/test_wire.py pins the round trip per layer
+family).
+
+Version policy: a decoder raises ``UnknownWireVersionError`` — a NAMED
+error, never a hang or a silent misparse — for any frame whose ``v``
+it does not speak; the worker replies with an ``error`` message carrying
+the exception name before closing, so a version-skewed peer fails fast
+with a readable reason (docs/SERVING.md "Deploying as a service").
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+
+import numpy as np
+
+# bump ONLY on incompatible schema changes; additive payload fields are
+# compatible (decoders ignore unknown keys)
+WIRE_VERSION = 1
+
+# one frame's hard ceiling (a hybrid migration artifact is page-count
+# sized — MBs, not GBs; anything bigger is a corrupt length prefix)
+MAX_FRAME_BYTES = 1 << 30
+
+_LEN = struct.Struct(">I")
+
+# tag keys for the tree codec — reserved in payload dicts
+_ND = "__nd__"
+_TUPLE = "__tuple__"
+
+
+class WireError(RuntimeError):
+    """Transport-level failure: framing, EOF, or a malformed message."""
+
+
+class WireClosedError(WireError):
+    """The peer closed the connection (EOF mid-frame or between
+    frames) — the worker-death signal failover keys on."""
+
+
+class UnknownWireVersionError(WireError):
+    """The frame's schema version is not one this codec speaks.  Named
+    (never a hang): a version-skewed peer gets this back as an
+    ``error`` message and the connection closes."""
+
+
+# --------------------------------------------------------------- tree codec
+
+
+def encode_array(a) -> dict:
+    """One ndarray (or jax array — materialized via np.asarray) as a
+    tagged JSON-safe dict; dtype string round-trips bf16/int8 via the
+    ml_dtypes registry numpy already carries under jax."""
+    a = np.asarray(a)
+    return {_ND: str(a.dtype), "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16, float8_*) register with numpy on
+        # import; jax depends on ml_dtypes so this is always present
+        import ml_dtypes  # noqa: F401
+
+        return np.dtype(name)
+
+
+def decode_array(d: dict) -> np.ndarray:
+    dtype = _np_dtype(d[_ND])
+    raw = base64.b64decode(d["data"])
+    return np.frombuffer(raw, dtype=dtype).reshape(d["shape"]).copy()
+
+
+def encode_tree(obj):
+    """Recursively encode a pytree of dicts/lists/tuples/ndarrays/
+    scalars into JSON-safe form, preserving treedef (tuples tagged) and
+    array bytes (``encode_array``)."""
+    if isinstance(obj, dict):
+        bad = [k for k in obj if k in (_ND, _TUPLE)]
+        if bad:
+            raise WireError(f"dict keys {bad} collide with codec tags")
+        return {k: encode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return {_TUPLE: [encode_tree(v) for v in obj]}
+    if isinstance(obj, list):
+        return [encode_tree(v) for v in obj]
+    if isinstance(obj, np.ndarray) or type(obj).__name__ == "ArrayImpl":
+        return encode_array(obj)
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    # anything array-like that slipped through (jax tracer-free arrays,
+    # memoryviews): materialize
+    return encode_array(obj)
+
+
+def decode_tree(obj):
+    if isinstance(obj, dict):
+        if _ND in obj:
+            return decode_array(obj)
+        if _TUPLE in obj:
+            return tuple(decode_tree(v) for v in obj[_TUPLE])
+        return {k: decode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_tree(v) for v in obj]
+    return obj
+
+
+# ----------------------------------------------------- request/event codecs
+
+
+def encode_request(request) -> dict:
+    """A ``serving.GenerationRequest`` as a wire payload.  The sampling
+    key is shipped RESOLVED (the raw uint32 pair ``resolve_key``
+    derives) so seed-vs-key requests serialize identically to how the
+    slot pool will store them; ``trace_id`` and ``priority`` ride along
+    — the router's trace context and admission class survive the
+    process boundary."""
+    d = {
+        "prompt_ids": encode_array(np.asarray(request.prompt_ids, np.int32)),
+        "max_new_tokens": int(request.max_new_tokens),
+        "top_k": int(request.top_k),
+        "temperature": float(request.temperature),
+        "eos_id": None if request.eos_id is None else int(request.eos_id),
+        "seed": int(request.seed),
+        "trace_id": request.trace_id,
+        "priority": request.priority,
+    }
+    if request.key is not None:
+        d["key"] = encode_array(np.asarray(request.resolve_key()))
+    return d
+
+
+def decode_request(d: dict):
+    from mamba_distributed_tpu.serving.scheduler import GenerationRequest
+
+    key = decode_array(d["key"]) if d.get("key") is not None else None
+    return GenerationRequest(
+        prompt_ids=decode_array(d["prompt_ids"]),
+        max_new_tokens=d["max_new_tokens"],
+        top_k=d["top_k"],
+        temperature=d["temperature"],
+        eos_id=d.get("eos_id"),
+        seed=d.get("seed", 0),
+        key=key,
+        trace_id=d.get("trace_id"),
+        priority=d.get("priority"),
+    )
+
+
+def encode_event(ev) -> dict:
+    return {"request_id": int(ev.request_id), "token": int(ev.token),
+            "index": int(ev.index), "done": bool(ev.done),
+            "finish_reason": ev.finish_reason}
+
+
+def decode_event(d: dict):
+    from mamba_distributed_tpu.serving.scheduler import TokenEvent
+
+    return TokenEvent(d["request_id"], d["token"], d["index"], d["done"],
+                      d.get("finish_reason"))
+
+
+# ------------------------------------------------------------------ framing
+
+
+def encode_msg(mtype: str, payload: dict | None = None) -> bytes:
+    body = json.dumps(
+        {"v": WIRE_VERSION, "type": mtype, "payload": payload or {}},
+        separators=(",", ":"),
+    ).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise WireError(f"frame of {len(body)} bytes exceeds "
+                        f"MAX_FRAME_BYTES={MAX_FRAME_BYTES}")
+    return _LEN.pack(len(body)) + body
+
+
+def decode_msg(body: bytes) -> tuple[str, dict]:
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"malformed wire frame: {e}") from e
+    v = obj.get("v")
+    if v != WIRE_VERSION:
+        raise UnknownWireVersionError(
+            f"wire schema version {v!r} is not supported (this codec "
+            f"speaks version {WIRE_VERSION}); upgrade the older peer"
+        )
+    mtype = obj.get("type")
+    if not isinstance(mtype, str):
+        raise WireError(f"wire frame has no message type: {obj!r}")
+    return mtype, obj.get("payload") or {}
+
+
+# hard cap on waiting out a half-received frame (a peer frozen mid-send):
+# long enough for any loopback/TCP burst, short enough that a wedged
+# peer reads as dead rather than hanging the caller forever
+MID_FRAME_STALL_S = 30.0
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                mid_frame: bool = False) -> bytes:
+    """Read exactly n bytes; WireClosedError on EOF.  socket.timeout
+    propagates ONLY between frames (heartbeat probes and the worker's
+    poll loop use it as the no-message signal) — once a frame's first
+    bytes have arrived the rest is in flight, so a mid-frame timeout
+    keeps reading instead of tearing the stream out of sync (a large
+    migration artifact easily straddles a short poll timeout)."""
+    import time as _time
+
+    buf = bytearray()
+    stall_deadline = None
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+            stall_deadline = None
+        except socket.timeout:
+            if not buf and not mid_frame:
+                raise
+            now = _time.monotonic()
+            if stall_deadline is None:
+                stall_deadline = now + MID_FRAME_STALL_S
+            elif now >= stall_deadline:
+                raise WireClosedError(
+                    f"peer stalled mid-frame for {MID_FRAME_STALL_S}s "
+                    f"({len(buf)}/{n} bytes)"
+                )
+            continue
+        if not chunk:
+            raise WireClosedError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, mtype: str,
+             payload: dict | None = None) -> None:
+    try:
+        sock.sendall(encode_msg(mtype, payload))
+    except OSError as e:
+        raise WireClosedError(f"send failed: {e}") from e
+
+
+def recv_msg(sock: socket.socket) -> tuple[str, dict]:
+    try:
+        (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+        if n > MAX_FRAME_BYTES:
+            raise WireError(f"frame length {n} exceeds MAX_FRAME_BYTES")
+        # the header is consumed: the body read is mid-frame by
+        # definition, however many bytes of it have arrived yet
+        return decode_msg(_recv_exact(sock, n, mid_frame=True))
+    except socket.timeout:
+        raise
+    except OSError as e:
+        raise WireClosedError(f"recv failed: {e}") from e

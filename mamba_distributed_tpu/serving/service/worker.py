@@ -1,0 +1,316 @@
+"""Replica worker: one ``EngineReplica`` behind a loopback/TCP listener.
+
+One worker process = one replica of the serving fabric (docs/SERVING.md
+"Deploying as a service").  The fabric front end (service/server.py)
+connects one control socket and drives the replica by RPC — every
+message is a request/response pair of wire.py frames, so the whole
+fabric stays as deterministic as the in-process router the tests pin
+parity against:
+
+  hello            -> hello {replica_id, role, capacity, hybrid, ...}
+  submit           -> submit_ack {request_id, stats} | error
+  submit_migrated  -> submit_ack | error {retriable}  (wire-crossed
+                      PR-10 migration artifact -> engine.submit_migrated)
+  step             -> migrate_offer* -> step_result {events, stats}
+  ping             -> pong {stats}              (heartbeat probe)
+  drain            -> drain_ack {withdrawn, stats}
+  summary          -> summary_result {summary}
+  shutdown         -> bye (process exits)
+
+``step`` is the one RPC with sub-messages: while the engine steps, a
+prefill-role replica's ``migrate_hook`` may fire — the worker sends a
+``migrate_offer`` carrying the serialized artifact and BLOCKS for the
+controller's ``migrate_ack`` (the controller places the artifact on a
+decode worker over that worker's own socket meanwhile), then the step
+finishes and ``step_result`` closes the RPC.  True ack => this engine
+frees the slot and pages (serving/engine._migrate_ready); False =>
+mixed-mode fallback, decode here.
+
+Lifecycle: SIGTERM (scripts/serve_worker.py installs the handler)
+marks the replica DRAINING — no new placements; queued-but-unstarted
+work is the controller's to withdraw — and the process exits once
+nothing is resident.  If no controller is connected at SIGTERM the
+worker self-steps to drain (tokens go nowhere; it is a shutdown, not a
+stream).  A controller vanishing mid-run is NOT fatal: the worker
+keeps its state and re-accepts, so a restarted front end finds the
+replica where it left it.
+
+Every serving_tick/request record the engine emits lands in the
+worker's OWN jsonl stream (``--jsonl``), stamped with its replica id;
+span streams (``--spans``) merge with the server's via
+``scripts/trace_export.py`` into one cross-process timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import time
+
+from mamba_distributed_tpu.serving.service import wire
+
+# message types the session dispatcher understands (anything else is a
+# named error back to the peer, never a hang)
+_HANDLED = ("hello", "submit", "submit_migrated", "step", "ping", "drain",
+            "summary", "shutdown")
+
+
+# ------------------------------------------------------------- config I/O
+
+
+def config_to_json(cfg, path: str) -> None:
+    """Serialize a ModelConfig for a worker process to rebuild —
+    identical config in every process is half the parity contract (the
+    other half is the shared param seed)."""
+    d = {f.name: getattr(cfg, f.name)
+         for f in dataclasses.fields(cfg) if f.init}
+    d = {k: (list(v) if isinstance(v, tuple) else v) for k, v in d.items()}
+    with open(path, "w") as f:
+        json.dump(d, f)
+
+
+def config_from_json(path: str):
+    from mamba_distributed_tpu.config import ModelConfig
+
+    with open(path) as f:
+        d = json.load(f)
+    # JSON has no tuples; every sequence-valued config field is a tuple
+    # (attn_layer_idx, ...) so the coercion is lossless
+    d = {k: (tuple(v) if isinstance(v, list) else v) for k, v in d.items()}
+    return ModelConfig(**d)
+
+
+# ---------------------------------------------------------------- worker
+
+
+class WorkerServer:
+    """One replica behind a TCP listener (see module docstring).
+
+    Args:
+      replica: the ``serving.EngineReplica`` to serve.  If its role is
+        "prefill" and the config's ``disagg_prompt_threshold`` > 0 the
+        worker installs the wire-level migration hook on its engine —
+        prefill-complete slots are offered to the controller instead of
+        decoded here (the cross-host version of the hook
+        serving/router.py installs in-process).
+      host/port: listen address; port 0 binds an ephemeral port (read
+        ``.port`` after construction — scripts/serve_worker.py prints
+        it in its READY line).
+      poll_s: accept/recv poll granularity — how often the loop checks
+        the SIGTERM flag between frames.
+    """
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0,
+                 *, poll_s: float = 0.05):
+        self.replica = replica
+        self.poll_s = poll_s
+        self._term = False
+        self._shutdown = False
+        self._conn: socket.socket | None = None
+        self._lsock = socket.create_server((host, port))
+        self._lsock.settimeout(poll_s)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        eng = replica.engine
+        if replica.role == "prefill" and eng.cfg.disagg_prompt_threshold > 0:
+            eng.migrate_hook = self._offer_migration
+
+    # ------------------------------------------------------------- control
+
+    def request_term(self) -> None:
+        """SIGTERM path: stop accepting (DRAINING), exit once empty.
+        Queued-but-unstarted work stays withdrawable by the controller
+        until the engine admits it."""
+        self._term = True
+        self.replica.drain()
+
+    def _stats(self) -> dict:
+        eng = self.replica.engine
+        s = {
+            "depth": eng.scheduler.depth,
+            "resident": len(eng._slots),
+            "capacity": eng.capacity,
+            "pending": self.replica.pending,
+            "state": self.replica.state.value,
+            "hybrid": eng.hybrid,
+        }
+        if eng.hybrid:
+            s["free_pages"] = eng.page_pool.free_pages
+            s["num_pages"] = eng.page_pool.num_pages
+            s["pages_in_use"] = eng.page_pool.pages_in_use
+        return s
+
+    # ------------------------------------------------------------ migration
+
+    # how long a prefill-complete slot waits for the controller's
+    # migrate_ack: the controller is re-placing the artifact on a
+    # decode worker over ANOTHER socket (encode + submit_migrated RPC)
+    # — ms on loopback, but it must never race the session's short
+    # poll timeout: a falsely-timed-out decline would both
+    # double-execute the request AND leave the late ack frame in the
+    # stream to desync the next RPC
+    MIGRATE_ACK_TIMEOUT_S = 60.0
+
+    def _offer_migration(self, tracked, package) -> bool:
+        """The engine's ``migrate_hook``, wire edition: serialize the
+        artifact, offer it to the controller, block for the ack (the
+        session timeout is RAISED to ``MIGRATE_ACK_TIMEOUT_S`` for the
+        wait — see above — and restored after).  No controller
+        connected (or a wire failure mid-offer) declines — mixed-mode
+        fallback, the slot decodes here; never a stall."""
+        if self._conn is None:
+            return False
+        snap = package()
+        try:
+            wire.send_msg(self._conn, "migrate_offer", {
+                "request_id": tracked.request_id,
+                "snapshot": wire.encode_tree(snap),
+                "stats": self._stats(),
+            })
+            # the controller replies migrate_ack before anything else
+            # on this socket (the step RPC is still open)
+            self._conn.settimeout(self.MIGRATE_ACK_TIMEOUT_S)
+            try:
+                mtype, payload = wire.recv_msg(self._conn)
+            finally:
+                self._conn.settimeout(self.poll_s)
+        except (wire.WireError, socket.timeout, OSError):
+            return False
+        if mtype != "migrate_ack":
+            return False
+        return bool(payload.get("accepted"))
+
+    # ------------------------------------------------------------- serving
+
+    def serve_forever(self) -> None:
+        """Accept loop: one control session at a time; SIGTERM drains
+        and exits once nothing is resident."""
+        try:
+            while not self._shutdown:
+                try:
+                    conn, _ = self._lsock.accept()
+                except socket.timeout:
+                    self._idle_tick()
+                    continue
+                try:
+                    self._session(conn)
+                finally:
+                    self._conn = None
+                    conn.close()
+        finally:
+            self._lsock.close()
+
+    def _idle_tick(self) -> None:
+        """No controller connected: honor SIGTERM by self-draining
+        (resident work steps to completion; its tokens have no
+        consumer — this is shutdown, not serving)."""
+        if not self._term:
+            return
+        if self.replica.pending:
+            self.replica.step()
+        if self.replica.pending == 0:
+            self._shutdown = True
+
+    def _session(self, conn: socket.socket) -> None:
+        conn.settimeout(self.poll_s)
+        self._conn = conn
+        while not self._shutdown:
+            try:
+                mtype, payload = wire.recv_msg(conn)
+            except socket.timeout:
+                if self._term and self.replica.pending == 0:
+                    self._shutdown = True
+                continue
+            except wire.UnknownWireVersionError as e:
+                # the NAMED version error: reply and close, never hang
+                try:
+                    wire.send_msg(conn, "error", {
+                        "error": str(e),
+                        "error_type": type(e).__name__,
+                        "retriable": False,
+                    })
+                except wire.WireError:
+                    pass
+                return
+            except wire.WireError:
+                return  # controller went away; re-accept
+            try:
+                self._dispatch(conn, mtype, payload)
+            except wire.WireError:
+                return
+
+    def _dispatch(self, conn: socket.socket, mtype: str,
+                  payload: dict) -> None:
+        rep = self.replica
+        if mtype == "hello":
+            eng = rep.engine
+            wire.send_msg(conn, "hello", {
+                "v": wire.WIRE_VERSION,
+                "replica_id": rep.replica_id,
+                "role": rep.role,
+                "capacity": eng.capacity,
+                "hybrid": eng.hybrid,
+                "stats": self._stats(),
+            })
+        elif mtype == "submit":
+            try:
+                request = wire.decode_request(payload["request"])
+                local_id = rep.submit(request,
+                                      force=bool(payload.get("force")))
+            except Exception as e:  # noqa: BLE001 — serialized back
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "submit_ack", {
+                "request_id": local_id, "stats": self._stats(),
+            })
+        elif mtype == "submit_migrated":
+            try:
+                request = wire.decode_request(payload["request"])
+                snap = wire.decode_tree(payload["snapshot"])
+                local_id = rep.engine.submit_migrated(
+                    request, snap,
+                    source_replica=payload.get("source_replica"),
+                )
+            except Exception as e:  # noqa: BLE001
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "submit_ack", {
+                "request_id": local_id, "stats": self._stats(),
+            })
+        elif mtype == "step":
+            events = rep.step()  # may emit migrate_offer sub-messages
+            wire.send_msg(conn, "step_result", {
+                "events": [wire.encode_event(ev) for ev in events],
+                "stats": self._stats(),
+            })
+        elif mtype == "ping":
+            wire.send_msg(conn, "pong", {
+                "stats": self._stats(), "t": time.time(),
+            })
+        elif mtype == "drain":
+            withdrawn = rep.drain(requeue=bool(payload.get("requeue")))
+            wire.send_msg(conn, "drain_ack", {
+                "withdrawn": withdrawn, "stats": self._stats(),
+            })
+        elif mtype == "summary":
+            from mamba_distributed_tpu.obs import jsonable
+
+            wire.send_msg(conn, "summary_result", {
+                "summary": jsonable(rep.engine.metrics.summary()),
+            })
+        elif mtype == "shutdown":
+            wire.send_msg(conn, "bye", {})
+            self._shutdown = True
+        else:
+            wire.send_msg(conn, "error", {
+                "error": f"unknown message type {mtype!r} (this worker "
+                         f"handles {_HANDLED})",
+                "error_type": "UnknownMessageType",
+                "retriable": False,
+            })

@@ -233,6 +233,157 @@ def _disagg_bench(cfg, params, requests, capacity, tokens_per_tick,
     return out, summary
 
 
+def _service_bench(cfg, requests, capacity, tokens_per_tick, n_workers,
+                   params):
+    """The cross-host service overhead row (docs/SERVING.md "Deploying
+    as a service"): the identical workload served (a) by an in-process
+    ``RequestRouter`` over N local replicas and (b) by the full service
+    stack — N loopback worker subprocesses behind the HTTP/SSE front
+    end — with client-side TTFT/ITL stamps on both, so the deltas price
+    exactly the wire: HTTP parse + SSE framing + the codec + one RPC
+    hop per fabric tick.  Returns the record fields."""
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.serving import GenerationRequest, RequestRouter
+    from mamba_distributed_tpu.serving.service import client as svc_client
+    from mamba_distributed_tpu.serving.service.health import HeartbeatMonitor
+    from mamba_distributed_tpu.serving.service.remote import RemoteReplica
+    from mamba_distributed_tpu.serving.service.server import (
+        FabricController,
+        FabricHTTPServer,
+    )
+    from mamba_distributed_tpu.serving.service.worker import config_to_json
+    from serve_fabric import spawn_worker
+
+    def fresh():
+        return [GenerationRequest(
+            prompt_ids=np.asarray(r.prompt_ids),
+            max_new_tokens=r.max_new_tokens, seed=r.seed,
+        ) for r in requests]
+
+    total_new = sum(r.max_new_tokens for r in requests)
+    out = {}
+
+    # ---- in-process baseline: same client-side stamping protocol
+    def run_inprocess(router):
+        t_submit, first, last, itls = {}, {}, {}, []
+        t0 = _time.perf_counter()
+        for r in fresh():
+            gid = router.submit(r)
+            t_submit[gid] = _time.perf_counter()
+        prev = {}
+        while router.pending:
+            for ev in router.step():
+                now = _time.perf_counter()
+                if ev.request_id not in first:
+                    first[ev.request_id] = now
+                else:
+                    itls.append((now - prev[ev.request_id]) * 1000)
+                prev[ev.request_id] = now
+                last[ev.request_id] = now
+        wall = _time.perf_counter() - t0
+        ttfts = [(first[g] - t_submit[g]) * 1000 for g in first]
+        return wall, ttfts, itls
+
+    router = RequestRouter(params, cfg, num_replicas=n_workers,
+                           capacity=capacity,
+                           tokens_per_tick=tokens_per_tick,
+                           retain_results=False)
+    run_inprocess(router)  # warm every jit signature
+    _progress("in-process: warm")
+    wall, ttfts, itls = run_inprocess(router)
+    out["wall_s_inprocess"] = round(wall, 3)
+    out["tokens_per_sec_inprocess"] = round(total_new / wall, 1)
+    out["ttft_p95_ms_inprocess"] = _p95(ttfts)
+    out["itl_p95_ms_inprocess"] = _p95(itls)
+    _progress(f"in-process: {out['tokens_per_sec_inprocess']} tok/s")
+
+    # ---- the service: loopback worker subprocesses + HTTP/SSE
+    fd, cfg_path = tempfile.mkstemp(suffix="_svc_cfg.json")
+    os.close(fd)
+    config_to_json(cfg, cfg_path)
+    procs, replicas = [], []
+    http = controller = None
+    try:
+        for i in range(n_workers):
+            proc, port = spawn_worker(
+                cfg_path, i, "mixed", capacity=capacity,
+                tokens_per_tick=tokens_per_tick, param_seed=0,
+            )
+            procs.append(proc)
+            replicas.append(RemoteReplica(i, ("127.0.0.1", port)))
+        svc_router = RequestRouter(None, cfg, replicas=replicas,
+                                   retain_results=False)
+        controller = FabricController(
+            svc_router, health=HeartbeatMonitor(svc_router)
+        )
+        controller.start()
+        http = FabricHTTPServer(controller)
+        http_port = http.start_background()
+        _progress(f"service: {n_workers} worker(s) up on :{http_port}")
+
+        def run_service():
+            results = [None] * len(requests)
+            errors = []
+
+            def drive(i, r):
+                spec = {"prompt_ids": np.asarray(r.prompt_ids).tolist(),
+                        "max_new_tokens": r.max_new_tokens, "seed": r.seed}
+                try:
+                    results[i] = svc_client.stream_generate(
+                        "127.0.0.1", http_port, spec)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+            threads = [threading.Thread(target=drive, args=(i, r))
+                       for i, r in enumerate(requests)]
+            t0 = _time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = _time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(f"service run failed: {errors[:3]}")
+            ttfts = [r["ttft_ms"] for r in results if r["ttft_ms"]]
+            itls = [x for r in results for x in r["itl_ms"]]
+            return wall, ttfts, itls
+
+        run_service()  # warm the workers (and the client path)
+        _progress("service: warm")
+        wall, ttfts, itls = run_service()
+    finally:
+        if http is not None:
+            http.stop()
+        if controller is not None:
+            controller.stop()
+        for rep in replicas:
+            rep.shutdown()
+        for proc in procs:
+            proc.kill()
+            proc.wait(timeout=10)
+        os.unlink(cfg_path)
+    out["wall_s_service"] = round(wall, 3)
+    out["tokens_per_sec_service"] = round(total_new / wall, 1)
+    out["ttft_p95_ms_service"] = _p95(ttfts)
+    out["itl_p95_ms_service"] = _p95(itls)
+    out["throughput_vs_inprocess"] = round(
+        out["tokens_per_sec_service"] / out["tokens_per_sec_inprocess"], 3
+    )
+    for m in ("ttft_p95_ms", "itl_p95_ms"):
+        a, b = out[f"{m}_service"], out[f"{m}_inprocess"]
+        out[f"{m.rsplit('_ms', 1)[0]}_delta_ms"] = (
+            round(a - b, 3) if a is not None and b is not None else None
+        )
+    _progress(f"service: {out['tokens_per_sec_service']} tok/s "
+              f"({out['throughput_vs_inprocess']}x of in-process)")
+    return out
+
+
 def _long_prompt_bench(cfg, params, requests, capacity, tokens_per_tick,
                        budget, short_max_len, jsonl):
     """Run the mixed long+short workload once per prefill mode; return
@@ -409,6 +560,15 @@ def main() -> None:
                          "router vs single-engine aggregate decode rate "
                          "(SERVE_DATA_SHARDS additionally shards each "
                          "replica's slot pool over a serving_mesh)")
+    ap.add_argument("--service", action="store_true",
+                    help="cross-host service overhead: the default "
+                         "workload through SERVE_WORKERS (2) loopback "
+                         "worker subprocesses behind the HTTP/SSE front "
+                         "end vs an in-process router of the same "
+                         "replica count, with client-side TTFT/ITL "
+                         "stamps for both — the BENCH_SERVING.json "
+                         "service_overhead row (docs/SERVING.md "
+                         "'Deploying as a service')")
     ap.add_argument("--model-shards", type=int, default=0, metavar="N",
                     help="tensor-parallel the serving weights N-way over "
                          "the 2-D serving mesh's model axis "
@@ -463,6 +623,7 @@ def main() -> None:
                              ("--quant-kv-capacity",
                               args.quant_kv_capacity),
                              ("--spec-tokens", bool(args.spec_tokens)),
+                             ("--service", args.service),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
         ap.error(f"{' and '.join(modes)} are separate bench modes; "
@@ -814,6 +975,31 @@ def main() -> None:
             "prompt_len_range": [pmin, pmax],
             "max_new_tokens": max_new,
             "kv_dtype": cfg.kv_page_dtype,
+            "device": dev.device_kind,
+        }
+        emit_bench_record(record, args.json)
+        return
+
+    if args.service:
+        n_workers = int(os.environ.get("SERVE_WORKERS", "2"))
+        requests = _workload(rng, n_requests, pmin, pmax, max_new,
+                             cfg.vocab_size)
+        fields = _service_bench(cfg, requests, capacity, tokens_per_tick,
+                                n_workers, params)
+        record = {
+            "metric": (f"serving_service_overhead_"
+                       f"{preset.replace('-', '_')}"),
+            "value": fields["throughput_vs_inprocess"],
+            "unit": ("service tok/s as a fraction of in-process router "
+                     "tok/s (HTTP/SSE + wire codec + per-tick RPC "
+                     "overhead; identical workload and replica count)"),
+            **fields,
+            "workers": n_workers,
+            "requests": n_requests,
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "max_new_tokens": max_new,
             "device": dev.device_kind,
         }
         emit_bench_record(record, args.json)

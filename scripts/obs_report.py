@@ -425,6 +425,51 @@ def build_report(events: list[dict]) -> dict:
                 "routes": dict(sorted(routes.items())),
             }
 
+    # --- fabric health (serving_health records from the cross-host
+    # service's HeartbeatMonitor, serving/service/health.py): per-
+    # replica beat/miss counts, heartbeat round-trip percentiles, and
+    # the lifecycle/failover timeline — the at-a-glance answer to "did
+    # any worker die, and did its work land somewhere"
+    health = [e for e in events if e.get("kind") == "serving_health"]
+    if health:
+        hper: dict[int, dict] = {}
+        for e in health:
+            d = hper.setdefault(e.get("replica"), {
+                "beats": 0, "missed": 0, "failovers": 0,
+                "failover_errors": 0, "requeued": 0,
+                "heartbeat_ms": [], "transitions": [],
+            })
+            ev = e.get("event")
+            if ev == "beat":
+                d["beats"] += 1
+                if e.get("heartbeat_ms") is not None:
+                    d["heartbeat_ms"].append(e["heartbeat_ms"])
+            elif ev == "missed":
+                d["missed"] += 1
+            elif ev == "failover":
+                d["failovers"] += 1
+                d["requeued"] += len(e.get("requeued") or [])
+            elif ev == "failover_error":
+                d["failover_errors"] += 1
+            elif ev == "lifecycle":
+                d["transitions"].append(e.get("transition"))
+        report["fabric_health"] = {
+            "replicas": {
+                rid: {
+                    "beats": d["beats"],
+                    "missed": d["missed"],
+                    "failovers": d["failovers"],
+                    "failover_errors": d["failover_errors"],
+                    "requeued": d["requeued"],
+                    "heartbeat_ms": (_pcts(d["heartbeat_ms"])
+                                     if d["heartbeat_ms"] else None),
+                    "transitions": d["transitions"],
+                }
+                for rid, d in sorted(hper.items(),
+                                     key=lambda kv: (kv[0] is None, kv[0]))
+            }
+        }
+
     # --- SLO attainment (obs/slo.py): the monitor stamps its targets
     # into the stream as an slo_config event, so attainment is
     # recomputable offline from the request records; breach/recovery
@@ -625,6 +670,20 @@ def format_report(report: dict) -> str:
             rows, ["replica", "requests", "ticks", "decode_tokens",
                    "mean_occ", "peak_queue", "min_kv_free",
                    "itl_p50/p95"]
+        ))
+    if "fabric_health" in report:
+        rows = []
+        for rid, d in report["fabric_health"]["replicas"].items():
+            hb = d["heartbeat_ms"]
+            rows.append([
+                _fmt(rid), d["beats"], d["missed"], d["failovers"],
+                d["requeued"],
+                "-" if hb is None else f"{_fmt(hb['p50'])}/{_fmt(hb['p95'])}",
+                ",".join(t for t in d["transitions"] if t) or "-",
+            ])
+        out.append("== fabric health (serving_health) ==\n" + _table(
+            rows, ["replica", "beats", "missed", "failovers", "requeued",
+                   "hb_p50/p95_ms", "transitions"]
         ))
     if "migrations" in report:
         m = report["migrations"]

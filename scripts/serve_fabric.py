@@ -1,0 +1,224 @@
+"""Fabric front-end entrypoint: HTTP/SSE server over worker processes.
+
+Deploys the router/replica fabric across processes (docs/SERVING.md
+"Deploying as a service"): connects one ``RemoteReplica`` per worker
+(scripts/serve_worker.py), runs the UNCHANGED ``RequestRouter``
+placement/failover/migration loop behind an asyncio HTTP front end,
+and drives the heartbeat monitor that turns a dead worker into a
+wire-level failover replay:
+
+  POST /v1/generate      -> SSE token stream
+  GET  /healthz          -> fabric + heartbeat health
+  POST /drain/<replica>  -> graceful retire (queued work requeues)
+  GET  /metrics-summary  -> per-replica engine summaries
+
+Two ways to get workers:
+
+  --workers host:port,host:port   connect to already-running workers
+  --spawn N                       spawn N loopback workers here (one
+                                  subprocess each; CI/smoke mode)
+
+Prints one READY line once serving:
+
+  SERVE_FABRIC_READY port=8100 workers=2 pid=12345
+
+SIGTERM/SIGINT runs the rolling shutdown: drain every replica
+(queued-but-unplaced work requeues while survivors exist), wait for
+in-flight streams to finish, then — spawn mode — shut the workers
+down.  ``--jsonl`` collects the fabric's serving_health records
+(scripts/obs_report.py renders the fabric-health table); ``--spans``
+writes the router's span stream (merge with the workers' via
+scripts/trace_export.py for one cross-process timeline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def spawn_worker(config_path: str, replica_id: int, role: str, *,
+                 capacity: int, tokens_per_tick: int, param_seed: int,
+                 jsonl: str | None = None, spans: str | None = None,
+                 timeout_s: float = 120.0) -> tuple[subprocess.Popen, int]:
+    """Spawn one serve_worker.py subprocess; returns (proc, port) once
+    its READY line arrives.  Shared by this CLI, the tests, and
+    ``bench_serving --service``."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "serve_worker.py"),
+           "--config", config_path, "--replica-id", str(replica_id),
+           "--role", role, "--capacity", str(capacity),
+           "--tokens-per-tick", str(tokens_per_tick),
+           "--param-seed", str(param_seed), "--port", "0"]
+    if jsonl:
+        cmd += ["--jsonl", jsonl]
+    if spans:
+        cmd += ["--spans", spans]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+    # the READY wait must honor timeout_s even when the worker wedges
+    # WITHOUT writing a line (a blocking `for line in stdout` would
+    # hang forever), so a reader thread feeds a queue we wait on with
+    # a real deadline; the same thread then keeps draining the pipe so
+    # the worker can never block on stdout
+    import queue as _queue
+
+    lines: _queue.Queue = _queue.Queue()
+
+    def _pump():
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)  # EOF (worker exited)
+
+    threading.Thread(target=_pump, daemon=True).start()
+    deadline = time.monotonic() + timeout_s
+    port = None
+    while port is None:
+        try:
+            line = lines.get(timeout=max(0.0, deadline - time.monotonic()))
+        except _queue.Empty:
+            break
+        if line is None:
+            break
+        if line.startswith("SERVE_WORKER_READY"):
+            port = int(dict(kv.split("=") for kv in line.split()[1:])["port"])
+    if port is None:
+        proc.kill()
+        raise RuntimeError(
+            f"worker {replica_id} never printed its READY line within "
+            f"{timeout_s}s (rc={proc.poll()})"
+        )
+    return proc, port
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", required=True, metavar="PATH",
+                    help="ModelConfig JSON shared with the workers "
+                         "(worker.config_to_json)")
+    grp = ap.add_mutually_exclusive_group(required=True)
+    grp.add_argument("--workers", metavar="HOST:PORT,...",
+                     help="connect to already-running workers")
+    grp.add_argument("--spawn", type=int, metavar="N",
+                     help="spawn N loopback workers as subprocesses")
+    ap.add_argument("--roles", default=None, metavar="R0,R1,...",
+                    help="per-replica tier roles (mixed|prefill|decode; "
+                         "default all mixed)")
+    ap.add_argument("--http-host", default="127.0.0.1")
+    ap.add_argument("--http-port", type=int, default=8100,
+                    help="HTTP/SSE listen port (0 = ephemeral; see "
+                         "READY line)")
+    ap.add_argument("--heartbeat-ms", type=float, default=200.0)
+    ap.add_argument("--miss-threshold", type=int, default=3)
+    ap.add_argument("--capacity", type=int, default=4,
+                    help="per-worker slot capacity (spawn mode)")
+    ap.add_argument("--tokens-per-tick", type=int, default=8)
+    ap.add_argument("--param-seed", type=int, default=0)
+    ap.add_argument("--jsonl", default=None, metavar="PATH",
+                    help="fabric serving_health record stream")
+    ap.add_argument("--spans", default=None, metavar="PATH",
+                    help="router span stream (trace_export.py input)")
+    args = ap.parse_args()
+
+    from mamba_distributed_tpu.obs import (
+        NULL_TRACER,
+        SpanTracer,
+        append_jsonl,
+    )
+    from mamba_distributed_tpu.serving import RequestRouter
+    from mamba_distributed_tpu.serving.service.health import HeartbeatMonitor
+    from mamba_distributed_tpu.serving.service.remote import RemoteReplica
+    from mamba_distributed_tpu.serving.service.server import (
+        FabricController,
+        FabricHTTPServer,
+    )
+    from mamba_distributed_tpu.serving.service.worker import config_from_json
+
+    cfg = config_from_json(args.config)
+    procs: list[subprocess.Popen] = []
+    if args.spawn:
+        n = args.spawn
+    else:
+        addrs = [a.strip() for a in args.workers.split(",") if a.strip()]
+        n = len(addrs)
+    roles = (args.roles.split(",") if args.roles else ["mixed"] * n)
+    if len(roles) != n:
+        ap.error(f"--roles names {len(roles)} role(s) for {n} worker(s)")
+
+    if args.spawn:
+        addrs = []
+        for i in range(n):
+            proc, port = spawn_worker(
+                args.config, i, roles[i], capacity=args.capacity,
+                tokens_per_tick=args.tokens_per_tick,
+                param_seed=args.param_seed,
+            )
+            procs.append(proc)
+            addrs.append(f"127.0.0.1:{port}")
+    replicas = []
+    for i, addr in enumerate(addrs):
+        host, _, port = addr.rpartition(":")
+        replicas.append(RemoteReplica(i, (host, int(port)), role=roles[i]))
+
+    tracer = SpanTracer(args.spans) if args.spans else NULL_TRACER
+    if args.jsonl:
+        open(args.jsonl, "w").close()
+        emit = lambda rec: append_jsonl(args.jsonl, rec)  # noqa: E731
+    else:
+        emit = None
+    router = RequestRouter(None, cfg, replicas=replicas, tracer=tracer,
+                           retain_results=False)
+    health = HeartbeatMonitor(router, interval_ms=args.heartbeat_ms,
+                              miss_threshold=args.miss_threshold, emit=emit)
+    controller = FabricController(router, health=health)
+    controller.start()
+    http = FabricHTTPServer(controller, args.http_host, args.http_port)
+    port = http.start_background()
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    print(f"SERVE_FABRIC_READY port={port} workers={n} pid={os.getpid()}",
+          flush=True)
+    stop.wait()
+
+    # rolling shutdown: drain everyone (queued work requeues while any
+    # survivor accepts), wait for in-flight streams, then retire
+    for rep in replicas:
+        try:
+            controller.call(
+                lambda rid=rep.replica_id:
+                router.drain(rid, requeue_queued=True)
+            ).result(30)
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            pass
+    deadline = time.monotonic() + 60
+    while router.pending and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if procs:
+        # spawn mode owns its workers; externally-started workers are
+        # the operator's to retire (they are drained, not shut down)
+        for rep in replicas:
+            rep.shutdown()
+    for proc in procs:
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    http.stop()
+    controller.stop()
+    controller.join(timeout=10)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

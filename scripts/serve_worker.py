@@ -1,0 +1,114 @@
+"""Replica worker entrypoint: one serving engine behind a TCP port.
+
+One process per replica of the cross-host fabric (docs/SERVING.md
+"Deploying as a service").  The worker builds its engine from a config
+JSON (``serving.service.worker.config_to_json`` — identical config in
+every process) and a shared ``--param-seed`` (identical weights), binds
+a loopback/TCP listener, prints one READY line:
+
+  SERVE_WORKER_READY replica=0 role=mixed port=41733 pid=12345
+
+and then serves RPC frames from the fabric front end
+(scripts/serve_fabric.py) until shutdown.  SIGTERM drains: no new
+placements, resident work finishes, then the process exits — the
+rolling-restart contract.
+
+  # a 2-worker loopback fabric by hand:
+  python scripts/serve_worker.py --config cfg.json --replica-id 0 &
+  python scripts/serve_worker.py --config cfg.json --replica-id 1 &
+  python scripts/serve_fabric.py --config cfg.json \
+      --workers 127.0.0.1:PORT0,127.0.0.1:PORT1
+
+Real checkpoints: pass ``--checkpoint DIR`` to serve trained params
+instead of the seed-initialized ones (the seed path is the parity/CI
+harness — every process derives bit-identical weights with zero
+checkpoint I/O).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--config", metavar="PATH",
+                     help="ModelConfig JSON (worker.config_to_json)")
+    src.add_argument("--preset", metavar="NAME",
+                     help="named preset instead of a config JSON")
+    ap.add_argument("--replica-id", type=int, default=0)
+    ap.add_argument("--role", default="mixed",
+                    choices=["mixed", "prefill", "decode"],
+                    help="disaggregated-tier role (docs/SERVING.md)")
+    ap.add_argument("--capacity", type=int, default=4,
+                    help="slot-pool capacity of this replica")
+    ap.add_argument("--tokens-per-tick", type=int, default=8)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral; see READY line)")
+    ap.add_argument("--param-seed", type=int, default=0,
+                    help="PRNG seed for the (shared) param init — every "
+                         "worker and the parity harness must agree")
+    ap.add_argument("--checkpoint", default=None, metavar="DIR",
+                    help="serve trained params from this checkpoint "
+                         "(Orbax dir or reference .pt) instead of "
+                         "seed-initialized ones — requires --preset")
+    ap.add_argument("--jsonl", default=None, metavar="PATH",
+                    help="this replica's serving_tick/request stream "
+                         "(obs_report.py input)")
+    ap.add_argument("--spans", default=None, metavar="PATH",
+                    help="this replica's span stream (trace_export.py "
+                         "merges it with the server's)")
+    args = ap.parse_args()
+
+    import jax
+
+    from mamba_distributed_tpu.config import get_preset
+    from mamba_distributed_tpu.models import init_lm_params
+    from mamba_distributed_tpu.obs import NULL_TRACER, SpanTracer
+    from mamba_distributed_tpu.serving import EngineReplica
+    from mamba_distributed_tpu.serving.service.worker import (
+        WorkerServer,
+        config_from_json,
+    )
+    from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+    if args.checkpoint:
+        if not args.preset:
+            ap.error("--checkpoint needs --preset (the preset the "
+                     "checkpoint was trained with)")
+        from eval import load_custom
+
+        params, cfg = load_custom(args.checkpoint, args.preset)
+    else:
+        cfg = (config_from_json(args.config) if args.config
+               else get_preset(args.preset).model)
+        params = init_lm_params(jax.random.PRNGKey(args.param_seed), cfg)
+    metrics = ServingMetrics(args.capacity, jsonl_path=args.jsonl,
+                             replica=args.replica_id)
+    tracer = SpanTracer(args.spans) if args.spans else NULL_TRACER
+    replica = EngineReplica(
+        args.replica_id, params, cfg, metrics=metrics, tracer=tracer,
+        role=args.role, capacity=args.capacity, retain_results=False,
+        tokens_per_tick=args.tokens_per_tick,
+    )
+    worker = WorkerServer(replica, args.host, args.port)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: worker.request_term())
+    print(
+        f"SERVE_WORKER_READY replica={args.replica_id} role={args.role} "
+        f"port={worker.port} pid={os.getpid()}",
+        flush=True,
+    )
+    worker.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -353,3 +353,121 @@ def test_bench_gate_smoke(tmp_path, monkeypatch):
     # case wins" picks it up (the stale pre-PR-8 expectation here was
     # rc 2 — tier-1's one red test between PRs 8 and 9)
     assert bench_gate.main([fresh, "--band", "0.99"]) == 0
+
+
+def _write_service_cfg(tmp_path):
+    """Tiny CPU config JSON shared by the service CLI smokes."""
+    from mamba_distributed_tpu.config import ModelConfig
+    from mamba_distributed_tpu.serving.service.worker import config_to_json
+
+    cfg = ModelConfig(d_model=32, n_layer=2, vocab_size=64,
+                      ssm_layer="mamba2", headdim=8, chunk_size=16,
+                      d_state=16, compute_dtype="float32",
+                      prefill_chunk_tokens=16, prefill_tokens_per_tick=16)
+    path = str(tmp_path / "service_cfg.json")
+    config_to_json(cfg, path)
+    return path
+
+
+@pytest.mark.service
+@pytest.mark.serving
+def test_serve_worker_cli_smoke(tmp_path):
+    """serve_worker.py spawns, prints its READY line, answers
+    hello/ping over the wire, and SIGTERM-drains to a clean exit
+    (ISSUE 13 satellite: service CLI smoke).  No generation — the
+    streamed-request path is covered by test_service.py — so the smoke
+    stays compile-free and cheap in the tier-1 window."""
+    import signal
+    import socket
+
+    from mamba_distributed_tpu.serving.service import wire
+
+    cfg_path = _write_service_cfg(tmp_path)
+    env = _env()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_worker.py"),
+         "--config", cfg_path, "--replica-id", "0", "--capacity", "2",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env,
+    )
+    try:
+        port = None
+        for line in proc.stdout:
+            if line.startswith("SERVE_WORKER_READY"):
+                fields = dict(kv.split("=") for kv in line.split()[1:])
+                port = int(fields["port"])
+                assert fields["role"] == "mixed"
+                break
+        assert port is not None, "worker never printed READY"
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        sock.settimeout(10)
+        wire.send_msg(sock, "hello", {})
+        mtype, payload = wire.recv_msg(sock)
+        assert mtype == "hello" and payload["replica_id"] == 0
+        assert payload["stats"]["state"] == "active"
+        wire.send_msg(sock, "ping", {})
+        assert wire.recv_msg(sock)[0] == "pong"
+        sock.close()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        proc.kill()
+
+
+@pytest.mark.service
+@pytest.mark.serving
+@pytest.mark.slow
+def test_serve_fabric_cli_smoke(tmp_path):
+    """serve_fabric.py --spawn 1 end to end: READY line, /healthz with
+    a beating worker, one streamed SSE request, /drain with requeue,
+    and a clean SIGTERM rolling shutdown (worker included).  Marked
+    slow: it compiles a worker engine inside the smoke — the same
+    surface runs un-marked in tests/test_service.py through the
+    library entrypoints."""
+    import json
+    import signal
+
+    from mamba_distributed_tpu.serving.service import client as svc_client
+
+    cfg_path = _write_service_cfg(tmp_path)
+    env = _env()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_fabric.py"),
+         "--config", cfg_path, "--spawn", "1", "--http-port", "0",
+         "--capacity", "2", "--tokens-per-tick", "2",
+         "--jsonl", str(tmp_path / "health.jsonl")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env,
+    )
+    try:
+        port = None
+        for line in proc.stdout:
+            if line.startswith("SERVE_FABRIC_READY"):
+                fields = dict(kv.split("=") for kv in line.split()[1:])
+                port = int(fields["port"])
+                assert fields["workers"] == "1"
+                break
+        assert port is not None, "fabric never printed READY"
+        hz = svc_client.http_json("127.0.0.1", port, "GET", "/healthz")
+        assert hz["ok"] and hz["replicas"]["0"]["state"] == "active"
+        res = svc_client.stream_generate(
+            "127.0.0.1", port,
+            {"prompt_ids": [1, 2, 3, 4], "max_new_tokens": 3, "seed": 7},
+            timeout=300,
+        )
+        assert len(res["tokens"]) == 3
+        assert res["finish_reason"] == "length"
+        assert res["ttft_ms"] is not None
+        out = svc_client.http_json("127.0.0.1", port, "POST", "/drain/0")
+        assert out["_status"] == 200 and out["replica"] == 0
+        hz = svc_client.http_json("127.0.0.1", port, "GET", "/healthz")
+        assert hz["replicas"]["0"]["state"] == "draining"
+        # heartbeat records landed on the obs stream
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "health.jsonl") if ln.strip()]
+        assert any(r["event"] == "beat" for r in recs)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        proc.kill()

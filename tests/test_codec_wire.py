@@ -1,0 +1,229 @@
+"""Wire codec unit tests (serving/service/wire.py) — the fast half.
+
+The codec contracts every cross-host message rides on:
+
+  * TREE ROUND-TRIP — arbitrary pytrees of dicts/lists/tuples/ndarrays
+    (f32/bf16/int8/int32/uint32 included) survive encode/decode with
+    treedef, dtype, shape AND bytes intact — the property that makes
+    the wire-crossed migration artifact bit-exact.
+  * REQUEST/EVENT CODECS — trace_id, priority and the resolved
+    sampling key survive; framing survives a socketpair.
+  * VERSIONING — an unknown schema version raises the NAMED
+    ``UnknownWireVersionError``, never a misparse or a hang.
+
+The process-level half (worker RPC, fabric failover, migration-parity
+through a real engine) lives in tests/test_service.py.
+"""
+
+import json
+import socket
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.serving import GenerationRequest, TokenEvent
+from mamba_distributed_tpu.serving.service import wire
+
+pytestmark = [pytest.mark.service, pytest.mark.serving, pytest.mark.fast]
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+# ------------------------------------------------------------- tree codec
+
+
+def assert_tree_equal(a, b):
+    assert type(a) is type(b) or (
+        isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+    ), (type(a), type(b))
+    if isinstance(a, dict):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            assert_tree_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert_tree_equal(x, y)
+    elif isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()  # BIT equality, not allclose
+    else:
+        assert a == b
+
+
+def test_tree_roundtrip_mixed_dtypes():
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    tree = {
+        "blocks": (
+            {"conv": rng.normal(size=(1, 4, 8)).astype(np.float32),
+             "ssm": rng.normal(size=(1, 2, 8, 16)).astype(np.float32)},
+            {"kv": (rng.integers(-128, 127, size=(3, 2, 8, 4))
+                    .astype(np.int8)),
+             "scales": rng.normal(size=(3, 2)).astype(np.float32)},
+        ),
+        "logits": rng.normal(size=(1, 64)).astype(ml_dtypes.bfloat16),
+        "lengths": np.asarray([5, 9], np.int32),
+        "key": np.asarray([1, 2], np.uint32),
+        "step": 0,
+        "kv_len": 40,
+        "package_ms": 0.25,
+        "migrated": True,
+        "none_field": None,
+        "names": ["a", "b"],
+    }
+    out = wire.decode_tree(wire.encode_tree(tree))
+    assert_tree_equal(tree, out)
+
+
+def test_tree_rejects_tag_collision():
+    with pytest.raises(wire.WireError, match="codec tags"):
+        wire.encode_tree({"__nd__": 1})
+
+
+def test_jax_arrays_encode_as_numpy():
+    a = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    out = wire.decode_tree(wire.encode_tree({"a": a}))
+    np.testing.assert_array_equal(out["a"], np.asarray(a))
+    assert isinstance(out["a"], np.ndarray)
+
+
+# -------------------------------------------------------- request / events
+
+
+def test_request_roundtrip_preserves_trace_and_priority():
+    req = GenerationRequest(prompt_ids=rand_prompt(9), max_new_tokens=7,
+                            top_k=3, temperature=0.7, eos_id=5, seed=42,
+                            trace_id="req-abc123", priority=2)
+    out = wire.decode_request(wire.encode_request(req))
+    np.testing.assert_array_equal(out.prompt_ids, req.prompt_ids)
+    assert out.max_new_tokens == 7 and out.top_k == 3
+    assert out.temperature == pytest.approx(0.7)
+    assert out.eos_id == 5 and out.seed == 42
+    assert out.trace_id == "req-abc123" and out.priority == 2
+    assert out.key is None
+
+
+def test_request_roundtrip_ships_resolved_key():
+    req = GenerationRequest(prompt_ids=rand_prompt(4),
+                            key=jax.random.PRNGKey(123))
+    out = wire.decode_request(wire.encode_request(req))
+    np.testing.assert_array_equal(
+        np.asarray(out.resolve_key()), np.asarray(req.resolve_key())
+    )
+
+
+def test_event_roundtrip():
+    ev = TokenEvent(3, 41, 7, True, "eos")
+    out = wire.decode_event(wire.encode_event(ev))
+    assert out == ev
+
+
+def test_framing_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        wire.send_msg(a, "ping", {"x": 1})
+        wire.send_msg(a, "step", {})
+        assert wire.recv_msg(b) == ("ping", {"x": 1})
+        assert wire.recv_msg(b) == ("step", {})
+        a.close()
+        with pytest.raises(wire.WireClosedError):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------------- versioning
+
+
+def test_unknown_version_is_named_error():
+    body = json.dumps({"v": 99, "type": "ping", "payload": {}}).encode()
+    frame = struct.pack(">I", len(body)) + body
+    a, b = socket.socketpair()
+    try:
+        a.sendall(frame)
+        with pytest.raises(wire.UnknownWireVersionError, match="version 99"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_missing_version_is_named_error():
+    with pytest.raises(wire.UnknownWireVersionError):
+        wire.decode_msg(json.dumps({"type": "ping"}).encode())
+
+
+# --------------------------------------------------------- codec edges
+
+
+def test_empty_and_zero_dim_arrays_roundtrip():
+    tree = {"empty": np.zeros((0, 4), np.float32),
+            "scalar0d": np.asarray(3.5, np.float32),
+            "one": np.asarray([7], np.int32)}
+    out = wire.decode_tree(wire.encode_tree(tree))
+    assert_tree_equal(tree, out)
+
+
+def test_noncontiguous_array_encodes_its_values():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]
+    out = wire.decode_array(wire.encode_array(a))
+    np.testing.assert_array_equal(out, np.ascontiguousarray(a))
+
+
+def test_fortran_order_array_roundtrips_values():
+    a = np.asfortranarray(np.arange(12, dtype=np.int32).reshape(3, 4))
+    out = wire.decode_array(wire.encode_array(a))
+    np.testing.assert_array_equal(out, a)
+
+
+def test_empty_containers_and_unicode_roundtrip():
+    tree = {"d": {}, "l": [], "t": (), "s": "prefill→decode ✓"}
+    out = wire.decode_tree(wire.encode_tree(tree))
+    assert out == tree and isinstance(out["t"], tuple)
+
+
+def test_nested_tuple_structure_survives():
+    tree = (1, (2, [3, (4,)]), {"k": (5, 6)})
+    out = wire.decode_tree(wire.encode_tree(tree))
+    assert out == tree
+    assert isinstance(out, tuple) and isinstance(out[1][1][1], tuple)
+
+
+def test_decoded_array_is_writable_copy():
+    # restore paths mutate state in place; a frombuffer view would be
+    # read-only and explode deep inside the engine
+    out = wire.decode_array(wire.encode_array(np.zeros(3, np.float32)))
+    out[0] = 1.0  # must not raise
+
+
+def test_frame_bytes_are_length_prefixed_json():
+    import struct
+
+    frame = wire.encode_msg("ping", {"a": 1})
+    (n,) = struct.unpack(">I", frame[:4])
+    assert len(frame) == 4 + n
+    assert wire.decode_msg(frame[4:]) == ("ping", {"a": 1})
+
+
+def test_decode_msg_rejects_garbage_with_wire_error():
+    with pytest.raises(wire.WireError, match="malformed"):
+        wire.decode_msg(b"\xff\xfenot json")
+    with pytest.raises(wire.WireError, match="message type"):
+        wire.decode_msg(json.dumps({"v": wire.WIRE_VERSION}).encode())
+
+
+def test_request_defaults_roundtrip_minimal():
+    req = GenerationRequest(prompt_ids=np.asarray([1, 2, 3], np.int32))
+    out = wire.decode_request(wire.encode_request(req))
+    assert out.key is None and out.trace_id is None
+    assert out.priority is None and out.eos_id is None
+    assert out.max_new_tokens == req.max_new_tokens

@@ -1,0 +1,599 @@
+"""Cross-host serving service tests (serving/service/).
+
+The ISSUE 13 acceptance contract, over REAL worker subprocesses on
+loopback:
+
+  * HTTP/SSE PARITY — a 2-worker fabric serves concurrent streaming
+    requests over POST /v1/generate with every stream token-identical
+    to solo ``generate()``; the server's and workers' span streams
+    merge into one flow-linked Perfetto timeline.
+  * WIRE-LEVEL FAILOVER — SIGKILL a worker mid-stream: the heartbeat
+    monitor fails it over, the PR-5 replay-dedup runs across the
+    process boundary, and the resumed streams are no-loss/no-dup and
+    token-identical to solo ``generate()``; ``serving_health`` records
+    land on the obs stream and obs_report renders the fabric-health
+    table.
+  * WIRE-CROSSED MIGRATION — a prefill-tier worker's finished carry
+    (+ hybrid KV pages) serializes across two sockets into a decode
+    worker, bit-exactly (plus in-process codec round-trip parity per
+    layer family).
+  * DRAIN SHUTDOWN FIX — draining a replica with queued-but-unplaced
+    requests requeues them to the router (previously only in-flight
+    work survived a drain initiated from outside ``serve()``).
+
+Runnable standalone: ``pytest -m service``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.obs import SpanTracer, append_jsonl
+from mamba_distributed_tpu.serving import (
+    GenerationRequest,
+    ReplicaState,
+    RequestRouter,
+    ServingEngine,
+)
+from mamba_distributed_tpu.serving.service import client as svc_client
+from mamba_distributed_tpu.serving.service import wire
+from mamba_distributed_tpu.serving.service.health import HeartbeatMonitor
+from mamba_distributed_tpu.serving.service.remote import RemoteReplica
+from mamba_distributed_tpu.serving.service.server import (
+    FabricController,
+    FabricHTTPServer,
+)
+from mamba_distributed_tpu.serving.service.worker import config_to_json
+
+pytestmark = [pytest.mark.service, pytest.mark.serving]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHUNK = 16
+
+
+def tiny_cfg(layer="mamba2", **kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32", **kw)
+
+
+def hybrid_cfg(**kw):
+    return tiny_cfg(attn_layer_idx=(1,), attn_num_heads=4,
+                    attn_num_kv_heads=2, remat=False, kv_page_tokens=8,
+                    kv_slot_tokens=64, **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def solo(params, cfg, prompt, seed, max_new):
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None],
+                   jax.random.PRNGKey(seed), max_new_tokens=max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# --------------------------------------------------------- fabric harness
+
+
+class Fabric:
+    """Worker subprocesses + RemoteReplicas + router + HTTP server —
+    the full service stack on loopback, torn down hard on exit."""
+
+    def __init__(self, cfg, tmp_path, *, n=2, roles=None, capacity=3,
+                 tokens_per_tick=2, heartbeat_ms=100.0, miss_threshold=2,
+                 spans=False):
+        self.tmp = tmp_path
+        roles = roles or ["mixed"] * n
+        self.cfg_path = str(tmp_path / "cfg.json")
+        config_to_json(cfg, self.cfg_path)
+        self.procs = []
+        self.worker_spans = []
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for i in range(n):
+            cmd = [sys.executable,
+                   os.path.join(REPO, "scripts", "serve_worker.py"),
+                   "--config", self.cfg_path, "--replica-id", str(i),
+                   "--role", roles[i], "--capacity", str(capacity),
+                   "--tokens-per-tick", str(tokens_per_tick),
+                   "--port", "0"]
+            if spans:
+                span_path = str(tmp_path / f"worker{i}.jsonl")
+                self.worker_spans.append(span_path)
+                cmd += ["--spans", span_path]
+            self.procs.append(subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=REPO, env=env,
+            ))
+        ports = []
+        for i, proc in enumerate(self.procs):
+            port = None
+            for line in proc.stdout:
+                if line.startswith("SERVE_WORKER_READY"):
+                    port = int(dict(kv.split("=")
+                                    for kv in line.split()[1:])["port"])
+                    break
+            assert port is not None, f"worker {i} died before READY"
+            ports.append(port)
+            threading.Thread(target=proc.stdout.read, daemon=True).start()
+        self.replicas = [
+            RemoteReplica(i, ("127.0.0.1", p), role=roles[i],
+                          rpc_timeout_s=120.0)
+            for i, p in enumerate(ports)
+        ]
+        self.server_spans = str(tmp_path / "server.jsonl") if spans else None
+        tracer = SpanTracer(self.server_spans) if spans else None
+        self.health_jsonl = str(tmp_path / "health.jsonl")
+        open(self.health_jsonl, "w").close()
+        self.router = RequestRouter(
+            None, cfg, replicas=self.replicas, retain_results=False,
+            **({"tracer": tracer} if tracer else {}),
+        )
+        self.health = HeartbeatMonitor(
+            self.router, interval_ms=heartbeat_ms,
+            miss_threshold=miss_threshold,
+            emit=lambda rec: append_jsonl(self.health_jsonl, rec),
+        )
+        self.controller = FabricController(self.router, health=self.health)
+        self.controller.start()
+        self.http = FabricHTTPServer(self.controller)
+        self.port = self.http.start_background()
+
+    def stream(self, spec, **kw):
+        return svc_client.stream_generate("127.0.0.1", self.port, spec, **kw)
+
+    def get(self, path):
+        return svc_client.http_json("127.0.0.1", self.port, "GET", path)
+
+    def post(self, path, body=None):
+        return svc_client.http_json("127.0.0.1", self.port, "POST", path,
+                                    body)
+
+    def health_records(self):
+        with open(self.health_jsonl) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+
+    def close(self):
+        self.http.stop()
+        self.controller.stop()
+        self.controller.join(timeout=10)
+        for proc in self.procs:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+@pytest.fixture
+def fabric_factory(tmp_path):
+    fabrics = []
+
+    def make(cfg, **kw):
+        f = Fabric(cfg, tmp_path, **kw)
+        fabrics.append(f)
+        return f
+
+    yield make
+    for f in fabrics:
+        f.close()
+
+
+def _spec(prompt, seed, max_new):
+    return {"prompt_ids": np.asarray(prompt).tolist(), "seed": seed,
+            "max_new_tokens": max_new, "top_k": 50}
+
+
+# -------------------------------------------------------- HTTP/SSE parity
+
+
+def test_fabric_http_sse_concurrent_parity_and_trace_merge(
+        fabric_factory, tmp_path):
+    """2 loopback workers serve 4 concurrent SSE streams (short +
+    chunked-long prompts) token-identical to solo generate(); both
+    workers took work; the server + worker span streams merge into one
+    flow-linked timeline (the cross-process trace_export smoke)."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    fab = fabric_factory(cfg, spans=True)
+    jobs = [(rand_prompt(5 + 3 * i, seed=10 + i), 100 + i, 6)
+            for i in range(3)]
+    jobs.append((rand_prompt(2 * CHUNK + 7, seed=50), 200, 6))  # chunked
+    results = [None] * len(jobs)
+    errors = []
+
+    def drive(i):
+        prompt, seed, max_new = jobs[i]
+        try:
+            results[i] = fab.stream(_spec(prompt, seed, max_new))
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(len(jobs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    for (prompt, seed, max_new), res in zip(jobs, results):
+        assert res["tokens"] == solo(params, cfg, prompt, seed, max_new)
+        assert res["finish_reason"] in ("eos", "length")
+        idx = [ev["index"] for ev in res["events"]]
+        assert idx == list(range(len(idx)))  # contiguous, no dup, no gap
+
+    # both workers actually served (least-loaded placement spread)
+    summary = fab.get("/metrics-summary")
+    served = {rid: s.get("finished_requests", 0)
+              for rid, s in summary.items() if rid != "_status"}
+    assert sum(served.values()) == len(jobs)
+    assert all(v > 0 for v in served.values()), served
+
+    # healthz sees two ACTIVE replicas with heartbeats
+    hz = fab.get("/healthz")
+    assert hz["ok"] and hz["pending"] == 0
+    assert set(hz["replicas"]) == {"0", "1"}
+    assert all(r["state"] == "active" for r in hz["replicas"].values())
+
+    # --- cross-process span-stream merge (scripts/trace_export.py's
+    # library half): one request's journey spans server + worker files
+    from mamba_distributed_tpu.obs import export_chrome_trace
+
+    out = str(tmp_path / "trace.json")
+    meta = export_chrome_trace(
+        [fab.server_spans] + fab.worker_spans, out
+    )
+    assert meta["streams"] == 3
+    assert meta["linked_requests"] >= len(jobs)
+    assert meta["flow_events"] > 0
+    with open(out) as f:
+        trace = json.load(f)
+    assert trace["traceEvents"]
+
+
+# ---------------------------------------------------- wire-level failover
+
+
+def test_fabric_worker_kill_failover_no_loss_no_dup(fabric_factory):
+    """SIGKILL a worker mid-stream: heartbeat-driven failover replays
+    its requests on the survivor over the wire; every stream stays
+    contiguous, duplicate-free and token-identical to solo generate()
+    — the PR-5 replay-cursor pin across a process boundary."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    fab = fabric_factory(cfg, heartbeat_ms=50.0, miss_threshold=2)
+    jobs = [(rand_prompt(6 + 2 * i, seed=20 + i), 300 + i, 20)
+            for i in range(2)]
+    results = [None] * len(jobs)
+    errors = []
+    progress = [0] * len(jobs)
+
+    def drive(i):
+        prompt, seed, max_new = jobs[i]
+
+        def on_event(ev):
+            progress[i] += 1
+
+        try:
+            results[i] = fab.stream(_spec(prompt, seed, max_new),
+                                    on_event=on_event)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(len(jobs))]
+    for t in threads:
+        t.start()
+    # wait until both streams are mid-flight, then kill worker 1
+    deadline = time.monotonic() + 240
+    while (min(progress) < 3 and time.monotonic() < deadline
+           and not errors):
+        time.sleep(0.02)
+    assert min(progress) >= 3, (progress, errors)
+    fab.procs[1].kill()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    for (prompt, seed, max_new), res in zip(jobs, results):
+        assert res["tokens"] == solo(params, cfg, prompt, seed, max_new)
+        idx = [ev["index"] for ev in res["events"]]
+        assert idx == list(range(len(idx)))  # no loss, no dup
+
+    # the fabric recorded the death: replica 1 DEAD, failover event with
+    # requeued work, and beats for the survivor
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        recs = fab.health_records()
+        if any(r["event"] == "failover" for r in recs):
+            break
+        time.sleep(0.05)
+    events = [r["event"] for r in recs]
+    assert "failover" in events, events
+    fo = next(r for r in recs if r["event"] == "failover")
+    assert fo["replica"] == 1 and fo["requeued"]
+    assert any(r["event"] == "beat" for r in recs)
+    hz = fab.get("/healthz")
+    assert hz["replicas"]["1"]["state"] == "dead"
+    assert hz["replicas"]["0"]["state"] == "active"
+
+    # the obs_report fabric-health table renders from the same stream
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from obs_report import build_report, format_report
+    finally:
+        sys.path.pop(0)
+    report = build_report(recs)
+    assert "fabric_health" in report
+    h1 = report["fabric_health"]["replicas"][1]
+    assert h1["failovers"] == 1
+    assert any("dead" in t for t in h1["transitions"])
+    assert "fabric health" in format_report(report)
+
+
+# ------------------------------------------------- wire-crossed migration
+
+
+def test_fabric_migration_crosses_wire(fabric_factory):
+    """Disaggregated tiers over processes: a long prompt prefills on
+    the prefill-tier worker, its carry + KV pages serialize across two
+    sockets into the decode worker, and the stream stays bit-exact."""
+    cfg = hybrid_cfg(disagg_prompt_threshold=24)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    fab = fabric_factory(cfg, roles=["prefill", "decode"])
+    long_prompt = rand_prompt(2 * CHUNK + 7, seed=50)
+    short_prompt = rand_prompt(7, seed=11)
+    res_long = fab.stream(_spec(long_prompt, 400, 6))
+    res_short = fab.stream(_spec(short_prompt, 401, 6))
+    assert res_long["tokens"] == solo(params, cfg, long_prompt, 400, 6)
+    assert res_short["tokens"] == solo(params, cfg, short_prompt, 401, 6)
+    hz = fab.get("/healthz")
+    assert hz["migrations"] >= 1  # the artifact crossed the wire
+    # the decode tier finished the migrated stream
+    summary = fab.get("/metrics-summary")
+    assert summary["1"]["finished_requests"] >= 1
+
+
+@pytest.mark.parametrize("layer", ["mamba2", "mamba1", "hybrid"])
+def test_migration_artifact_wire_roundtrip_parity(layer):
+    """Package a prefill-complete slot on engine A, push the artifact
+    through the codec (bytes and treedef intact), restore on engine B,
+    and pin the resumed stream to solo ``generate()`` — per layer
+    family, in-process (the subprocess version is the fabric test
+    above)."""
+    cfg = hybrid_cfg() if layer == "hybrid" else tiny_cfg(layer)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = rand_prompt(2 * CHUNK + 5, seed=7)
+    key = jax.random.PRNGKey(11)
+    req = GenerationRequest(prompt_ids=prompt, max_new_tokens=6, key=key)
+
+    captured = {}
+
+    def hook(tracked, package):
+        captured["snap"] = package()
+        return True  # source frees the slot
+
+    src = ServingEngine(params, cfg, capacity=2, retain_results=False,
+                        migrate_hook=hook, tokens_per_tick=2)
+    src.submit(req)
+    while "snap" not in captured:
+        src.step()
+    assert src.pending == 0  # handed off, nothing left at the source
+
+    frame = wire.encode_msg("submit_migrated", {
+        "snapshot": wire.encode_tree(captured["snap"]),
+        "request": wire.encode_request(req),
+    })
+    mtype, payload = wire.decode_msg(frame[4:])
+    assert mtype == "submit_migrated"
+    snap = wire.decode_tree(payload["snapshot"])
+    req2 = wire.decode_request(payload["request"])
+
+    dst = ServingEngine(params, cfg, capacity=2, retain_results=True,
+                        tokens_per_tick=2)
+    rid = dst.submit_migrated(req2, snap, source_replica=0)
+    for _ in dst.serve():
+        pass
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None], key,
+                   max_new_tokens=6)
+    want = np.asarray(out)[0, len(prompt):].tolist()
+    assert dst.results[rid].new_tokens.tolist() == want
+
+
+# ------------------------------------------------------ worker wire edges
+
+
+def test_worker_replies_named_error_on_unknown_version():
+    """A version-skewed frame gets an ``error`` reply naming
+    UnknownWireVersionError and a closed session — never a hang — and
+    the worker survives to serve healthy peers (ISSUE 13 satellite)."""
+    import socket
+    import struct
+
+    from mamba_distributed_tpu.serving import EngineReplica
+    from mamba_distributed_tpu.serving.service.worker import WorkerServer
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    rep = EngineReplica(0, params, cfg, capacity=2, retain_results=False)
+    worker = WorkerServer(rep)
+    t = threading.Thread(target=worker.serve_forever, daemon=True)
+    t.start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", worker.port),
+                                        timeout=10)
+        sock.settimeout(10)
+        body = json.dumps({"v": 99, "type": "ping", "payload": {}}).encode()
+        sock.sendall(struct.pack(">I", len(body)) + body)
+        mtype, payload = wire.recv_msg(sock)
+        assert mtype == "error"
+        assert payload["error_type"] == "UnknownWireVersionError"
+        with pytest.raises(wire.WireClosedError):
+            wire.recv_msg(sock)  # session closed, not hung
+        sock.close()
+        sock2 = socket.create_connection(("127.0.0.1", worker.port),
+                                         timeout=10)
+        sock2.settimeout(10)
+        wire.send_msg(sock2, "ping", {})
+        assert wire.recv_msg(sock2)[0] == "pong"
+        sock2.close()
+    finally:
+        worker._shutdown = True
+        t.join(timeout=5)
+
+
+# ------------------------------------------------------- drain queue fix
+
+
+def test_router_drain_requeues_queued_to_survivors():
+    """The scheduler/queue shutdown fix: draining a replica whose queue
+    holds never-started requests re-places them on the survivors (and
+    every stream still matches solo generate()).  Previously only
+    in-flight work survived a drain initiated from outside serve()."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    router = RequestRouter(params, cfg, num_replicas=2, capacity=1,
+                           tokens_per_tick=2)
+    reqs = [GenerationRequest(prompt_ids=rand_prompt(5 + i, seed=30 + i),
+                              max_new_tokens=5,
+                              key=jax.random.PRNGKey(300 + i))
+            for i in range(5)]
+    ids = [router.submit(r) for r in reqs]
+    # capacity 1 => replica 0 is left holding queued-but-unplaced work
+    assert router.replicas[0].engine.scheduler.depth > 0
+    moved = router.drain(0, requeue_queued=True)
+    assert moved  # queued work moved to the survivor
+    assert router.replicas[0].engine.scheduler.depth == 0
+    assert router.replicas[0].state is ReplicaState.DRAINING
+    for _ in router.serve():
+        pass
+    for r, gid in zip(reqs, ids):
+        out = generate(params, cfg, jnp.asarray(r.prompt_ids)[None], r.key,
+                       max_new_tokens=r.max_new_tokens)
+        want = np.asarray(out)[0, len(r.prompt_ids):].tolist()
+        assert router.results[gid].new_tokens.tolist() == want
+
+
+def test_router_drain_without_survivors_keeps_queue_local():
+    """With nothing else accepting, drain withdraws NOTHING — the
+    retiring replica finishes its own queue (never a stranded
+    request)."""
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    router = RequestRouter(params, cfg, num_replicas=1, capacity=1,
+                           tokens_per_tick=2)
+    reqs = [GenerationRequest(prompt_ids=rand_prompt(5 + i, seed=40 + i),
+                              max_new_tokens=3,
+                              key=jax.random.PRNGKey(500 + i))
+            for i in range(3)]
+    ids = [router.submit(r) for r in reqs]
+    moved = router.drain(0, requeue_queued=True)
+    assert moved == []
+    assert router.replicas[0].engine.scheduler.depth > 0
+    for _ in router.serve():
+        pass
+    assert all(i in router.results for i in ids)
+
+
+def test_router_replicas_injection_rejects_construction_args():
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    router = RequestRouter(params, cfg, num_replicas=1, capacity=1)
+    rep = router.replicas[0]
+    with pytest.raises(ValueError, match="cannot be combined"):
+        RequestRouter(None, cfg, replicas=[rep], roles=["mixed"])
+    with pytest.raises(ValueError, match="num_replicas"):
+        RequestRouter(None, cfg, num_replicas=2, replicas=[rep])
+
+
+# ------------------------------------------------------ heartbeat monitor
+
+
+class _StubReplica:
+    def __init__(self, rid, fail_after=None):
+        self.replica_id = rid
+        self.role = "mixed"
+        self.state = ReplicaState.ACTIVE
+        self.wire_dead = False
+        self.pending = 0
+        self.fail_after = fail_after  # beats before the wire "dies"
+        self.pings = 0
+
+    def ping(self):
+        self.pings += 1
+        if self.fail_after is not None and self.pings > self.fail_after:
+            raise wire.WireError("connection refused")
+        return 1.5, {"pending": 0}
+
+    def mark_dead(self):
+        self.state = ReplicaState.DEAD
+
+
+class _StubRouter:
+    def __init__(self, replicas):
+        self.replicas = replicas
+        self.failed = []
+
+    def fail(self, rid):
+        self.replicas[rid].mark_dead()
+        self.failed.append(rid)
+        return [77]
+
+
+def test_heartbeat_monitor_beats_misses_and_failover():
+    reps = [_StubReplica(0), _StubReplica(1, fail_after=1)]
+    router = _StubRouter(reps)
+    now = [0.0]
+    records = []
+    mon = HeartbeatMonitor(router, interval_ms=100, miss_threshold=2,
+                           emit=records.append, clock=lambda: now[0])
+    mon.tick()  # both beat
+    assert [r["event"] for r in records] == ["beat", "beat"]
+    assert records[0]["heartbeat_ms"] == pytest.approx(1.5)
+    now[0] += 0.2
+    mon.tick()  # rep1 misses (1/2)
+    now[0] += 0.2
+    failed = mon.tick()  # rep1 misses (2/2) -> failover
+    assert failed == [1] and router.failed == [1]
+    events = [(r["event"], r["replica"]) for r in records]
+    assert ("missed", 1) in events and ("failover", 1) in events
+    fo = next(r for r in records if r["event"] == "failover")
+    assert fo["reason"] == "missed_beats" and fo["requeued"] == [77]
+    # the DEAD transition is observed as a lifecycle record next pass
+    now[0] += 0.2
+    mon.tick()
+    assert any(r["event"] == "lifecycle"
+               and r["transition"] == "active->dead" for r in records)
+    # dead replicas are never probed again, and failover fires once
+    pings = reps[1].pings
+    now[0] += 0.2
+    mon.tick()
+    assert reps[1].pings == pings
+    assert router.failed == [1]
+    # snapshot carries the health view /healthz serves
+    snap = mon.snapshot()
+    assert snap[0]["missed"] == 0 and snap[0]["heartbeat_ms"] is not None
+    assert snap[1]["state"] == "dead" and snap[1]["missed"] == 2
+
+
+def test_heartbeat_monitor_wire_death_escalates_immediately():
+    reps = [_StubReplica(0), _StubReplica(1)]
+    reps[1].wire_dead = True  # a submit/step already saw the socket die
+    router = _StubRouter(reps)
+    records = []
+    mon = HeartbeatMonitor(router, emit=records.append, clock=lambda: 0.0)
+    assert mon.tick() == [1]
+    assert router.failed == [1]
+    fo = next(r for r in records if r["event"] == "failover")
+    assert fo["reason"] == "wire_dead"
