@@ -331,6 +331,16 @@ def slot_pool_specs(pool, num_shards: int):
     engine sizes capacity and the page pool so both divide; the
     fallback keeps arbitrary pools valid).  Weights are NOT covered
     here — serving replicates them (``NamedSharding(mesh, P())``).
+
+    The COMPACTED-tick lane trees ride the same rules (the bucketed
+    slot-pool constraint): ``state_cache.gather_slots``/
+    ``scatter_slots`` pass their ``{"blocks", "logits", "meta"}``
+    trees through here with the lane bucket in place of the slot
+    axis — the engine keeps the bucket a multiple of the data-shard
+    count and maps each shard's live slots onto that shard's lanes,
+    so a compact lane tree tiles over ``data`` exactly like the full
+    pool it was gathered from (docs/SERVING.md "Occupancy-adaptive
+    ticks").
     """
     def leaf_spec(path, leaf):
         names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]

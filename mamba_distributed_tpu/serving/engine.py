@@ -577,6 +577,29 @@ class ServingEngine:
                 weight_dtype=cfg.serving_weight_dtype,
                 kv_dtype=cfg.kv_page_dtype,
             )
+        # --- occupancy-adaptive compacted ticks (docs/SERVING.md
+        # "Occupancy-adaptive ticks"): cfg.tick_compaction gathers the
+        # LIVE slots into a pow2 lane bucket per data shard, runs the
+        # existing tick/verify jit at bucket width, scatters back.
+        # Off (default) is the byte-stable status quo — no gather/
+        # scatter traces, no record stamps.
+        self.compaction = cfg.tick_compaction
+        if self.compaction:
+            # current per-shard lane bucket (pow2): grows immediately
+            # when live slots need it, shrinks only after
+            # cfg.compaction_hysteresis_ticks consecutive smaller-
+            # sufficient ticks so occupancy jitter around a pow2
+            # boundary can't thrash gather/tick/scatter recompiles
+            self._compact_bucket = 1
+            self._shrink_streak = 0
+            self.metrics.configure_compaction()
+        # recently finished streams' tokens (bounded), so a restarted
+        # front end can re-attach an SSE stream whose final events died
+        # with the old connection (stream_state; docs/SERVING.md
+        # "Deploying as a service" — SSE resume tokens).  In-flight
+        # streams replay from their trackers; this ring only covers the
+        # just-finished tail.
+        self._recent_finished: dict[int, tuple[list[int], str]] = {}
         self._pc_hits = 0  # per-window gauges -> serving_tick records
         self._pc_misses = 0
         self._pc_saved_tokens = 0
@@ -685,6 +708,41 @@ class ServingEngine:
         elif snapshot.get("t_submit") is not None:
             tracked.t_submit = snapshot["t_submit"]
         return tracked.request_id
+
+    # finished streams whose token lists stay replayable for SSE resume
+    # (stream_state) after eviction — a small host-side ring
+    RECENT_FINISHED_KEEP = 128
+
+    def stream_state(self, request_id: int,
+                     from_index: int = 0) -> dict | None:
+        """Replay view of one stream for a re-attaching consumer (the
+        SSE resume path, docs/SERVING.md "Deploying as a service"):
+        ``{"tokens": <emitted[from_index:]>, "done", "finish_reason",
+        "request"}`` for an in-flight (resident, queued or preempted)
+        request — whose tokens live on its tracker — or a recently
+        finished one (the bounded ``RECENT_FINISHED_KEEP`` ring;
+        ``request`` is None there).  None for an unknown id.  Pure
+        host-side bookkeeping: no device sync, no stream perturbation,
+        and the engine keeps generating whether or not anyone
+        re-attaches."""
+        for t in list(self._slots.values()) + list(self.scheduler):
+            if t.request_id == request_id:
+                return {
+                    "tokens": list(t.new_tokens[from_index:]),
+                    "done": False,
+                    "finish_reason": None,
+                    "request": t.request,
+                }
+        fin = self._recent_finished.get(request_id)
+        if fin is not None:
+            toks, reason = fin
+            return {
+                "tokens": list(toks[from_index:]),
+                "done": True,
+                "finish_reason": reason,
+                "request": None,
+            }
+        return None
 
     def withdraw_queued(self) -> list[int]:
         """Pull every queued-but-UNSTARTED request (status QUEUED, no
@@ -1619,8 +1677,178 @@ class ServingEngine:
         """Requests not yet finished (queued + in-flight)."""
         return self.scheduler.depth + len(self._slots)
 
-    def _spec_tick(self):
+    # --------------------------------------------------- compacted ticks
+
+    def _compaction_width(self, live_slots) -> int | None:
+        """Lane width of this tick's compacted launch, or None for the
+        plain full-width tick (compaction off, or the bucket would not
+        be narrower than capacity).  The bucket is a pow2 over the
+        BUSIEST data shard's live count — every shard gets the same
+        lane count so the compact tree tiles over the data axis exactly
+        like the full pool — grown immediately, shrunk only after
+        ``cfg.compaction_hysteresis_ticks`` consecutive ticks that
+        would have fit the smaller bucket."""
+        if not self.compaction:
+            return None
+        per = self.capacity // self.num_shards
+        by_shard = [0] * self.num_shards
+        for s in live_slots:
+            by_shard[self._slot_shard(s)] += 1
+        need = next_pow2_bucket(max(1, max(by_shard)), min_bucket=1)
+        b = self._compact_bucket
+        if need > b:
+            b = need
+            self._shrink_streak = 0
+        elif need < b:
+            self._shrink_streak += 1
+            if self._shrink_streak >= self.cfg.compaction_hysteresis_ticks:
+                b = need
+                self._shrink_streak = 0
+        else:
+            self._shrink_streak = 0
+        self._compact_bucket = b
+        if b >= per:
+            return None  # full width: the existing tick IS the launch
+        return b * self.num_shards
+
+    def _compact_maps(self, live_slots, width: int):
+        """Host-side lane maps for one compacted launch: ``idx`` (W,)
+        gathers lane j from slot idx[j] (pad lanes repeat their shard's
+        first slot — garbage lanes the scatter never reads), ``inv``/
+        ``touched`` (S,) scatter lane inv[s] back into live slot s, and
+        ``lanes`` maps slot -> lane for the host-side token plumbing.
+        Shard d's live slots land in lanes [d*b, d*b + n_d): the gather
+        is shard-local, so the mesh-sharded pool's tiling survives
+        compaction."""
+        b = width // self.num_shards
+        per = self.capacity // self.num_shards
+        idx = np.zeros((width,), np.int32)
+        inv = np.zeros((self.capacity,), np.int32)
+        touched = np.zeros((self.capacity,), bool)
+        lanes: dict[int, int] = {}
+        fill = [d * b for d in range(self.num_shards)]
+        for d in range(self.num_shards):
+            idx[d * b : (d + 1) * b] = d * per  # pad default, in-shard
+        for s in sorted(live_slots):
+            d = self._slot_shard(s)
+            lane = fill[d]
+            fill[d] += 1
+            idx[lane] = s
+            inv[s] = lane
+            touched[s] = True
+            lanes[s] = lane
+        return idx, inv, touched, lanes
+
+    def _compact_rows(self):
+        """The full pool's per-slot subtrees, as gather/scatter see
+        them (``attn_blocks`` — the shared page pool — has no slot axis
+        and rides the tick's own donation instead)."""
+        return {
+            "blocks": self.pool["state"]["blocks"],
+            "logits": self.pool["logits"],
+            "meta": self.pool["meta"],
+        }
+
+    def _compact_page_meta(self, idx, lanes, spare: bool):
+        """Compacted page table + lengths for a hybrid launch: the live
+        slots' rows in lane order, pad lanes pointing at the trash page
+        with length 0.  The page-count bucket is the pow2 of the
+        largest LIVE allocation (+1 spare trash column in spec mode,
+        exactly like the full-width tick), so attention reads scale
+        with what the compacted lanes actually hold."""
+        largest = max(
+            (len(self._slots[s].pages) for s in lanes
+             if self._slots[s].pages),
+            default=1,
+        )
+        bucket = min(
+            next_pow2_bucket(largest + (1 if spare else 0), min_bucket=1),
+            self._page_tbl.shape[1],
+        )
+        ctbl = self._page_tbl[idx, :bucket].copy()
+        clen = self._kv_len[idx].copy()
+        pad = np.ones((len(idx),), bool)
+        pad[list(lanes.values())] = False
+        ctbl[pad] = 0
+        clen[pad] = 0
+        return ctbl, clen
+
+    def _scatter_pool(self, new_cpool_state, compact_out, inv, touched):
+        """Reassemble ``self.pool`` from a compacted launch's output:
+        scatter the per-slot lanes back (donating the old full-width
+        rows) and carry the page pool forward from the launch's own
+        donation."""
+        res = state_cache.scatter_slots(
+            self._compact_rows(), compact_out,
+            jnp.asarray(inv), jnp.asarray(touched), mesh=self.mesh,
+        )
+        state = {"blocks": res["blocks"]}
+        if self.hybrid:
+            state["attn_blocks"] = new_cpool_state["attn_blocks"]
+        self.pool = {"state": state, "logits": res["logits"],
+                     "meta": res["meta"]}
+
+    def _compact_tick(self, live_slots, width: int):
+        """One COMPACTED decode tick: gather the live slots' rows into
+        ``width`` lanes, run the identical ``_tick`` jit at lane width
+        (one trace per pow2 bucket), scatter the advanced rows back,
+        and expand the token matrices to slot indexing for the shared
+        event plumbing.  Pad lanes repeat an in-shard slot's rows and
+        compute garbage — their hybrid KV writes land on the trash page
+        (their compacted table rows are zeroed) and nothing ever reads
+        them back.  Per-row math is the full tick's, so streams are
+        bit-identical to the uncompacted engine (tests/
+        test_tick_compaction.py)."""
+        idx, inv, touched, lanes = self._compact_maps(live_slots, width)
+        gathered = state_cache.gather_slots(
+            self._compact_rows(), jnp.asarray(idx), mesh=self.mesh,
+        )
+        cpool = {"state": {"blocks": gathered["blocks"]},
+                 "logits": gathered["logits"], "meta": gathered["meta"]}
+        tick_kv = ()
+        if self.hybrid:
+            # the shared page pool has no slot axis: it rides the
+            # tick's donation exactly as in the full-width launch
+            cpool["state"]["attn_blocks"] = \
+                self.pool["state"]["attn_blocks"]
+            ctbl, clen = self._compact_page_meta(idx, lanes, spare=False)
+            tick_kv = (jnp.asarray(ctbl), jnp.asarray(clen))
+        new_cpool, tokens, emitted, done = _tick(
+            self._params, cpool, *tick_kv, cfg=self.cfg,
+            k_max=self.max_top_k, steps=self.tokens_per_tick,
+            mesh=self.mesh,
+        )
+        self._scatter_pool(
+            new_cpool["state"],
+            {"blocks": new_cpool["state"]["blocks"],
+             "logits": new_cpool["logits"], "meta": new_cpool["meta"]},
+            inv, touched,
+        )
+        tokens = np.asarray(tokens)  # (steps, width) — the host sync
+        emitted = np.asarray(emitted)
+        done = np.asarray(done)
+        steps = tokens.shape[0]
+        cols = np.fromiter(lanes.keys(), np.int64, len(lanes))
+        ls = np.fromiter(lanes.values(), np.int64, len(lanes))
+        tokens_f = np.zeros((steps, self.capacity), tokens.dtype)
+        emitted_f = np.zeros((steps, self.capacity), bool)
+        done_f = np.zeros((steps, self.capacity), bool)
+        tokens_f[:, cols] = tokens[:, ls]
+        emitted_f[:, cols] = emitted[:, ls]
+        done_f[:, cols] = done[:, ls]
+        if self.hybrid:
+            # the device-side lengths advance, mirrored at full width
+            self._kv_len += emitted_f.sum(axis=0).astype(np.int32)
+        return tokens_f, emitted_f, done_f
+
+    def _spec_tick(self, width: int | None = None):
         """One speculative draft-verify tick (serving/spec_decode.py).
+
+        ``width`` (from ``_compaction_width``) compacts the launch to
+        the live lanes: the feed/verify/commit all run at lane width
+        and the committed lanes scatter back — the same per-row math at
+        a narrower batch, so the compacted spec stream is bit-identical
+        to the full-width one (and to plain greedy).
 
         Per live slot: compose the feed (its pending committed tokens +
         up to K drafter proposals, zero-filled to the static width W),
@@ -1644,8 +1872,17 @@ class ServingEngine:
         S = self.capacity
         live = {s: t for s, t in self._slots.items()
                 if t.status is RequestStatus.DECODE}
-        ids = np.zeros((S, W), np.int32)
-        tmask = np.zeros((S, W), np.float32)
+        compacted = width is not None
+        if compacted:
+            idx, inv, touched, lanes = self._compact_maps(
+                list(live), width
+            )
+            n_lanes = width
+        else:
+            lanes = {s: s for s in live}
+            n_lanes = S
+        ids = np.zeros((n_lanes, W), np.int32)
+        tmask = np.zeros((n_lanes, W), np.float32)
         trusted: dict[int, int] = {}
         for slot, tr in live.items():
             rid = tr.request_id
@@ -1677,38 +1914,58 @@ class ServingEngine:
             drafts = (list(self.drafter.draft(rid, n))[:n] if n > 0
                       else [])
             self._spec_drafted += n
-            ids[slot] = spec_decode.build_feed(tr.spec_pending, drafts, W)
-            tmask[slot] = 1.0
+            ids[lanes[slot]] = spec_decode.build_feed(
+                tr.spec_pending, drafts, W
+            )
+            tmask[lanes[slot]] = 1.0
             trusted[slot] = len(tr.spec_pending)
-        state_in = dict(self.pool["state"])
-        if self.hybrid:
-            # +1 past the largest allocation so a fully-reserved slot's
-            # overshoot writes clamp onto a zero (trash) table entry —
-            # the table rows carry a permanent spare column for exactly
-            # this (see __init__)
-            largest = max(
-                (len(t.pages) for t in self._slots.values() if t.pages),
-                default=1,
+        if compacted:
+            gathered = state_cache.gather_slots(
+                self._compact_rows(), jnp.asarray(idx), mesh=self.mesh,
             )
-            bucket = min(next_pow2_bucket(largest + 1, min_bucket=1),
-                         self._page_tbl.shape[1])
-            state_in["attn_meta"] = (
-                jnp.asarray(self._page_tbl[:, :bucket]),
-                jnp.asarray(self._kv_len),
-            )
+            state_in = {"blocks": gathered["blocks"]}
+            logits_in, meta_in = gathered["logits"], gathered["meta"]
+            if self.hybrid:
+                state_in["attn_blocks"] = \
+                    self.pool["state"]["attn_blocks"]
+                ctbl, clen = self._compact_page_meta(idx, lanes,
+                                                     spare=True)
+                state_in["attn_meta"] = (jnp.asarray(ctbl),
+                                         jnp.asarray(clen))
+        else:
+            state_in = dict(self.pool["state"])
+            logits_in, meta_in = self.pool["logits"], self.pool["meta"]
+            if self.hybrid:
+                # +1 past the largest allocation so a fully-reserved
+                # slot's overshoot writes clamp onto a zero (trash)
+                # table entry — the table rows carry a permanent spare
+                # column for exactly this (see __init__)
+                largest = max(
+                    (len(t.pages) for t in self._slots.values()
+                     if t.pages),
+                    default=1,
+                )
+                bucket = min(next_pow2_bucket(largest + 1, min_bucket=1),
+                             self._page_tbl.shape[1])
+                state_in["attn_meta"] = (
+                    jnp.asarray(self._page_tbl[:, :bucket]),
+                    jnp.asarray(self._kv_len),
+                )
         greedy_d, final_logits, new_state, old = spec_decode.spec_verify(
             self._params, state_in, jnp.asarray(ids), jnp.asarray(tmask),
             cfg=self.cfg, mesh=self._tp_mesh,
         )
-        greedy = np.asarray(greedy_d)  # (S, W) — the host sync point
+        greedy = np.asarray(greedy_d)  # (lanes, W) — the host sync point
         tokens = np.zeros((W + 1, S), np.int32)
         emitted = np.zeros((W + 1, S), bool)
         done = np.zeros((W + 1, S), bool)
-        advance = np.zeros((S,), bool)
+        advance = np.zeros((n_lanes,), bool)
         for slot, tr in live.items():
             nt = trusted[slot]
-            fed = ids[slot].tolist()
-            a, adv, nxt = spec_decode.verify_greedy(fed, greedy[slot], nt)
+            fed = ids[lanes[slot]].tolist()
+            a, adv, nxt = spec_decode.verify_greedy(
+                fed, greedy[lanes[slot]], nt
+            )
             self._spec_accepted += a
             pending = tr.spec_pending
             stream = (pending[tr.spec_pending_emitted:]
@@ -1733,27 +1990,41 @@ class ServingEngine:
             if finished:
                 done[len(emitted_now) - 1, slot] = True
             elif adv:
-                advance[slot] = True
+                advance[lanes[slot]] = True
                 tr.spec_pending = [nxt]
                 tr.spec_pending_emitted = 1
             else:
                 tr.spec_pending = pending + fed[nt:nt + a] + [nxt]
                 tr.spec_pending_emitted = len(tr.spec_pending)
-        # next step's chunk budget pays for this tick's verify lanes
-        self._spec_budget_debt = len(live) * W
+        # next step's chunk budget pays for this tick's verify lanes —
+        # the lanes actually COMPUTED: the compacted bucket width when
+        # compaction narrowed the launch, the live count otherwise
+        self._spec_budget_debt = (width if compacted else len(live)) * W
         self._spec_streams += len(live)
         new_state = {k: v for k, v in new_state.items()
                      if k != "attn_meta"}
-        self.pool = spec_decode.spec_commit(
-            new_state, old["blocks"], self.pool["logits"],
-            self.pool["meta"], final_logits, jnp.asarray(advance),
-            jnp.int32(W),
+        committed = spec_decode.spec_commit(
+            new_state, old["blocks"], logits_in, meta_in, final_logits,
+            jnp.asarray(advance), jnp.int32(W),
         )
+        if compacted:
+            self._scatter_pool(
+                committed["state"],
+                {"blocks": committed["state"]["blocks"],
+                 "logits": committed["logits"],
+                 "meta": committed["meta"]},
+                inv, touched,
+            )
+        else:
+            self.pool = committed
         if self.hybrid:
             # lengths advance by the full chunk width on accepted rows
             # only; rejected rows' freshly written cells stay dead-by-
             # lengths and the next verify overwrites them
-            self._kv_len += (W * advance).astype(np.int32)
+            adv_full = np.zeros((S,), bool)
+            for slot, lane in lanes.items():
+                adv_full[slot] = advance[lane]
+            self._kv_len += (W * adv_full).astype(np.int32)
         return tokens, emitted, done
 
     def step(self) -> list[TokenEvent]:
@@ -1781,6 +2052,14 @@ class ServingEngine:
             # granting chunk budget until a slot turns decodable
             return []
         occupied = len(self._slots)
+        live_slots = [s for s, t in self._slots.items()
+                      if t.status is RequestStatus.DECODE]
+        # occupancy-adaptive compaction: the lane width this tick's
+        # launch actually computes (None => the full-width status quo).
+        # Mid-prefill residents compact OUT of the launch entirely —
+        # their parked carries are simply never gathered — so the tick
+        # is priced by decodable slots, not residency.
+        width = self._compaction_width(live_slots)
         # live trace-id set: the requests this tick actually advances
         # (mid-prefill residents are masked out of sampling) — stamped
         # on the span AND the jsonl record so host-side attribution can
@@ -1798,7 +2077,11 @@ class ServingEngine:
                 # (serving/spec_decode.py); _spec_tick owns the hybrid
                 # lengths mirror (it advances by the chunk width only
                 # on full accepts)
-                tokens, emitted, done = self._spec_tick()
+                tokens, emitted, done = self._spec_tick(width)
+            elif width is not None:
+                tokens, emitted, done = self._compact_tick(
+                    live_slots, width
+                )
             else:
                 tick_kv = ()
                 if self.hybrid:
@@ -1883,6 +2166,16 @@ class ServingEngine:
             tracked = self._slots.pop(slot)
             self.pool = state_cache.evict(self.pool, slot)
             self._release_pages(slot, tracked)
+            # bounded finished-stream ring: lets stream_state() replay
+            # a just-finished stream's tail to a re-attaching consumer
+            # (SSE resume tokens) after the tracker is gone
+            self._recent_finished[tracked.request_id] = (
+                list(tracked.new_tokens), tracked.finish_reason
+            )
+            while len(self._recent_finished) > self.RECENT_FINISHED_KEEP:
+                self._recent_finished.pop(
+                    next(iter(self._recent_finished))
+                )
             self._free.append(slot)
             if self.spec:
                 self.drafter.forget(tracked.request_id)
@@ -1989,8 +2282,15 @@ class ServingEngine:
             prefill_real_tokens=self._pending_chunk_real_tokens,
             prefill_oneshot_tokens=self._pending_oneshot_real_tokens,
             prefill_oneshot_lanes=self._pending_oneshot_lanes,
-            slot_lanes=self.capacity * (self.spec_width if self.spec
-                                        else self.tokens_per_tick),
+            # goodput honesty: lanes are billed at the width the launch
+            # actually computed — the compacted bucket when compaction
+            # narrowed it, static capacity otherwise
+            slot_lanes=(self.capacity if width is None else width)
+            * (self.spec_width if self.spec else self.tokens_per_tick),
+            compaction_width=(
+                (self.capacity if width is None else width)
+                if self.compaction else None
+            ),
             traces=live_traces,
             model_shards=(self.model_shards if self.model_shards > 1
                           else None),

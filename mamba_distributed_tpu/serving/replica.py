@@ -165,3 +165,15 @@ class EngineReplica:
     def step(self):
         """One engine iteration (no-op once dead)."""
         return self.engine.step() if self.alive else []
+
+    def replay(self, local_id: int, from_index: int = 0) -> dict | None:
+        """Replay view of one stream for the SSE resume path (the
+        router's ``attach_resumed``): the engine's ``stream_state`` —
+        tokens already generated from ``from_index`` on, done flag, and
+        (for in-flight streams) the original request so a later
+        failover can still re-derive the stream.  None when the id is
+        unknown here (or the replica is dead — nothing to re-attach
+        to)."""
+        if not self.alive:
+            return None
+        return self.engine.stream_state(local_id, from_index)

@@ -74,7 +74,10 @@ class _Routed:
     much of its stream the consumer has already seen (the failover
     replay cursor)."""
 
-    request: GenerationRequest
+    # None only for a stream re-attached AFTER it finished on the
+    # worker (attach_resumed replays its tail and never registers it
+    # for stepping, so nothing downstream reads the request)
+    request: GenerationRequest | None
     global_id: int
     # fabric-wide trace id (obs/context.py), minted ONCE at first
     # placement and kept HERE (request.trace_id is only stamped for
@@ -301,6 +304,115 @@ class RequestRouter:
             routed.request.trace_id = prev_trace
         routed.replica_id, routed.local_id = rep.replica_id, local_id
         self._by_local[(rep.replica_id, local_id)] = routed
+
+    # --------------------------------------------------- SSE resume attach
+
+    def stream_location(self, global_id: int) -> tuple[int, int] | None:
+        """Where one in-flight stream lives right now: (replica_id,
+        engine-local request id), or None once finished/unknown.  The
+        front end stamps this — as an opaque ``wire.encode_resume_token``
+        cursor — on every SSE event, so a client holding the last
+        cursor can re-attach through a RESTARTED front end
+        (``attach_resumed``).  Failover re-placement updates the
+        location, and the cursor refreshes with the next event."""
+        routed = self._routed.get(global_id)
+        if routed is None or routed.done:
+            return None
+        return routed.replica_id, routed.local_id
+
+    def attach_resumed(self, replica_id: int, local_id: int,
+                       from_index: int = 0, boot_id: str | None = None):
+        """Re-attach to a stream a PREVIOUS front end placed (the SSE
+        resume path, docs/SERVING.md "Deploying as a service"): the
+        worker kept the request and its emitted tokens across the
+        controller gap — nothing steps while no controller is connected
+        — so this router adopts the stream under a fresh global id,
+        replays ``[from_index:]`` from the replica's ``replay`` view,
+        and (for still-running streams) registers the routing entry so
+        subsequent ``step()`` events flow like any other request's.
+
+        Returns ``(global_id, replayed TokenEvents)``.  Raises KeyError
+        when the replica doesn't know the stream (evicted past the
+        worker's finished ring, or a bogus cursor) — or when the cursor
+        names a replica this fabric doesn't have (a redeploy shrank the
+        fleet; negative ids must not wrap around to the tail replica) —
+        and ValueError when the stream is already attached here (one
+        consumer per stream)."""
+        if not 0 <= replica_id < len(self.replicas):
+            raise KeyError(
+                f"no replica {replica_id} in this fabric "
+                f"({len(self.replicas)} replicas) — the cursor predates "
+                f"a redeploy; resubmit the request (same seed => same "
+                f"tokens)"
+            )
+        rep = self.replicas[replica_id]
+        rep_boot = getattr(rep, "boot_id", None)
+        if boot_id is not None and rep_boot is not None \
+                and boot_id != rep_boot:
+            # the worker PROCESS restarted since the cursor was minted:
+            # its engine-local request ids restarted at 0, so the same
+            # local id may now name a DIFFERENT request — replaying it
+            # would leak another stream's tokens.  410, never a guess.
+            raise KeyError(
+                f"replica {replica_id} restarted since this cursor was "
+                f"minted (boot {boot_id} != {rep_boot}); resubmit the "
+                f"request (same seed => same tokens)"
+            )
+        if (replica_id, local_id) in self._by_local:
+            raise ValueError(
+                f"stream {local_id} on replica {replica_id} is already "
+                f"attached to this router"
+            )
+        # replay the FULL history and slice locally: the router needs
+        # the true token count to validate the cursor (an inflated
+        # index would park `emitted` ahead of reality and the step()
+        # dedup guard would then silently drop every real token) and
+        # to seed `routed.tokens` so a retain_results router's final
+        # GenerationResult holds the whole stream, not just the
+        # post-attach tail
+        info = rep.replay(local_id, 0)
+        if info is None:
+            raise KeyError(
+                f"replica {replica_id} has no replayable stream "
+                f"{local_id} — finished beyond its replay ring, failed "
+                f"over, or never placed; resubmit the request (same "
+                f"seed => same tokens)"
+            )
+        toks_all = info["tokens"]
+        if not info["done"] and from_index > len(toks_all):
+            raise KeyError(
+                f"resume index {from_index} is ahead of stream "
+                f"{local_id} on replica {replica_id} "
+                f"({len(toks_all)} tokens generated) — no honest cursor "
+                f"points there; resubmit the request (same seed => "
+                f"same tokens)"
+            )
+        request = info.get("request")
+        routed = _Routed(
+            request=request, global_id=self._next_id,
+            trace_id=(getattr(request, "trace_id", None)
+                      or mint_trace_id()),
+        )
+        self._next_id += 1
+        toks = toks_all[from_index:]
+        if self.retain_results:
+            routed.tokens = [int(t) for t in toks_all]
+        events = []
+        for k, tok in enumerate(toks):
+            last = info["done"] and k == len(toks) - 1
+            events.append(TokenEvent(
+                routed.global_id, int(tok), from_index + k, last,
+                info["finish_reason"] if last else None,
+            ))
+        routed.emitted = from_index + len(toks)
+        routed.replica_id, routed.local_id = replica_id, local_id
+        if info["done"]:
+            routed.done = True
+            routed.finish_reason = info["finish_reason"]
+            return routed.global_id, events  # nothing more will come
+        self._routed[routed.global_id] = routed
+        self._by_local[(replica_id, local_id)] = routed
+        return routed.global_id, events
 
     # ------------------------------------------------ disaggregated handoff
 

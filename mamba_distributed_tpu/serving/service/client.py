@@ -34,7 +34,8 @@ def http_json(host: str, port: int, method: str, path: str,
 
 
 def stream_generate(host: str, port: int, spec: dict,
-                    timeout: float = 300.0, on_event=None) -> dict:
+                    timeout: float = 300.0, on_event=None,
+                    path: str = "/v1/generate") -> dict:
     """POST /v1/generate and consume the SSE stream to completion.
 
     Returns {"tokens": [...], "finish_reason": ..., "events": [...],
@@ -42,20 +43,22 @@ def stream_generate(host: str, port: int, spec: dict,
     token.  ``on_event`` (if given) sees each event as it arrives —
     the failover tests use it to know when a stream is mid-flight.
     Raises RuntimeError on an in-stream {"error": ...} event or a
-    non-200 status."""
+    non-200 status.  Each event carries a ``resume`` cursor while the
+    stream is live — feed the last one to ``stream_resume`` to
+    re-attach through a restarted front end."""
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         t0 = time.perf_counter()
-        conn.request("POST", "/v1/generate", body=json.dumps(spec),
+        conn.request("POST", path, body=json.dumps(spec),
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         if resp.status != 200:
             raise RuntimeError(
-                f"/v1/generate -> {resp.status}: "
+                f"{path} -> {resp.status}: "
                 f"{resp.read().decode('utf-8', 'replace')[:500]}"
             )
         tokens, events, stamps = [], [], []
-        finish_reason = None
+        finish_reason, done = None, False
         while True:
             line = resp.fp.readline()
             if not line:
@@ -69,12 +72,18 @@ def stream_generate(host: str, port: int, spec: dict,
             if on_event is not None:
                 on_event(ev)
             events.append(ev)
-            tokens.append(ev["token"])
-            stamps.append(time.perf_counter())
+            if "token" in ev:
+                # a resumed stream whose cursor already covered every
+                # token closes with a bare done marker — no token field
+                tokens.append(ev["token"])
+                stamps.append(time.perf_counter())
             if ev.get("done"):
-                finish_reason = ev.get("finish_reason")
+                # done is terminal even with finish_reason None — the
+                # /v1/resume fully-delivered-cursor close is a bare
+                # done marker carrying no reason (server "resumed_empty")
+                finish_reason, done = ev.get("finish_reason"), True
                 break
-        if finish_reason is None:
+        if not done:
             raise RuntimeError(
                 f"SSE stream ended without a done event after "
                 f"{len(tokens)} token(s)"
@@ -89,3 +98,15 @@ def stream_generate(host: str, port: int, spec: dict,
         }
     finally:
         conn.close()
+
+
+def stream_resume(host: str, port: int, resume_token: str,
+                  timeout: float = 300.0, on_event=None) -> dict:
+    """Re-attach an SSE stream from a resume cursor (the ``resume``
+    field of the last event a previous connection delivered) through a
+    possibly-RESTARTED front end: POST /v1/resume replays everything
+    the workers generated past the cursor and keeps streaming to
+    completion.  Same return shape as ``stream_generate``."""
+    return stream_generate(host, port, {"resume": resume_token},
+                           timeout=timeout, on_event=on_event,
+                           path="/v1/resume")

@@ -345,6 +345,43 @@ class RemoteReplica:
         self.state = ReplicaState.DEAD
         self._close()
 
+    @property
+    def boot_id(self) -> str | None:
+        """The worker PROCESS's per-boot nonce (from hello): stamped
+        into resume cursors so a cursor minted against a restarted
+        worker's previous generation 410s instead of replaying
+        whichever new request reused the same local id."""
+        return self.info.get("boot_id")
+
+    def replay(self, local_id: int, from_index: int = 0) -> dict | None:
+        """SSE-resume replay across the wire (``EngineReplica.replay``
+        shape): the worker's tokens-so-far for one stream, or None when
+        the id is unknown there.  A wire failure reads as unknown — the
+        front end then tells the client to resubmit rather than hang.
+        NON-fatal (like ping, unlike submit/step): this is a read-only
+        idempotent query a CLIENT triggers, so one transient socket
+        failure must not condemn a healthy replica to failover — the
+        socket just closes and the next RPC reconnects."""
+        if not self.alive:
+            return None
+        try:
+            payload = self._rpc("replay", {
+                "request_id": int(local_id),
+                "from_index": int(from_index),
+            }, expect="replay_result", fatal=False)
+        except wire.WireError:
+            return None
+        if not payload.get("found"):
+            return None
+        req = payload.get("request")
+        return {
+            "tokens": [int(t) for t in payload.get("tokens", [])],
+            "done": bool(payload.get("done")),
+            "finish_reason": payload.get("finish_reason"),
+            "request": (wire.decode_request(req)
+                        if req is not None else None),
+        }
+
     # ----------------------------------------------------------- telemetry
 
     def ping(self) -> tuple[float, dict]:

@@ -18,7 +18,15 @@ pin, which is why remote streams can be token-identical to solo
 
   POST /v1/generate      JSON body -> SSE stream, one ``data:`` event
                          per token ({request_id, token, index, done,
-                         finish_reason}), connection closes at done
+                         finish_reason, resume}), connection closes at
+                         done.  ``resume`` is an opaque cursor: POST it
+                         to /v1/resume to re-attach the stream through
+                         a RESTARTED front end (the workers keep the
+                         request and its tokens across the gap)
+  POST /v1/resume        {"resume": "<cursor>"} -> the same SSE stream,
+                         replayed from the cursor and continuing live;
+                         version-skewed cursors 400 with the named
+                         UnknownWireVersionError, unknown streams 410
   GET  /healthz          fabric + per-replica health (heartbeat ages,
                          missed beats, lifecycle states)
   POST /drain/<replica>  graceful retire; queued-but-unplaced work
@@ -52,6 +60,7 @@ import numpy as np
 
 from mamba_distributed_tpu.obs import jsonable
 from mamba_distributed_tpu.serving.scheduler import GenerationRequest
+from mamba_distributed_tpu.serving.service import wire
 
 # a sink item is either a token-event dict or an {"error": ...}
 # terminator; an SSE handler waiting longer than this for the next one
@@ -125,17 +134,65 @@ class FabricController(threading.Thread):
                     sink = self._sinks.get(ev.request_id)
                     if sink is None:
                         continue
-                    sink.put({
-                        "request_id": ev.request_id, "token": int(ev.token),
-                        "index": int(ev.index), "done": bool(ev.done),
-                        "finish_reason": ev.finish_reason,
-                    })
+                    sink.put(self._event_dict(ev))
                     if ev.done:
                         del self._sinks[ev.request_id]
             elif not worked:
                 time.sleep(self.poll_s)
         # controller exiting with streams open: terminate them cleanly
         self._error_out("fabric controller stopped")
+
+    def _event_dict(self, ev) -> dict:
+        """One TokenEvent as an SSE payload, stamped with the resume
+        cursor — (replica, local id, next index) as an opaque
+        ``wire.encode_resume_token`` — so a client holding the last
+        event can re-attach through a RESTARTED front end via
+        POST /v1/resume instead of resubmitting.  The location comes
+        from the router's live table (it tracks failover moves);
+        finished streams carry no cursor — there is nothing left to
+        resume."""
+        d = {
+            "request_id": ev.request_id, "token": int(ev.token),
+            "index": int(ev.index), "done": bool(ev.done),
+            "finish_reason": ev.finish_reason,
+        }
+        loc = self.router.stream_location(ev.request_id)
+        if loc is not None:
+            d["resume"] = wire.encode_resume_token(
+                loc[0], loc[1], int(ev.index) + 1,
+                boot_id=getattr(self.router.replicas[loc[0]],
+                                "boot_id", None),
+            )
+        return d
+
+    def attach_resumed(self, token: str) -> concurrent.futures.Future:
+        """Re-attach a stream from a resume cursor; Future of
+        (global_id, sink queue).  The sink is pre-loaded with the
+        replayed tokens (everything the worker generated past the
+        cursor) and — for a still-running stream — registered for the
+        live events that follow; a finished stream's sink ends with its
+        final event (or a bare done marker when the cursor already
+        covered every token)."""
+        rid, lid, index, boot = wire.decode_resume_token(token)
+
+        def _do():
+            gid, events = self.router.attach_resumed(
+                rid, lid, index, boot_id=boot
+            )
+            sink: queue.Queue = queue.Queue()
+            for ev in events:
+                sink.put(self._event_dict(ev))
+            still_running = self.router.stream_location(gid) is not None
+            if still_running:
+                self._sinks[gid] = sink
+            elif not events:
+                # finished AND fully delivered: close the stream with a
+                # token-less done marker so the SSE handler terminates
+                sink.put({"request_id": gid, "done": True,
+                          "finish_reason": None, "resumed_empty": True})
+            return gid, sink
+
+        return self.call(_do)
 
     def _drain_commands(self) -> bool:
         worked = False
@@ -290,6 +347,8 @@ class FabricHTTPServer:
         ctrl = self.controller
         if method == "POST" and path == "/v1/generate":
             await self._generate(body, writer)
+        elif method == "POST" and path == "/v1/resume":
+            await self._resume(body, writer)
         elif method == "GET" and path == "/healthz":
             snap = await asyncio.wrap_future(ctrl.call(self._health_payload))
             writer.write(_json_response("200 OK", snap))
@@ -383,6 +442,59 @@ class FabricHTTPServer:
             b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
         )
         await writer.drain()
+        await self._stream_sse(writer, gid, sink)
+
+    async def _resume(self, body: bytes,
+                      writer: asyncio.StreamWriter) -> None:
+        """POST /v1/resume {"resume": "<cursor>"} — re-attach an SSE
+        stream through a restarted front end (docs/SERVING.md "SSE
+        resume tokens").  The worker kept the request and every emitted
+        token across the controller gap; the new fabric adopts the
+        stream, replays everything past the cursor, and keeps
+        streaming.  A version-skewed cursor 400s with the NAMED
+        ``UnknownWireVersionError``; an unknown stream 410s (resubmit —
+        same seed, same tokens)."""
+        try:
+            spec = json.loads(body.decode("utf-8"))
+            token = spec["resume"]
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            writer.write(_json_response(
+                "400 Bad Request", {"error": f"bad resume body: {e}"}))
+            return
+        try:
+            gid, sink = await asyncio.wrap_future(
+                self.controller.attach_resumed(token)
+            )
+        except wire.UnknownWireVersionError as e:
+            writer.write(_json_response(
+                "400 Bad Request",
+                {"error": str(e), "error_type": type(e).__name__}))
+            return
+        except wire.WireError as e:
+            writer.write(_json_response(
+                "400 Bad Request", {"error": f"bad resume token: {e}"}))
+            return
+        except KeyError as e:
+            writer.write(_json_response(
+                "410 Gone", {"error": str(e).strip("'\"")}))
+            return
+        except (ValueError, RuntimeError) as e:
+            writer.write(_json_response(
+                "409 Conflict" if isinstance(e, ValueError)
+                else "503 Service Unavailable", {"error": str(e)}))
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        await self._stream_sse(writer, gid, sink)
+
+    async def _stream_sse(self, writer: asyncio.StreamWriter, gid: int,
+                          sink) -> None:
+        """Drain one request's sink queue onto the wire as SSE events
+        (shared by /v1/generate and /v1/resume — one copy of the pump
+        protocol)."""
         # one dedicated pump thread per stream, bridging the blocking
         # sink queue into the loop: the shared default executor would
         # cap concurrent streams at its thread count (each blocked in
@@ -397,7 +509,15 @@ class FabricHTTPServer:
                 except queue.Empty:
                     ev = {"error": f"no token within {_EVENT_POLL_S}s",
                           "request_id": gid, "done": True}
-                loop.call_soon_threadsafe(aq.put_nowait, ev)
+                try:
+                    loop.call_soon_threadsafe(aq.put_nowait, ev)
+                except RuntimeError:
+                    # the loop closed under us: the front end is
+                    # shutting down with this stream open.  The
+                    # consumer is gone but the stream survives on its
+                    # worker — a resume cursor re-attaches it through
+                    # the next front end (POST /v1/resume)
+                    return
                 if ev.get("done") or "error" in ev:
                     return
 

@@ -40,8 +40,13 @@ import struct
 import numpy as np
 
 # bump ONLY on incompatible schema changes; additive payload fields are
-# compatible (decoders ignore unknown keys)
-WIRE_VERSION = 1
+# compatible (decoders ignore unknown keys).  v2: the worker RPC
+# surface grew the ``replay`` op and token events grew SSE resume
+# cursors (encode/decode_resume_token) — a v1 front end cannot drive
+# the re-attach protocol, so the version negotiation (and every resume
+# cursor, which embeds its schema version) fails the skew loudly
+# through UnknownWireVersionError instead of half-working.
+WIRE_VERSION = 2
 
 # one frame's hard ceiling (a hybrid migration artifact is page-count
 # sized — MBs, not GBs; anything bigger is a corrupt length prefix)
@@ -189,6 +194,63 @@ def decode_event(d: dict):
 
     return TokenEvent(d["request_id"], d["token"], d["index"], d["done"],
                       d.get("finish_reason"))
+
+
+# ------------------------------------------------------ SSE resume cursors
+
+
+def encode_resume_token(replica_id: int, request_id: int,
+                        index: int, boot_id: str | None = None) -> str:
+    """Opaque SSE resume cursor (docs/SERVING.md "Deploying as a
+    service"): enough for a RESTARTED front end to re-attach an
+    in-flight stream — which worker holds it (``replica_id``), the
+    worker-local request id, the next token index the client expects,
+    and the worker's per-boot nonce (``boot_id``, from its hello).
+    Carries the wire schema version so a cursor minted by a different
+    service generation fails decoding with the NAMED
+    ``UnknownWireVersionError`` instead of replaying garbage; the boot
+    nonce catches the subtler skew — a RESTARTED worker reuses local
+    request ids from 0, and without the nonce a stale cursor would
+    silently replay a DIFFERENT request's stream."""
+    body = json.dumps(
+        {"v": WIRE_VERSION, "replica": int(replica_id),
+         "request": int(request_id), "index": int(index),
+         **({"boot": str(boot_id)} if boot_id else {})},
+        separators=(",", ":"),
+    ).encode("utf-8")
+    return base64.urlsafe_b64encode(body).decode("ascii")
+
+
+def decode_resume_token(token: str) -> tuple[int, int, int, str | None]:
+    """Inverse of ``encode_resume_token`` -> (replica_id, request_id,
+    next_index, boot_id-or-None).  Raises ``UnknownWireVersionError``
+    on a version-skewed cursor and ``WireError`` on anything malformed
+    — never a silent misparse."""
+    try:
+        obj = json.loads(base64.urlsafe_b64decode(token.encode("ascii")))
+    except Exception as e:  # noqa: BLE001 — any decode failure is one error
+        raise WireError(f"malformed resume token: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireError(f"malformed resume token payload: {obj!r}")
+    v = obj.get("v")
+    if v != WIRE_VERSION:
+        raise UnknownWireVersionError(
+            f"resume token schema version {v!r} is not supported (this "
+            f"service speaks version {WIRE_VERSION}); resubmit the "
+            f"request instead (same seed => same tokens)"
+        )
+    try:
+        out = int(obj["replica"]), int(obj["request"]), int(obj["index"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed resume token fields: {e}") from e
+    if any(v < 0 for v in out):
+        # negative ids/indices must never reach Python indexing (a -1
+        # replica would silently wrap to the LAST replica's streams)
+        raise WireError(f"malformed resume token fields: negative {out}")
+    boot = obj.get("boot")
+    if boot is not None and not isinstance(boot, str):
+        raise WireError(f"malformed resume token boot id: {boot!r}")
+    return out + (boot,)
 
 
 # ------------------------------------------------------------------ framing

@@ -53,7 +53,7 @@ from mamba_distributed_tpu.serving.service import wire
 # message types the session dispatcher understands (anything else is a
 # named error back to the peer, never a hang)
 _HANDLED = ("hello", "submit", "submit_migrated", "step", "ping", "drain",
-            "summary", "shutdown")
+            "replay", "summary", "shutdown")
 
 
 # ------------------------------------------------------------- config I/O
@@ -111,6 +111,16 @@ class WorkerServer:
         self._lsock = socket.create_server((host, port))
         self._lsock.settimeout(poll_s)
         self.host, self.port = self._lsock.getsockname()[:2]
+        # per-PROCESS boot nonce, advertised in hello and embedded in
+        # every SSE resume cursor: engine-local request ids restart at
+        # 0 when the worker process restarts, so a cursor minted
+        # against a previous worker generation must 410 ("resubmit")
+        # at re-attach instead of silently replaying whichever NEW
+        # request landed on the same local id (a cross-stream token
+        # leak).  uuid4 — uniqueness per boot, not secrecy.
+        import uuid
+
+        self.boot_id = uuid.uuid4().hex[:16]
         eng = replica.engine
         if replica.role == "prefill" and eng.cfg.disagg_prompt_threshold > 0:
             eng.migrate_hook = self._offer_migration
@@ -250,6 +260,7 @@ class WorkerServer:
                 "role": rep.role,
                 "capacity": eng.capacity,
                 "hybrid": eng.hybrid,
+                "boot_id": self.boot_id,
                 "stats": self._stats(),
             })
         elif mtype == "submit":
@@ -298,6 +309,26 @@ class WorkerServer:
             wire.send_msg(conn, "drain_ack", {
                 "withdrawn": withdrawn, "stats": self._stats(),
             })
+        elif mtype == "replay":
+            # SSE resume (docs/SERVING.md "Deploying as a service"): a
+            # restarted front end re-attaches an in-flight stream.  The
+            # worker kept the request and its emitted tokens across the
+            # controller gap (nothing steps while no controller is
+            # connected, so nothing is ever lost in between).
+            info = rep.replay(int(payload.get("request_id", -1)),
+                              int(payload.get("from_index", 0)))
+            if info is None:
+                wire.send_msg(conn, "replay_result", {"found": False})
+            else:
+                out = {
+                    "found": True,
+                    "tokens": [int(t) for t in info["tokens"]],
+                    "done": bool(info["done"]),
+                    "finish_reason": info["finish_reason"],
+                }
+                if info.get("request") is not None:
+                    out["request"] = wire.encode_request(info["request"])
+                wire.send_msg(conn, "replay_result", out)
         elif mtype == "summary":
             from mamba_distributed_tpu.obs import jsonable
 

@@ -468,6 +468,97 @@ def _write_blocks(pool_state, slot: jax.Array, state):
     return {**pool_state, "blocks": new_blocks}
 
 
+# ------------------------------------------------- compacted-tick lanes
+#
+# Occupancy-adaptive compacted ticks (serving/engine.py; docs/SERVING.md
+# "Occupancy-adaptive ticks"): the engine gathers the LIVE slots' rows
+# into a pow2 lane bucket, runs the existing jitted tick/verify step at
+# bucket width, and scatters the results back — compute per tick tracks
+# live slots, not static capacity.  These two jits are the whole device
+# side of that layer.  One trace per bucket width (the index arrays are
+# traced; only the width is a shape) — the engine's per-bucket trace
+# pins ride on these counters, mirroring the prompt-bucket discipline.
+TRACE_COUNTS = {"gather": 0, "scatter": 0}
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def gather_slots(rows: dict, idx: jax.Array, mesh=None):
+    """Gather slot rows ``idx`` (W,) of a ``{"blocks", "logits",
+    "meta"}`` tree (the per-slot subtrees of a pool — ``blocks`` leaves
+    (L, S, ...) take axis 1, ``logits``/``meta`` leaves axis 0) into a
+    compact (.., W, ..) tree.  NOT donated: the full pool lives on (the
+    compacted tick's scatter writes it back).  Pad lanes may repeat any
+    in-range slot index — their computed results are garbage the
+    scatter never reads.  ``mesh`` (static; a serving_mesh, else None)
+    pins the compact lanes to the data-axis layout via the SAME
+    ``slot_pool_specs`` rules the full pool uses (the engine keeps the
+    bucket a multiple of the shard count and gathers shard-locally, so
+    the tiling carries over)."""
+    TRACE_COUNTS["gather"] += 1
+    out = {
+        "blocks": jax.tree.map(
+            lambda a: jnp.take(a, idx, axis=1), rows["blocks"]
+        ),
+        "logits": jnp.take(rows["logits"], idx, axis=0),
+        "meta": jax.tree.map(
+            lambda a: jnp.take(a, idx, axis=0), rows["meta"]
+        ),
+    }
+    if mesh is not None:
+        from mamba_distributed_tpu.parallel.sharding import (
+            slot_pool_shardings,
+        )
+
+        out = jax.lax.with_sharding_constraint(
+            out, slot_pool_shardings(out, mesh)
+        )
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",), donate_argnums=(0,))
+def scatter_slots(rows: dict, compact: dict, inv: jax.Array,
+                  touched: jax.Array, mesh=None):
+    """Write a compacted tick's output lanes back into the full-width
+    rows: slot s takes compact lane ``inv[s]`` where ``touched[s]``,
+    else keeps its old row (mid-prefill carries, empty slots — and pad
+    lanes, which no slot maps to — are never written).  Implemented as
+    a per-slot gather + select rather than a scatter, so duplicate pad
+    indices can never race a live row.  ``rows`` (the full pool's
+    per-slot subtrees) is donated — the output aliases it; the compact
+    buffers are the tick's spent output and simply expire."""
+    TRACE_COUNTS["scatter"] += 1
+    t_slot = lambda ndim, ax: touched.reshape(
+        (1,) * ax + (-1,) + (1,) * (ndim - ax - 1)
+    )
+    out = {
+        "blocks": jax.tree.map(
+            lambda f, c: jnp.where(
+                t_slot(f.ndim, 1), jnp.take(c, inv, axis=1), f
+            ),
+            rows["blocks"], compact["blocks"],
+        ),
+        "logits": jnp.where(
+            t_slot(rows["logits"].ndim, 0),
+            jnp.take(compact["logits"], inv, axis=0), rows["logits"],
+        ),
+        "meta": jax.tree.map(
+            lambda f, c: jnp.where(
+                t_slot(f.ndim, 0), jnp.take(c, inv, axis=0), f
+            ),
+            rows["meta"], compact["meta"],
+        ),
+    }
+    if mesh is not None:
+        from mamba_distributed_tpu.parallel.sharding import (
+            slot_pool_shardings,
+        )
+
+        out = jax.lax.with_sharding_constraint(
+            out, slot_pool_shardings(out, mesh)
+        )
+    return out
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def evict(pool: dict, slot: jax.Array) -> dict:
     """Free ``slot``: mark it empty.  The stale state/logits stay in

@@ -221,6 +221,18 @@ class ServingMetrics:
         self.spec_accepted = 0
         self.spec_stream_ticks = 0  # Σ live streams over verify ticks
         self.spec_accept_rate = StreamingHistogram(lo=1e-2, hi=200.0)
+        # occupancy-adaptive compacted ticks (serving/engine.py;
+        # docs/SERVING.md "Occupancy-adaptive ticks"): the engine calls
+        # configure_compaction() when cfg.tick_compaction is on,
+        # unlocking summary()["compaction"] — per-width tick histogram,
+        # distinct compiled bucket widths ("recompiles": each width is
+        # one gather/tick/scatter trace trio), and the token lanes the
+        # narrower launches saved vs static capacity.  Off by default
+        # so compaction-off records/summaries stay byte-stable.
+        self._compaction_on = False
+        self.compaction_ticks = 0  # ticks that ran NARROWER than capacity
+        self.compaction_hist: dict[int, int] = {}  # lane width -> ticks
+        self.compaction_lanes_saved = 0
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
         # disaggregated prefill/decode handoffs (docs/SERVING.md
@@ -312,6 +324,14 @@ class ServingMetrics:
     def record_preemption(self) -> None:
         """One priority swap-out (serving/engine._preempt)."""
         self.preemptions += 1
+
+    # ------------------------------------------------- compacted ticks
+
+    def configure_compaction(self) -> None:
+        """Mark occupancy-adaptive tick compaction live (engine
+        construction): ``summary()`` gains its ``compaction`` block and
+        tick records their ``compaction_width`` stamp."""
+        self._compaction_on = True
 
     # ------------------------------------------------ speculative decoding
 
@@ -406,6 +426,7 @@ class ServingMetrics:
         spec_drafted: int | None = None,
         spec_accepted: int | None = None,
         spec_streams: int | None = None,
+        compaction_width: int | None = None,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -548,6 +569,24 @@ class ServingMetrics:
             record["spec_drafted"] = spec_drafted
             record["spec_accepted"] = spec_accepted
             record["spec_streams"] = spec_streams
+        if compaction_width is not None:
+            # occupancy-adaptive compaction stamp (only when the engine
+            # has compaction on — records stay byte-stable otherwise):
+            # the lane width this tick's launch computed.  slot_lanes
+            # above is already billed at that width, so the goodput
+            # fields price the compacted launch, not static capacity;
+            # lanes_saved is the delta a full-width launch would have
+            # burned on the same tick.
+            record["compaction_width"] = compaction_width
+            self.compaction_hist[compaction_width] = (
+                self.compaction_hist.get(compaction_width, 0) + 1
+            )
+            if compaction_width < self.capacity:
+                self.compaction_ticks += 1
+                self.compaction_lanes_saved += (
+                    slot_lanes * self.capacity // compaction_width
+                    - slot_lanes
+                )
         if self.jsonl_path:
             self._write_jsonl(record)
 
@@ -628,6 +667,19 @@ class ServingMetrics:
                         and self._fpt_decode is not None) else None
                 ),
             },
+            "compaction": (None if not self._compaction_on else {
+                "ticks_compacted": self.compaction_ticks,
+                # one gather/tick/scatter trace trio per distinct
+                # NARROW width ever used (full-width launches reuse
+                # the pre-existing tick trace)
+                "recompiles": sum(1 for w in self.compaction_hist
+                                  if w < self.capacity),
+                "bucket_histogram": {
+                    str(w): n
+                    for w, n in sorted(self.compaction_hist.items())
+                },
+                "lanes_saved": self.compaction_lanes_saved,
+            }),
             "speculation": (None if not self._spec_on else {
                 "spec_tokens": self.spec_tokens_cfg,
                 "drafter": self.spec_drafter,

@@ -254,6 +254,24 @@ def build_report(events: list[dict]) -> dict:
                     sp_tokens / (streams or len(spticks)), 2
                 ),
             }
+        # occupancy-adaptive compaction gauges (absent unless a
+        # compaction-enabled engine wrote the stream): how many ticks
+        # ran narrower than capacity and at what lane widths
+        # (docs/SERVING.md "Occupancy-adaptive ticks")
+        cticks = [e for e in ticks
+                  if e.get("compaction_width") is not None]
+        compaction = None
+        if cticks:
+            widths = [e["compaction_width"] for e in cticks]
+            narrowed = [e for e in cticks
+                        if e.get("capacity")
+                        and e["compaction_width"] < e["capacity"]]
+            compaction = {
+                "ticks": len(cticks),
+                "ticks_compacted": len(narrowed),
+                "mean_width": round(sum(widths) / len(widths), 2),
+                "min_width": min(widths),
+            }
         # quantized-serving gauges (absent unless an int8 engine wrote
         # the stream): the dtype stamp + resident-bytes from the last
         # stamped tick (docs/SERVING.md "Quantized serving")
@@ -286,6 +304,7 @@ def build_report(events: list[dict]) -> dict:
             ),
             "goodput": goodput,
             "prefix_cache": prefix,
+            "compaction": compaction,
             "speculation": speculation,
             "preemptions": preemptions,
             "migrations": {"handoffs": handoffs} if handoffs else None,
@@ -611,6 +630,13 @@ def format_report(report: dict) -> str:
                 f"saved prefill tokens: {pc['saved_prefill_tokens']}   "
                 f"entries: {_fmt(pc['entries'])}   "
                 f"bytes: {_fmt(pc['bytes'])}"
+            )
+        if s.get("compaction"):
+            c = s["compaction"]
+            head += (
+                f"\ncompaction: {c['ticks_compacted']}/{c['ticks']} "
+                f"ticks compacted   mean lane width: {c['mean_width']}"
+                f"   min: {c['min_width']}"
             )
         if s.get("speculation"):
             sp = s["speculation"]

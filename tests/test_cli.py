@@ -289,6 +289,71 @@ def test_bench_serving_disagg_smoke(tmp_path):
 
 
 @pytest.mark.serving
+@pytest.mark.compaction
+def test_bench_serving_compaction_smoke(tmp_path):
+    """CI smoke for the occupancy-adaptive compaction bench (ISSUE 14
+    satellite): ``--occupancy ... --compaction`` must time compacted
+    and full-width engines at every fill level (streams asserted
+    identical inside the bench), make the low-fill speedup the
+    headline, leave a tick stream whose compaction line obs_report.py
+    renders, and gate against the committed compaction_occupancy_cpu
+    row."""
+    import json
+
+    json_out = str(tmp_path / "comp.json")
+    jsonl = str(tmp_path / "comp.jsonl")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_CAPACITY="4",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="6",
+               SERVE_MAX_NEW="4", SERVE_TOKENS_PER_TICK="2")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--occupancy", "0.25,1.0", "--compaction",
+         "--json", json_out, "--jsonl", jsonl],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(open(json_out).read().strip())
+    assert rec["metric"].startswith(
+        "serving_compaction_low_occupancy_speedup")
+    assert rec["low_occupancy_target"] == 0.25
+    assert set(rec["compaction_speedup_by_fill"]) == {"0.25", "1.0"}
+    for point in rec["occupancy_sweep"]:
+        assert point["tokens_per_sec_compacted"] > 0
+        assert point["compaction"]["bucket_histogram"]
+    # the 25%-fill point actually narrowed its launches (1 live slot
+    # of 4 -> lane bucket < capacity)
+    low = rec["occupancy_sweep"][0]
+    assert low["compaction"]["ticks_compacted"] > 0
+    assert low["compaction"]["lanes_saved"] > 0
+    # --compaction without --occupancy is a usage error, not a hang
+    p2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--compaction"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert p2.returncode == 2
+    assert "--occupancy" in p2.stderr
+    # the tick stream renders the report's compaction line
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "compaction:" in r.stdout
+    # gates against the committed row (huge band: the smoke's tiny
+    # workload is a different operating point than the committed run)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "compaction_occupancy_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "compaction_occupancy_cpu" in g.stdout
+
+
+@pytest.mark.serving
 def test_bench_gate_smoke(tmp_path, monkeypatch):
     """CI smoke for the bench regression gate (ISSUE 7 satellite): a
     fresh tiny ``bench_serving --json`` run passes against a baseline

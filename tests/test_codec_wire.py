@@ -162,6 +162,53 @@ def test_missing_version_is_named_error():
         wire.decode_msg(json.dumps({"type": "ping"}).encode())
 
 
+# --------------------------------------------------- SSE resume cursors
+
+
+def test_resume_token_roundtrip():
+    tok = wire.encode_resume_token(3, 17, 42)
+    assert isinstance(tok, str) and tok.isascii()
+    assert wire.decode_resume_token(tok) == (3, 17, 42, None)
+    # the worker's per-boot nonce rides the cursor (guards against a
+    # restarted worker reusing local request ids)
+    tok2 = wire.encode_resume_token(3, 17, 42, boot_id="abc123")
+    assert wire.decode_resume_token(tok2) == (3, 17, 42, "abc123")
+
+
+def test_resume_token_version_skew_is_named_error():
+    """A cursor minted by a different wire generation fails with the
+    NAMED UnknownWireVersionError (the versioned-schema contract: the
+    client resubmits — same seed, same tokens — instead of replaying
+    against a protocol it doesn't speak)."""
+    import base64
+
+    old = base64.urlsafe_b64encode(json.dumps(
+        {"v": wire.WIRE_VERSION - 1, "replica": 0, "request": 0,
+         "index": 0}).encode()).decode()
+    with pytest.raises(wire.UnknownWireVersionError, match="resume token"):
+        wire.decode_resume_token(old)
+
+
+def test_resume_token_garbage_is_wire_error():
+    for bad in ("not-base64!!", "", "aGVsbG8="):  # last: b64 of "hello"
+        with pytest.raises(wire.WireError):
+            wire.decode_resume_token(bad)
+    # well-formed json but missing fields
+    import base64
+
+    nofields = base64.urlsafe_b64encode(json.dumps(
+        {"v": wire.WIRE_VERSION}).encode()).decode()
+    with pytest.raises(wire.WireError):
+        wire.decode_resume_token(nofields)
+    # negative ids/indices are rejected at decode — a -1 replica would
+    # otherwise wrap around to the LAST replica's streams
+    neg = base64.urlsafe_b64encode(json.dumps(
+        {"v": wire.WIRE_VERSION, "replica": -1, "request": 0,
+         "index": 0}).encode()).decode()
+    with pytest.raises(wire.WireError, match="negative"):
+        wire.decode_resume_token(neg)
+
+
 # --------------------------------------------------------- codec edges
 
 

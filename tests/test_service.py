@@ -131,28 +131,56 @@ class Fabric:
             assert port is not None, f"worker {i} died before READY"
             ports.append(port)
             threading.Thread(target=proc.stdout.read, daemon=True).start()
-        self.replicas = [
-            RemoteReplica(i, ("127.0.0.1", p), role=roles[i],
-                          rpc_timeout_s=120.0)
-            for i, p in enumerate(ports)
-        ]
+        # stashed so restart_front_end() can rebuild a fresh service
+        # generation over the SAME workers (the SSE resume tests)
+        self._cfg, self._roles, self._ports = cfg, roles, ports
+        self._hb_ms, self._miss = heartbeat_ms, miss_threshold
         self.server_spans = str(tmp_path / "server.jsonl") if spans else None
-        tracer = SpanTracer(self.server_spans) if spans else None
         self.health_jsonl = str(tmp_path / "health.jsonl")
         open(self.health_jsonl, "w").close()
+        self._start_front_end(spans=spans)
+
+    def _start_front_end(self, spans=False):
+        """RemoteReplicas + router + controller + HTTP server over the
+        (already running) workers — the restartable half of the
+        service."""
+        self.replicas = [
+            RemoteReplica(i, ("127.0.0.1", p), role=self._roles[i],
+                          rpc_timeout_s=120.0)
+            for i, p in enumerate(self._ports)
+        ]
+        tracer = SpanTracer(self.server_spans) if spans else None
         self.router = RequestRouter(
-            None, cfg, replicas=self.replicas, retain_results=False,
+            None, self._cfg, replicas=self.replicas, retain_results=False,
             **({"tracer": tracer} if tracer else {}),
         )
         self.health = HeartbeatMonitor(
-            self.router, interval_ms=heartbeat_ms,
-            miss_threshold=miss_threshold,
+            self.router, interval_ms=self._hb_ms,
+            miss_threshold=self._miss,
             emit=lambda rec: append_jsonl(self.health_jsonl, rec),
         )
         self.controller = FabricController(self.router, health=self.health)
         self.controller.start()
         self.http = FabricHTTPServer(self.controller)
         self.port = self.http.start_background()
+
+    def stop_front_end(self):
+        """Tear down ONLY the front end — HTTP server, controller,
+        router and its worker sockets — leaving the worker processes
+        alive with all their state.  Nothing steps while no controller
+        is connected, so in-flight streams freeze rather than advance
+        unobserved (the restart half of the SSE resume contract)."""
+        self.http.stop()
+        self.controller.stop()
+        self.controller.join(timeout=10)
+        for rep in self.replicas:
+            rep._close()
+
+    def restart_front_end(self):
+        """A fresh service generation (new router/controller/HTTP port)
+        re-adopting the same workers, as after a front-end crash or
+        rolling restart."""
+        self._start_front_end()
 
     def stream(self, spec, **kw):
         return svc_client.stream_generate("127.0.0.1", self.port, spec, **kw)
@@ -597,3 +625,135 @@ def test_heartbeat_monitor_wire_death_escalates_immediately():
     assert router.failed == [1]
     fo = next(r for r in records if r["event"] == "failover")
     assert fo["reason"] == "wire_dead"
+
+
+# ------------------------------------------------------- SSE resume tokens
+
+
+def test_attach_resumed_full_result_and_ahead_cursor_in_process():
+    """Library-level resume semantics (no subprocesses): a
+    retain_results router adopting a mid-stream request must end with
+    the COMPLETE token list in its GenerationResult (not just the
+    post-attach tail), and a cursor pointing past what the stream has
+    actually generated is a KeyError — silently parking the dedup
+    cursor ahead would drop every later real token."""
+    from mamba_distributed_tpu.serving.replica import EngineReplica
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    prompt = rand_prompt(6, seed=40)
+    want = solo(params, cfg, prompt, 600, 12)
+
+    rep = EngineReplica(0, params, cfg, capacity=2, tokens_per_tick=2)
+    lid = rep.submit(GenerationRequest(
+        prompt_ids=prompt, max_new_tokens=12, seed=600))
+    while len(rep.engine.stream_state(lid)["tokens"]) < 4:
+        rep.step()  # a previous front end generated a few ticks
+    n_before = len(rep.engine.stream_state(lid)["tokens"])
+
+    router = RequestRouter(None, cfg, replicas=[rep],
+                           retain_results=True)
+    with pytest.raises(KeyError, match="ahead of stream"):
+        router.attach_resumed(0, lid, n_before + 100)
+    gid, events = router.attach_resumed(0, lid, 2)
+    assert [ev.token for ev in events] == want[2:n_before]
+    while router.pending:
+        router.step()
+    # the retained result holds the WHOLE stream incl. pre-attach work
+    assert router.results[gid].new_tokens.tolist() == want
+
+
+def test_sse_resume_through_restarted_front_end(fabric_factory):
+    """The SSE resume contract (docs/SERVING.md "Deploying as a
+    service"): every live event carries an opaque ``resume`` cursor; a
+    client that read N events through a front end that then DIED can
+    re-attach through a fresh front end with POST /v1/resume and read
+    the rest — total stream token-identical to solo generate(), no
+    loss, no dup.  A second restart resumes a by-then FINISHED stream
+    from the worker's replay ring.  Version-skewed cursors 400 with the
+    named UnknownWireVersionError, garbage cursors 400, unknown streams
+    410."""
+    import http.client
+
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    fab = fabric_factory(cfg, n=1)
+    prompt, seed, max_new = rand_prompt(9, seed=30), 500, 24
+    want = solo(params, cfg, prompt, seed, max_new)
+
+    # -- read 3 events by hand, then the front end dies mid-stream
+    conn = http.client.HTTPConnection("127.0.0.1", fab.port, timeout=120)
+    conn.request("POST", "/v1/generate", body=json.dumps(
+        _spec(prompt, seed, max_new)),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    head = []
+    while len(head) < 3:
+        line = resp.fp.readline().decode("utf-8").strip()
+        if line.startswith("data:"):
+            head.append(json.loads(line[len("data:"):].strip()))
+    assert all("resume" in ev for ev in head)  # live events carry cursors
+    assert [ev["token"] for ev in head] == want[:3]
+    cursor = head[-1]["resume"]
+    fab.stop_front_end()  # the "crash": streams freeze, workers keep state
+    conn.close()
+
+    # -- a fresh service generation re-attaches and finishes the stream
+    fab.restart_front_end()
+    res = svc_client.stream_resume("127.0.0.1", fab.port, cursor)
+    assert res["tokens"] == want[3:]  # replay + live tail, no loss/no dup
+    assert res["finish_reason"] in ("eos", "length")
+    idx = [ev["index"] for ev in res["events"] if "token" in ev]
+    assert idx == list(range(3, len(want)))  # contiguous from the cursor
+
+    # -- resuming a FINISHED stream replays its tail from the worker's
+    #    bounded ring (a third front-end generation this time: the
+    #    previous router still holds the attachment)
+    live = [ev for ev in res["events"] if ev.get("resume")]
+    late_cursor = live[-1]["resume"]
+    fab.stop_front_end()
+    fab.restart_front_end()
+    tail = svc_client.stream_resume("127.0.0.1", fab.port, late_cursor)
+    k = len(want) - len(tail["tokens"])
+    assert tail["tokens"] == want[k:] and len(tail["tokens"]) >= 1
+    assert tail["finish_reason"] == res["finish_reason"]
+
+    # -- a cursor whose index already covers the whole stream closes
+    #    with a bare done marker (no token events, no client error)
+    rid, lid, _, boot = wire.decode_resume_token(late_cursor)
+    assert boot  # live cursors carry the worker's boot nonce
+    covered = svc_client.stream_resume(
+        "127.0.0.1", fab.port,
+        wire.encode_resume_token(rid, lid, len(want), boot_id=boot))
+    assert covered["tokens"] == []
+
+    # -- error paths, all named and terminal (never a hang)
+    bad = fab.post("/v1/resume", {"resume": "not-a-cursor!!"})
+    assert bad["_status"] == 400
+    # a cursor from a bigger fleet (replica id past this fabric) is the
+    # documented 410, never a 500 or a wrapped-around replica
+    stale = fab.post("/v1/resume", {
+        "resume": wire.encode_resume_token(7, 0, 0)})
+    assert stale["_status"] == 410
+    assert "resubmit" in stale["error"]
+    # a cursor minted against a PREVIOUS worker boot (local ids restart
+    # at 0 there) is a 410, never a silent replay of whichever new
+    # request reused the id
+    other_boot = fab.post("/v1/resume", {
+        "resume": wire.encode_resume_token(rid, lid, 0,
+                                           boot_id="deadbeef00000000")})
+    assert other_boot["_status"] == 410
+    assert "restarted" in other_boot["error"]
+    import base64
+
+    skew = base64.urlsafe_b64encode(json.dumps(
+        {"v": wire.WIRE_VERSION + 1, "replica": 0, "request": 0,
+         "index": 0}).encode()).decode()
+    skewed = fab.post("/v1/resume", {"resume": skew})
+    assert skewed["_status"] == 400
+    assert skewed["error_type"] == "UnknownWireVersionError"
+    gone = fab.post("/v1/resume", {
+        "resume": wire.encode_resume_token(0, 10 ** 6, 0)})
+    assert gone["_status"] == 410
+    assert "resubmit" in gone["error"]
