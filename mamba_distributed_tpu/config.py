@@ -303,6 +303,40 @@ class ModelConfig:
     # bucket — occupancy jitter around a pow2 boundary must not thrash
     # gather/tick/scatter recompiles.  0 shrinks immediately.
     compaction_hysteresis_ticks: int = 4
+    # --- multi-tenant LoRA serving (serving/adapters.py; docs/
+    # SERVING.md "Multi-tenant LoRA") ---
+    # Named LoRA adapters one engine may serve concurrently.  0
+    # (default) disables multi-tenancy entirely — the byte-stable
+    # status quo: no factor pools ride the params, no record stamps,
+    # identical traces.  > 0 enables the segmented batched-LoRA path:
+    # an AdapterRegistry holds up to this many named adapters' low-rank
+    # {A, B} factors over the linear()-routed projections, a bounded
+    # device AdapterCache stacks them into (slots+1, ...) factor pools
+    # (row 0 = the zero "no adapter" factors), and every tick computes
+    # ``y = base(x) + (x @ A[ids]) @ B[ids]`` with per-slot adapter ids
+    # gathered from the slot pool's meta — slots running DIFFERENT
+    # adapters share ONE compiled launch.  Parity regime: a stream
+    # under adapter a matches solo ``generate()`` on the MERGED weights
+    # ``W + (alpha/rank)·A@B`` via ops/quant.assert_stream_close
+    # (toleranced — the segmented delta re-associates float sums, so
+    # bit-exactness is the wrong pin; greedy tokens agree exactly on
+    # the fp32 CPU matrix, tests/test_tenant_lora.py).
+    lora_max_adapters: int = 0
+    # Low-rank dimension r shared by every adapter on the engine (the
+    # factor pools are static-shape).
+    lora_rank: int = 8
+    # Default LoRA scaling numerator: the delta is weighted alpha/rank
+    # (per-adapter alpha may override at registration; the scale is
+    # folded into the stored B factors once, so the hot path never
+    # multiplies by it).
+    lora_alpha: float = 16.0
+    # Device factor-pool slots (adapters resident on-device at once).
+    # 0 => auto: lora_max_adapters (every registered adapter resident).
+    # Set lower to page adapters: admission reserves a slot like it
+    # reserves KV pages (waits when all slots are pinned by resident
+    # streams — never a mid-flight miss), refcounts pin a slot while
+    # any stream uses it, and zero-ref residents evict LRU.
+    lora_cache_slots: int = 0
     # Tensor-parallel shards of the serving WEIGHTS over `mesh.model`
     # (the 2-D serving mesh's second axis): Mamba d_inner channels,
     # attention heads and the embedding/head vocab axis split across
@@ -450,6 +484,26 @@ class ModelConfig:
                 f"spec_ngram_order must be >= 1, got "
                 f"{self.spec_ngram_order}"
             )
+        if self.lora_max_adapters < 0:
+            raise ValueError(
+                f"lora_max_adapters must be >= 0 (0 disables multi-"
+                f"tenant LoRA serving), got {self.lora_max_adapters}"
+            )
+        if self.lora_max_adapters > 0:
+            if self.lora_rank < 1:
+                raise ValueError(
+                    f"lora_rank must be >= 1 when LoRA serving is on, "
+                    f"got {self.lora_rank}"
+                )
+            if self.lora_alpha <= 0:
+                raise ValueError(
+                    f"lora_alpha must be > 0, got {self.lora_alpha}"
+                )
+            if self.lora_cache_slots < 0:
+                raise ValueError(
+                    f"lora_cache_slots must be >= 0 (0 => auto: "
+                    f"lora_max_adapters), got {self.lora_cache_slots}"
+                )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"attn_impl must be 'auto', 'xla' or 'pallas', got "
@@ -506,6 +560,15 @@ class ModelConfig:
         if self.ssm_layer == "mamba2" and c % self.chunk_size:
             return ((c + self.chunk_size - 1) // self.chunk_size) * self.chunk_size
         return c
+
+    @property
+    def effective_lora_cache_slots(self) -> int:
+        """Device adapter-cache slots actually allocated (0 = LoRA
+        off): ``lora_cache_slots``, or every registered adapter
+        resident when the knob is 0."""
+        if self.lora_max_adapters <= 0:
+            return 0
+        return self.lora_cache_slots or self.lora_max_adapters
 
     @property
     def kv_quantized(self) -> bool:

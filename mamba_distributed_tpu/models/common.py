@@ -51,9 +51,25 @@ def linear(params: dict, x: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
     for row-scaled ones (``(x * scale) @ q``), so no full-precision
     weight copy is ever materialized (int8 values are exact in bf16:
     the cast feeding the dot is lossless).
+
+    Multi-tenant LoRA serving (serving/adapters.py) attaches
+    ``params["lora"] = {"A": (N, d_in, r), "B": (N, r, d_out),
+    "ids": (b,)}`` — stacked per-adapter factor pools plus the
+    launch's per-ROW adapter ids — and the segmented batched-LoRA
+    delta lands on the fp32 accumulator:
+
+        y += (x @ A[ids]) @ B[ids]
+
+    Row 0 of the pools is the all-zero "no adapter" entry, so id-0
+    rows add an exact +0.0 and batches mixing adapters (or none)
+    share this ONE launch.  The ``alpha/rank`` scale is folded into
+    the stored B (serving/adapters.py), so no extra multiply rides
+    the hot path.  Trees without a bound ``lora`` entry — training,
+    plain serving, ``generate()`` — take the exact pre-LoRA path.
     """
     w = params["kernel"]
     scale = params.get("scale")
+    x0 = x  # pre-scale activations (the LoRA delta reads the originals)
     if scale is not None and scale.shape[-1] == 1:
         # per-input-row scales (row-parallel weights): fold into x —
         # exact (diag(scale) commutes through the contraction)
@@ -67,6 +83,20 @@ def linear(params: dict, x: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
     if scale is not None:
         # per-output-column scales: fold into the fp32 accumulator
         y = y * scale.astype(jnp.float32)
+    lora = params.get("lora")
+    if lora is not None and "ids" in lora:
+        a_sel = jnp.take(lora["A"], lora["ids"], axis=0)
+        b_sel = jnp.take(lora["B"], lora["ids"], axis=0)
+        xa = jnp.einsum(
+            "b...d,bdr->b...r",
+            x0.astype(compute_dtype), a_sel.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        y = y + jnp.einsum(
+            "b...r,bro->b...o",
+            xa.astype(compute_dtype), b_sel.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
     if "bias" in params:
         y = y + params["bias"].astype(jnp.float32)
     return y.astype(compute_dtype)

@@ -71,6 +71,17 @@ def _tp_axis(names: list[str], ndim: int, stacked: bool) -> int | None:
     return None
 
 
+def _tp_rule_end_axis(names: list[str]) -> int | None:
+    """The raw rule axis-from-end (-1 column-parallel, -2 row-parallel)
+    for a param path, before any ndim conversion — what the serving
+    LoRA factor rules key off (a factor's rank differs from its base
+    kernel's, so the absolute-axis form is useless there)."""
+    for pattern, ax in _TP_RULES:
+        if tuple(names[-len(pattern):]) == pattern:
+            return ax
+    return None
+
+
 def _spec_for(names: list[str], shape: tuple[int, ...], fsdp_size: int,
               tensor_size: int, stacked: bool, expert_size: int = 1) -> P:
     """Expert axis first (MoE stacks), then the tensor-parallel axis (by
@@ -212,6 +223,28 @@ def serving_param_specs(params, model_shards: int):
         names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
         shape = np.shape(leaf)
         spec: list = [None] * len(shape)
+        if model_shards > 1 and len(names) >= 2 and names[-2] == "lora":
+            # multi-tenant LoRA factor pools (serving/adapters.py):
+            # "A" (L, slots+1, d_in, r) shards d_in with a ROW-parallel
+            # base kernel's input axis (the x @ A contraction then runs
+            # on the shard that holds that x slice; GSPMD all-reduces
+            # the rank-r partials with the base matmul's), "B"
+            # (L, slots+1, r, d_out) shards d_out with a COLUMN-
+            # parallel kernel's output axis (the delta lands sharded
+            # exactly like y).  The other factor of each pair — and
+            # the bound "ids" rows — replicate (rank-r tensors are
+            # tiny).  This is what makes LoRA and tensor parallelism
+            # compose with zero cross-shard rescales.
+            base_ax = _tp_rule_end_axis(names[:-2] + ["kernel"])
+            ax = None
+            if names[-1] == "A" and base_ax == -2:
+                ax = len(shape) - 2  # d_in
+            elif names[-1] == "B" and base_ax == -1:
+                ax = len(shape) - 1  # d_out
+            if ax is not None and shape[ax] % model_shards == 0:
+                spec[ax] = "model"
+                return P(*spec)
+            return P()
         if model_shards > 1 and shape:
             lookup = names
             if names and names[-1] == "scale":

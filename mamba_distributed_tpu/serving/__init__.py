@@ -26,6 +26,11 @@ docs/SERVING.md has the architecture; the short version:
                by prompt-prefix hash — near-zero TTFT for shared
                prompts; hybrid entries pin KV pages copy-on-write
                (docs/SERVING.md "Prefix caching & preemption")
+  adapters     multi-tenant LoRA serving: named adapter registry,
+               refcounted/LRU device factor cache, and the segmented
+               batched-LoRA pools one tick launch consumes — slots
+               running different adapters share one compiled launch
+               (docs/SERVING.md "Multi-tenant LoRA")
   spec_decode  speculative decoding on the chunk machinery: K-token
                draft-verify ticks (one lm_verify_chunk launch commits
                up to K+2 greedy tokens per full weight read) with
@@ -39,6 +44,12 @@ docs/SERVING.md has the architecture; the short version:
                scripts/serve_worker.py + scripts/serve_fabric.py)
 """
 
+from mamba_distributed_tpu.serving.adapters import (
+    AdapterCache,
+    AdapterCacheError,
+    AdapterRegistry,
+    UnknownAdapterError,
+)
 from mamba_distributed_tpu.serving.engine import ServingEngine
 from mamba_distributed_tpu.serving.prefix_cache import (
     PrefixCache,
@@ -76,6 +87,10 @@ from mamba_distributed_tpu.serving.state_cache import (
 )
 
 __all__ = [
+    "AdapterCache",
+    "AdapterCacheError",
+    "AdapterRegistry",
+    "UnknownAdapterError",
     "ChunkPlan",
     "Drafter",
     "EngineReplica",

@@ -72,6 +72,7 @@ from mamba_distributed_tpu.models.lm import (
     lm_prefill,
     lm_step,
 )
+from mamba_distributed_tpu.serving import adapters as adapters_mod
 from mamba_distributed_tpu.serving import prefix_cache as prefix_cache_mod
 from mamba_distributed_tpu.serving import spec_decode
 from mamba_distributed_tpu.serving import state_cache
@@ -101,13 +102,16 @@ TRACE_COUNTS = {"prefill": 0, "tick": 0}
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
 def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig,
-             mesh=None):
+             mesh=None, adapter_ids=None):
     """Bucketed batch-1 prompt prefill -> (last_logits (1, V), state).
 
     ``mesh`` (static; only passed when the serving mesh has a model
     axis > 1) re-asserts the tensor-parallel weight layout so this
     prefill partitions exactly like ``generate(mesh=)``'s — an input to
-    the engine==generate() parity argument at ``model > 1``."""
+    the engine==generate() parity argument at ``model > 1``.
+    ``adapter_ids`` (LoRA engines only; (1,) int32) binds the request's
+    factor-pool row so the prefill computes the same segmented delta
+    the ticks will (serving/adapters.py)."""
     TRACE_COUNTS["prefill"] += 1
     if mesh is not None:
         from mamba_distributed_tpu.parallel.sharding import (
@@ -115,6 +119,8 @@ def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig,
         )
 
         params = constrain_serving_params(params, mesh)
+    if adapter_ids is not None:
+        params = adapters_mod.bind_adapter_ids(params, adapter_ids)
     return lm_prefill(params, cfg, ids, token_mask=mask)
 
 
@@ -182,6 +188,14 @@ def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
             lengths = jax.lax.with_sharding_constraint(
                 lengths, slot_axis_sharding(mesh)
             )
+    # multi-tenant LoRA (serving/adapters.py): bind each slot's factor-
+    # pool row from the pool meta into the attached pools — a no-op
+    # tree walk on LoRA-less params (no "lora" subtrees), and the ids
+    # are constant across the tick's sub-steps (admission happens
+    # between ticks), so one bind serves the whole scan.
+    params = adapters_mod.bind_adapter_ids(
+        params, pool["meta"]["adapter_id"]
+    )
 
     def one(carry, _):
         pool, lengths = carry
@@ -329,6 +343,24 @@ class ServingEngine:
         and ``state_cache.restore`` — the resumed stream is bit-exact
         (the preempt/resume contract, tests/test_disagg.py).
 
+      adapters: a ``serving/adapters.AdapterRegistry`` of named LoRA
+        adapters (read only when ``cfg.lora_max_adapters > 0``; None
+        builds an empty registry from the engine's own params —
+        register before submitting).  The engine keeps its own
+        bounded device ``AdapterCache`` of factor slots over the
+        registry: admission ``acquire``s the request's adapter slot
+        like it reserves KV pages (waits when every slot is pinned —
+        no mid-flight miss), refcounts pin it while the stream is
+        resident, and the per-slot ids ride the pool meta so slots
+        running DIFFERENT adapters share one compiled launch
+        (docs/SERVING.md "Multi-tenant LoRA").  Share one registry
+        across a router's replicas so a migration target re-pins the
+        factors from its own cache.  Streams under adapter ``a``
+        match solo ``generate()`` on ``adapters.merge(params, a)``
+        via ``ops/quant.assert_stream_close`` (the segmented delta
+        re-associates float sums; tests/test_tenant_lora.py).
+        Int8 weights + LoRA is a ROADMAP residual — rejected here.
+
       drafter: a ``serving/spec_decode.Drafter`` for speculative
         decoding (only read when ``cfg.spec_tokens > 0``).  None builds
         the config's drafter (``spec_drafter="ngram"``; ``"model"``
@@ -372,6 +404,7 @@ class ServingEngine:
         prefix_cache: PrefixCache | None = None,
         migrate_hook=None,
         drafter: spec_decode.Drafter | None = None,
+        adapters: adapters_mod.AdapterRegistry | None = None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -593,6 +626,49 @@ class ServingEngine:
             self._compact_bucket = 1
             self._shrink_streak = 0
             self.metrics.configure_compaction()
+        # --- multi-tenant LoRA serving (serving/adapters.py; docs/
+        # SERVING.md "Multi-tenant LoRA"): cfg.lora_max_adapters > 0
+        # attaches bounded device factor pools to the decode params and
+        # threads per-slot adapter ids through every launch.  Off
+        # (default) is the byte-stable status quo: no pools, no record
+        # stamps, identical traces.
+        self.lora = cfg.lora_max_adapters > 0
+        if self.lora:
+            if self.quantized_weights:
+                raise ValueError(
+                    "int8 base weights + a LoRA delta is a ROADMAP "
+                    "residual (the two dequant paths don't compose "
+                    "yet): serve LoRA adapters with "
+                    "serving_weight_dtype='bf16', or quantize without "
+                    "lora_max_adapters"
+                )
+            self.adapters = (adapters if adapters is not None
+                             else adapters_mod.AdapterRegistry(cfg, params))
+            if self.adapters.rank != cfg.lora_rank:
+                raise ValueError(
+                    f"adapter registry rank {self.adapters.rank} != "
+                    f"cfg.lora_rank {cfg.lora_rank} — the factor pools "
+                    f"are static-shape; one rank per engine"
+                )
+            self.adapter_cache = adapters_mod.AdapterCache(
+                self.adapters, cfg.effective_lora_cache_slots,
+                compute_dtype=cfg.compute_dtype,
+            )
+            self._base_decode_params = self._params
+            self._lora_version = -1
+            self._refresh_lora_params()
+            # window deltas for the tick-record gauges (the cache keeps
+            # cumulative counters)
+            self._ad_hits0 = 0
+            self._ad_misses0 = 0
+            self._ad_evictions0 = 0
+            self.metrics.configure_adapters(
+                cfg.lora_max_adapters, cfg.lora_rank,
+                cfg.effective_lora_cache_slots,
+            )
+        else:
+            self.adapters = None
+            self.adapter_cache = None
         # recently finished streams' tokens (bounded), so a restarted
         # front end can re-attach an SSE stream whose final events died
         # with the old connection (stream_state; docs/SERVING.md
@@ -648,6 +724,23 @@ class ServingEngine:
                 f"mode rejection sampling is a ROADMAP residual; serve "
                 f"sampled requests on a spec_tokens=0 engine"
             )
+        adapter = getattr(request, "adapter", None)
+        if adapter:
+            if not self.lora:
+                raise ValueError(
+                    f"request names adapter {adapter!r} but this engine "
+                    f"serves the base model only "
+                    f"(cfg.lora_max_adapters=0); enable multi-tenant "
+                    f"LoRA serving (docs/SERVING.md) or drop the "
+                    f"adapter field"
+                )
+            if adapter not in self.adapters:
+                # the NAMED error, at submit — never a hang, and the
+                # HTTP front end maps it to a 404 (serving/adapters.py)
+                raise adapters_mod.UnknownAdapterError(
+                    f"unknown adapter {adapter!r}: this engine's "
+                    f"registry holds {self.adapters.names()}"
+                )
         if self.hybrid:
             need = len(request.prompt_ids) + request.max_new_tokens
             if need > self.cfg.kv_slot_tokens:
@@ -770,6 +863,87 @@ class ServingEngine:
         )]
         tracked.spec_pending_emitted = 0
 
+    # ------------------------------------------------ multi-tenant LoRA
+
+    def _refresh_lora_params(self) -> None:
+        """Re-attach the adapter cache's factor pools to the decode
+        params after a pool write (upload/evict — ``AdapterCache.
+        version``).  Pure host-side tree surgery plus, on a mesh, a
+        device_put that is a no-op for every already-placed base leaf;
+        the compiled launches see the pools as ordinary param leaves,
+        so one trace serves every resident-adapter mix."""
+        if self.adapter_cache.version == self._lora_version:
+            return
+        p = adapters_mod.attach_adapter_pools(
+            self._base_decode_params, self.adapter_cache.pools
+        )
+        if self.mesh is not None:
+            from mamba_distributed_tpu.parallel.sharding import (
+                serving_param_shardings,
+            )
+
+            p = jax.device_put(p, serving_param_shardings(p, self.mesh))
+        self._params = p
+        self._lora_version = self.adapter_cache.version
+
+    def adapter_resident(self, name: str) -> bool:
+        """Is ``name``'s factor set on this engine's device cache right
+        now?  A pure probe — the router's adapter-affinity placement
+        term reads it (serving/replica.place_cost)."""
+        return (self.lora and self.adapter_cache.resident(name))
+
+    def _adapter_salt(self, request) -> bytes:
+        """Prefix-cache key salt for one request's adapter identity —
+        carry snapshots depend on the adapter delta that shaped them,
+        so a warm hit under adapter X must never seed adapter Y.
+        ``b""`` on LoRA-less engines and adapter-less requests: keys
+        byte-identical to pre-LoRA."""
+        if not self.lora:
+            return b""
+        return adapters_mod.prefix_salt(getattr(request, "adapter", None))
+
+    def _acquire_adapter_ref(self, tracked: _Tracked) -> bool:
+        """Reserve the request's adapter factor slot (the admission
+        analogue of the KV page reservation).  True = ready —
+        ``tracked.adapter_slot`` holds the pool row (0 = no adapter);
+        False = every cache slot is pinned by other resident streams:
+        the caller requeues and admission waits, exactly like a short
+        page pool — never a mid-flight miss."""
+        if not self.lora or not getattr(tracked.request, "adapter", None):
+            tracked.adapter_slot = 0
+            return True
+        if tracked.adapter_slot:  # preempted resume: the ref rode along
+            return True
+        slot = self.adapter_cache.acquire(tracked.request.adapter)
+        if slot is None:
+            return False
+        tracked.adapter_slot = slot
+        self._refresh_lora_params()  # a miss uploaded fresh pool rows
+        return True
+
+    def _lora_call_kw(self, tracked: _Tracked) -> dict:
+        """The ``adapter_ids=`` kwarg for a batch-1 prefill/chunk
+        launch — EMPTY on LoRA-less engines: even an explicit
+        ``adapter_ids=None`` would change the jit cache key vs a
+        caller that omits it (solo ``generate()``'s chunk driver),
+        splitting the one shared chunk trace the parity contract
+        leans on.  LoRA engines always pass the (1,) array, row 0
+        (the zero factors) for adapter-less requests, so one trace
+        serves every adapter mix."""
+        if not self.lora:
+            return {}
+        return {"adapter_ids": jnp.full((1,), tracked.adapter_slot or 0,
+                                        jnp.int32)}
+
+    def _release_adapter_ref(self, tracked: _Tracked) -> None:
+        """Drop the request's adapter-slot ref (finish, failure,
+        migrate-out, failed admission requeue).  Idempotent via the
+        ``adapter_slot`` sentinel — the cache itself raises the named
+        ``AdapterCacheError`` on a genuine double release."""
+        if self.lora and tracked.adapter_slot:
+            self.adapter_cache.release(tracked.request.adapter)
+        tracked.adapter_slot = None
+
     def _slot_shard(self, slot: int) -> int:
         """Which data shard holds ``slot``'s pool rows (NamedSharding
         partitions the slot axis contiguously)."""
@@ -819,6 +993,15 @@ class ServingEngine:
         if tracked.snapshot is not None:
             return self._resume(tracked)
         r = tracked.request
+        # multi-tenant LoRA: reserve the adapter's factor slot FIRST
+        # (the page-reservation discipline) — when every cache slot is
+        # pinned by other resident streams the request waits in the
+        # queue, and finishing streams release slots, so admission can
+        # never miss factors mid-flight
+        if not self._acquire_adapter_ref(tracked):
+            self.scheduler.requeue(tracked)
+            return False
+        salt = self._adapter_salt(r)
         plan = plan_chunks(len(r.prompt_ids),
                            self.cfg.effective_prefill_chunk_tokens,
                            force=self.hybrid)
@@ -827,7 +1010,7 @@ class ServingEngine:
         # this every step and must not drift the cache's counters
         hit = (None if self.prefix_cache is None
                else self.prefix_cache.lookup(r.prompt_ids, plan,
-                                             peek=True))
+                                             peek=True, salt=salt))
         n_pages = shared_n = fresh_n = 0
         cow = False
         if self.hybrid:
@@ -893,6 +1076,10 @@ class ServingEngine:
                 if slot is None and self._reclaim_cache_pages(n_pages):
                     slot = _fits()
                 if slot is None:
+                    # page-stalled: drop the adapter ref too, so a
+                    # withdrawn (drained-away) queued request can't
+                    # strand a factor slot; the retry re-acquires
+                    self._release_adapter_ref(tracked)
                     self.scheduler.requeue(tracked)
                     return False
             self._free.remove(slot)
@@ -940,6 +1127,7 @@ class ServingEngine:
                         r.resolve_key(), r.max_new_tokens, r.top_k,
                         r.temperature,
                         -1 if r.eos_id is None else r.eos_id,
+                        adapter_id=tracked.adapter_slot or 0,
                     )
                     self._seed_spec(tracked, entry.logits)
             elif entry is not None:
@@ -955,6 +1143,7 @@ class ServingEngine:
                     self.pool, slot, {"blocks": entry.state["blocks"]},
                     r.resolve_key(), r.max_new_tokens, r.top_k,
                     r.temperature, -1 if r.eos_id is None else r.eos_id,
+                    adapter_id=tracked.adapter_slot or 0,
                 )
             elif plan is None:
                 # one per-request span (trace-stamped) so even a short
@@ -974,11 +1163,13 @@ class ServingEngine:
                     logits, state = _prefill(
                         self._params, padded, mask, cfg=self.cfg,
                         mesh=self._tp_mesh,
+                        **self._lora_call_kw(tracked),
                     )
                     self.pool = state_cache.insert(
                         self.pool, slot, state, logits, r.resolve_key(),
                         r.max_new_tokens, r.top_k, r.temperature,
                         -1 if r.eos_id is None else r.eos_id,
+                        adapter_id=tracked.adapter_slot or 0,
                     )
                     self._seed_spec(tracked, logits)
                     if self.prefix_cache is not None:
@@ -986,7 +1177,7 @@ class ServingEngine:
                         # was NOT donated by insert — safe to retain):
                         # an exact prompt repeat skips _prefill outright
                         self.prefix_cache.maybe_store_full(
-                            r.prompt_ids, state, logits
+                            r.prompt_ids, state, logits, salt=salt
                         )
             else:
                 tracked.plan = plan
@@ -1005,6 +1196,7 @@ class ServingEngine:
                     {"blocks": init_lm_blocks_state(self.cfg, batch=1)},
                     r.resolve_key(), r.max_new_tokens, r.top_k,
                     r.temperature, -1 if r.eos_id is None else r.eos_id,
+                    adapter_id=tracked.adapter_slot or 0,
                 )
         except Exception:
             # a failed prefill must neither leak the slot (capacity would
@@ -1012,6 +1204,7 @@ class ServingEngine:
             # goes back to the queue head so a caller catching the raise
             # still sees it in `pending` and can retry or cancel
             self._release_pages(slot, tracked)
+            self._release_adapter_ref(tracked)
             self._free.insert(0, slot)
             self.scheduler.requeue(tracked)
             raise
@@ -1021,7 +1214,8 @@ class ServingEngine:
             # gauges.  AFTER the try block, so a failed (requeued +
             # retried) admission can't double-count, and a shard-
             # dropped hybrid hit commits as the miss it became.
-            self.prefix_cache.commit_lookup(r.prompt_ids, plan, hit)
+            self.prefix_cache.commit_lookup(r.prompt_ids, plan, hit,
+                                            salt=salt)
             kind = None if entry is None else (
                 "full" if full_hit else "partial")
             tracked.cache_hit = kind
@@ -1089,6 +1283,7 @@ class ServingEngine:
                 logits, state = prefill_chunk(
                     self._params, ids, mask, state, cfg=self.cfg,
                     mesh=self._tp_mesh,
+                    **self._lora_call_kw(tracked),
                 )
                 if self.hybrid:
                     # pages were written in place (donated): swap the
@@ -1114,12 +1309,14 @@ class ServingEngine:
             # pool via read_state, so nothing ever donates them away).
             # The LAST boundary is stored too: it seeds longer prompts
             # with the same left-pad that extend this one.
-            self._store_prefix(r.prompt_ids, plan, i, state, slot)
+            salt = self._adapter_salt(r)
+            self._store_prefix(r.prompt_ids, plan, i, state, slot,
+                               salt=salt)
             if tracked.chunks_done == plan.n_chunks:
                 # ...and the full-prompt entry (state + last logits):
                 # an exact repeat skips prefill entirely
                 self._store_prefix(r.prompt_ids, plan, i, state, slot,
-                                   logits=logits)
+                                   logits=logits, salt=salt)
                 self.pool = state_cache.finish_prefill(
                     self.pool, slot, state, logits
                 )
@@ -1140,6 +1337,7 @@ class ServingEngine:
                     self.pool, slot, state, r.resolve_key(),
                     r.max_new_tokens, r.top_k, r.temperature,
                     -1 if r.eos_id is None else r.eos_id,
+                    adapter_id=tracked.adapter_slot or 0,
                 )
                 # rotate to the back: the NEXT chunk grant (this step or
                 # the next) goes to the other in-flight prefills first —
@@ -1159,6 +1357,7 @@ class ServingEngine:
             # deleted-array errors on the next use.
             self.pool = state_cache.evict(self.pool, slot)
             self._release_pages(slot, tracked)
+            self._release_adapter_ref(tracked)
             self._prefill_queue.remove(slot)
             del self._slots[slot]
             self._free.insert(0, slot)
@@ -1181,7 +1380,7 @@ class ServingEngine:
             self._page_frees += len(entry.kv_pages)
 
     def _store_prefix(self, prompt_ids, plan, i: int, state: dict, slot,
-                      logits=None) -> None:
+                      logits=None, salt: bytes = b"") -> None:
         """Snapshot chunk ``i``'s carry into the prefix cache (with
         ``logits``: the full-prompt entry instead).  Hybrid snapshots
         pin the KV pages covering the prefix (incref — the cache is a
@@ -1191,10 +1390,10 @@ class ServingEngine:
         if pc is None:
             return
         if logits is not None:
-            key = prefix_cache_mod.full_key(prompt_ids, plan.chunk)
+            key = prefix_cache_mod.full_key(prompt_ids, plan.chunk, salt)
             tokens = plan.prompt_len
         else:
-            key = prefix_cache_mod.boundary_key(prompt_ids, plan, i)
+            key = prefix_cache_mod.boundary_key(prompt_ids, plan, i, salt)
             tokens = (i + 1) * plan.chunk - plan.pad
         if not pc.wants(key):
             return
@@ -1253,19 +1452,23 @@ class ServingEngine:
             if parked is None or not self._admit(parked):
                 return
 
-    def prefix_hit_fraction(self, prompt_ids) -> float:
+    def prefix_hit_fraction(self, prompt_ids, adapter=None) -> float:
         """Fraction of ``prompt_ids`` whose prefill this engine's prefix
         cache could skip right now (0.0 with the cache off) — a pure
         probe: no stats bumped, no LRU recency touched.  The router's
         placement cost subtracts it (cache affinity: a warm replica is
-        cheaper than an idle cold one for a shared-prefix prompt)."""
+        cheaper than an idle cold one for a shared-prefix prompt).
+        ``adapter`` keys the probe to the request's LoRA identity —
+        snapshots under another adapter are not hits for this one."""
         pc = self.prefix_cache
         if pc is None or len(prompt_ids) == 0:
             return 0.0
         plan = plan_chunks(len(prompt_ids),
                            self.cfg.effective_prefill_chunk_tokens,
                            force=self.hybrid)
-        hit = pc.lookup(np.asarray(prompt_ids, np.int32), plan, peek=True)
+        hit = pc.lookup(np.asarray(prompt_ids, np.int32), plan, peek=True,
+                        salt=(b"" if not self.lora
+                              else adapters_mod.prefix_salt(adapter)))
         if hit is None:
             return 0.0
         return min(1.0, hit[0].tokens / len(prompt_ids))
@@ -1367,6 +1570,13 @@ class ServingEngine:
         free yet."""
         snap = tracked.snapshot
         migrated = bool(snap.get("migrated"))
+        # the adapter factor slot first (a preempted request's ref rode
+        # its snapshot — instant; a MIGRATED one re-pins from THIS
+        # engine's cache, waiting like any admission when all slots
+        # are pinned)
+        if not self._acquire_adapter_ref(tracked):
+            self.scheduler.requeue(tracked)
+            return False
         n_pages = 0
         if self.hybrid:
             if migrated:
@@ -1423,6 +1633,7 @@ class ServingEngine:
                     jnp.asarray(snap["logits"]), r.resolve_key(),
                     snap["step"], r.max_new_tokens, r.top_k,
                     r.temperature, -1 if r.eos_id is None else r.eos_id,
+                    adapter_id=tracked.adapter_slot or 0,
                 )
                 if self.hybrid:
                     self._page_tbl[slot] = 0
@@ -1546,6 +1757,7 @@ class ServingEngine:
             ):
                 self.pool = state_cache.evict(self.pool, slot)
                 self._release_pages(slot, tracked)
+                self._release_adapter_ref(tracked)
                 del self._slots[slot]
                 self._free.append(slot)
                 self._free.sort()
@@ -1954,6 +2166,8 @@ class ServingEngine:
         greedy_d, final_logits, new_state, old = spec_decode.spec_verify(
             self._params, state_in, jnp.asarray(ids), jnp.asarray(tmask),
             cfg=self.cfg, mesh=self._tp_mesh,
+            **({"adapter_ids": meta_in["adapter_id"]} if self.lora
+               else {}),
         )
         greedy = np.asarray(greedy_d)  # (lanes, W) — the host sync point
         tokens = np.zeros((W + 1, S), np.int32)
@@ -2166,6 +2380,7 @@ class ServingEngine:
             tracked = self._slots.pop(slot)
             self.pool = state_cache.evict(self.pool, slot)
             self._release_pages(slot, tracked)
+            self._release_adapter_ref(tracked)
             # bounded finished-stream ring: lets stream_state() replay
             # a just-finished stream's tail to a re-attaching consumer
             # (SSE resume tokens) after the tracker is gone
@@ -2211,6 +2426,8 @@ class ServingEngine:
                     tracked.migration_source
             if tracked.priority != self.scheduler.default_priority:
                 request_record["priority"] = tracked.priority
+            if self.lora and getattr(r, "adapter", None):
+                request_record["adapter"] = r.adapter
             self.metrics.record_request(request_record)
             if self.slo is not None:
                 self.slo.observe_request(request_record,
@@ -2263,6 +2480,29 @@ class ServingEngine:
             self._spec_drafted = 0
             self._spec_accepted = 0
             self._spec_streams = 0
+        lora_gauges = {}
+        if self.lora:
+            # adapter-cache window counters + residency/live gauges
+            # ride every tick record when multi-tenant LoRA is on
+            # (absent otherwise — records stay byte-stable); the
+            # distinct-adapter gauge counts the factor rows this
+            # tick's launch actually mixed
+            ac = self.adapter_cache
+            lora_gauges = dict(
+                adapters_resident=ac.resident_count,
+                adapter_cache_hits=ac.hits - self._ad_hits0,
+                adapter_cache_misses=ac.misses - self._ad_misses0,
+                adapter_cache_evictions=ac.evictions
+                - self._ad_evictions0,
+                adapters_live=len({
+                    t.adapter_slot for t in self._slots.values()
+                    if t.status is RequestStatus.DECODE
+                    and t.adapter_slot
+                }),
+            )
+            self._ad_hits0 = ac.hits
+            self._ad_misses0 = ac.misses
+            self._ad_evictions0 = ac.evictions
         quant_gauges = {}
         if self.quantized_weights or self.quantized_kv:
             # int8 serving stamps its dtype pair + resident-bytes
@@ -2301,6 +2541,7 @@ class ServingEngine:
             **kv_gauges,
             **quant_gauges,
             **spec_gauges,
+            **lora_gauges,
         )
         self._preemptions = 0
         self._migrations_out = 0

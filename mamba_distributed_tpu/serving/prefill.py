@@ -148,7 +148,7 @@ def chunk_inputs(
                    donate_argnums=(3,))
 def prefill_chunk(
     params: dict, ids: jax.Array, mask: jax.Array, state, cfg: ModelConfig,
-    mesh=None,
+    mesh=None, adapter_ids: jax.Array | None = None,
 ):
     """The compiled chunk step: (ids, mask, carry) -> (last logits, carry').
 
@@ -175,6 +175,17 @@ def prefill_chunk(
         )
 
         params = constrain_serving_params(params, mesh)
+    if adapter_ids is not None:
+        # multi-tenant LoRA (serving/adapters.py): bind the batch rows'
+        # adapter ids into the attached factor pools so this chunk's
+        # projections add the request's segmented delta — the SAME
+        # per-row math the tick applies, which is what keeps a LoRA
+        # stream's prefill and decode on one adapter identity
+        from mamba_distributed_tpu.serving.adapters import (
+            bind_adapter_ids,
+        )
+
+        params = bind_adapter_ids(params, adapter_ids)
     return lm_prefill_chunk(params, cfg, ids, state, token_mask=mask)
 
 

@@ -62,28 +62,34 @@ def _tokens_digest(h, prompt_ids, n: int) -> None:
     ).tobytes())
 
 
-def boundary_key(prompt_ids, plan, i: int) -> str:
+def boundary_key(prompt_ids, plan, i: int, salt: bytes = b"") -> str:
     """Key of the carry after chunk ``i`` of ``plan``'s layout: the
     chunk width, the left-pad, and every real token consumed through
-    that chunk — exactly the inputs that determine the carry."""
+    that chunk — exactly the inputs that determine the carry.
+    ``salt`` (serving/adapters.prefix_salt) mixes a LoRA adapter
+    identity into the key: the carry DEPENDS on the adapter delta, so
+    a warm hit under adapter X must never seed adapter Y.  The empty
+    default leaves every digest byte-identical to the unsalted one."""
     real = (i + 1) * plan.chunk - plan.pad
     h = hashlib.sha1()
+    h.update(salt)
     h.update(b"chunk:%d:%d:" % (plan.chunk, plan.pad))
     _tokens_digest(h, prompt_ids, real)
     return h.hexdigest()
 
 
-def full_key(prompt_ids, chunk: int) -> str:
+def full_key(prompt_ids, chunk: int, salt: bytes = b"") -> str:
     """Key of a CHUNKED prompt's final (state, last-logits) pair.  The
     pad is a pure function of (len, chunk), so chunk + the full token
-    sequence pin the layout."""
+    sequence pin the layout (``salt``: see ``boundary_key``)."""
     h = hashlib.sha1()
+    h.update(salt)
     h.update(b"full:%d:" % chunk)
     _tokens_digest(h, prompt_ids, len(prompt_ids))
     return h.hexdigest()
 
 
-def layout_keys(prompt_ids, plan) -> tuple[list, str]:
+def layout_keys(prompt_ids, plan, salt: bytes = b"") -> tuple[list, str]:
     """Every boundary key of ``plan``'s layout plus the full key, in ONE
     O(prompt_len) pass: the boundary digests are prefix-snapshots of a
     single running hash (``hashlib`` copies), byte-identical to calling
@@ -92,6 +98,7 @@ def layout_keys(prompt_ids, plan) -> tuple[list, str]:
     (the router probes every replica's cache per submit)."""
     ids = np.ascontiguousarray(np.asarray(prompt_ids, np.int32).reshape(-1))
     h = hashlib.sha1()
+    h.update(salt)
     h.update(b"chunk:%d:%d:" % (plan.chunk, plan.pad))
     keys = []
     prev = 0
@@ -101,16 +108,19 @@ def layout_keys(prompt_ids, plan) -> tuple[list, str]:
         prev = real
         keys.append(h.copy().hexdigest())
     hf = hashlib.sha1()
+    hf.update(salt)
     hf.update(b"full:%d:" % plan.chunk)
     hf.update(ids.tobytes())
     return keys, hf.hexdigest()
 
 
-def oneshot_key(prompt_ids) -> str:
+def oneshot_key(prompt_ids, salt: bytes = b"") -> str:
     """Key of a ONE-SHOT (pow2-bucketed) prompt's final (state, logits)
     pair — the short pure-SSM admission path.  The bucket is a pure
-    function of the length, so the tokens alone pin the layout."""
+    function of the length, so the tokens alone pin the layout
+    (``salt``: see ``boundary_key``)."""
     h = hashlib.sha1()
+    h.update(salt)
     h.update(b"oneshot:")
     _tokens_digest(h, prompt_ids, len(prompt_ids))
     return h.hexdigest()
@@ -204,7 +214,8 @@ class PrefixCache:
             return True
         return self._seen.get(key, 0) >= self.min_hits
 
-    def commit_lookup(self, prompt_ids, plan, hit) -> None:
+    def commit_lookup(self, prompt_ids, plan, hit,
+                      salt: bytes = b"") -> None:
         """Record a lookup outcome once the admission actually went
         through.  The ENGINE probes with ``lookup(peek=True)`` and
         commits here only after securing a slot: a request stalled on
@@ -219,9 +230,9 @@ class PrefixCache:
             self.hits += 1
             self.saved_tokens += entry.tokens
             if plan is None:
-                self.get(oneshot_key(prompt_ids))  # deferred recency
+                self.get(oneshot_key(prompt_ids, salt))  # deferred recency
                 return
-            bkeys, fkey = layout_keys(prompt_ids, plan)
+            bkeys, fkey = layout_keys(prompt_ids, plan, salt)
             if chunks_done == plan.n_chunks:
                 self.get(fkey)
                 return
@@ -236,9 +247,9 @@ class PrefixCache:
             return
         self.misses += 1
         if plan is None:
-            self.note_miss(oneshot_key(prompt_ids))
+            self.note_miss(oneshot_key(prompt_ids, salt))
             return
-        bkeys, fkey = layout_keys(prompt_ids, plan)
+        bkeys, fkey = layout_keys(prompt_ids, plan, salt)
         for k in [fkey] + bkeys[:-1]:
             self.note_miss(k)
 
@@ -305,7 +316,8 @@ class PrefixCache:
 
     # ------------------------------------------------------------- lookups
 
-    def lookup(self, prompt_ids, plan, peek: bool = False):
+    def lookup(self, prompt_ids, plan, peek: bool = False,
+               salt: bytes = b""):
         """Deepest cached prefix for this prompt's exact layout.
 
         Returns ``(entry, chunks_done)`` — ``chunks_done ==
@@ -317,7 +329,7 @@ class PrefixCache:
         prompts).  Misses bump the promotion counters; ``peek`` probes
         without touching stats or recency (router affinity)."""
         if plan is None:
-            key = oneshot_key(prompt_ids)
+            key = oneshot_key(prompt_ids, salt)
             e = self.get(key, peek=peek)
             if e is not None:
                 if not peek:
@@ -328,7 +340,7 @@ class PrefixCache:
                 self.misses += 1
                 self.note_miss(key)
             return None
-        bkeys, fkey = layout_keys(prompt_ids, plan)
+        bkeys, fkey = layout_keys(prompt_ids, plan, salt)
         keys = [(fkey, plan.n_chunks)]
         keys += [(bkeys[i], i + 1)
                  for i in reversed(range(plan.n_chunks - 1))]
@@ -352,11 +364,11 @@ class PrefixCache:
     # ------------------------------------------- pure-SSM store conveniences
 
     def maybe_store_boundary(self, prompt_ids, plan, i: int,
-                             state: dict) -> None:
+                             state: dict, salt: bytes = b"") -> None:
         """Store chunk ``i``'s carry for a PURE-SSM layout (hybrid
         entries need page pinning — the engine builds those itself).
         ``state`` must be safe to retain: never later donated."""
-        key = boundary_key(prompt_ids, plan, i)
+        key = boundary_key(prompt_ids, plan, i, salt)
         if not self.wants(key):
             return
         self.put(key, PrefixEntry(
@@ -365,12 +377,13 @@ class PrefixCache:
         ))
 
     def maybe_store_full(self, prompt_ids, state: dict, logits, *,
-                         chunk: int = 0, chunks: int = 0) -> None:
+                         chunk: int = 0, chunks: int = 0,
+                         salt: bytes = b"") -> None:
         """Store a full (state, logits) snapshot for a pure-SSM prompt
         — ``chunk > 0`` keys the chunked layout, 0 the one-shot pow2
         bucket."""
-        key = (full_key(prompt_ids, chunk) if chunk
-               else oneshot_key(prompt_ids))
+        key = (full_key(prompt_ids, chunk, salt) if chunk
+               else oneshot_key(prompt_ids, salt))
         if not self.wants(key):
             return
         self.put(key, PrefixEntry(

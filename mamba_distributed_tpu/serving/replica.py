@@ -38,6 +38,14 @@ class ReplicaState(enum.Enum):
     DEAD = "dead"          # failed over; never stepped again
 
 
+# placement discount for a replica whose device adapter cache already
+# holds the request's LoRA factors (serving/adapters.py): resident
+# factors skip an upload AND keep the cache's slot churn down, the
+# same shape as the PR-9 prefix-cache affinity — worth about half a
+# replica's load range, so affinity steers ties and near-ties without
+# overriding a genuinely overloaded-vs-idle gap
+ADAPTER_AFFINITY = 0.5
+
 # the disaggregated prefill/decode tiers (docs/SERVING.md
 # "Disaggregated tiers"): "mixed" is the exact pre-disagg status quo;
 # "prefill" replicas take the long prompts, run the chunked prefill
@@ -140,13 +148,26 @@ class EngineReplica:
         skip (engine.prefix_hit_fraction, a pure probe) — skipping a
         preamble's prefill is worth more than an idle cold replica, so
         shared-prefix traffic converges on warm caches instead of
-        spraying cold prefills across the fabric."""
+        spraying cold prefills across the fabric — minus (4) adapter
+        AFFINITY (multi-tenant LoRA): ``ADAPTER_AFFINITY`` when the
+        request's adapter factors are resident on this replica's
+        device cache (engine.adapter_resident, a pure probe), so one
+        tenant's traffic converges on the replicas already serving its
+        factors instead of churning every cache in the fabric."""
         eng = self.engine
         load = (eng.scheduler.depth + len(eng._slots)) / eng.capacity
         if eng.hybrid:
             load += eng.page_pool.pages_in_use / eng.page_pool.num_pages
+        adapter = (getattr(request, "adapter", None)
+                   if request is not None else None)
         if request is not None and eng.prefix_cache is not None:
-            load -= eng.prefix_hit_fraction(request.prompt_ids)
+            load -= eng.prefix_hit_fraction(request.prompt_ids,
+                                            adapter=adapter)
+        if adapter and eng.adapter_resident(adapter):
+            # (4) adapter AFFINITY (multi-tenant LoRA): the request's
+            # factors are already on this replica's device cache — no
+            # upload, no slot churn; same shape as the prefix term
+            load -= ADAPTER_AFFINITY
         return load
 
     def submit(self, request, force: bool = False) -> int:

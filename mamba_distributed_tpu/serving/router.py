@@ -273,7 +273,16 @@ class RequestRouter:
     def _place(self, routed: _Routed) -> None:
         """Role-filtered least-loaded placement (one ``serving_route``
         span): lowest ``place_cost`` among the accepting replicas of
-        the request's tier, ties to the lowest id."""
+        the request's tier, ties to the lowest id.
+
+        A replica that rejects the request's LoRA ADAPTER (its
+        registry lacks the name — possible when only some workers
+        preloaded it and the front end has no factors to push) is
+        skipped and the next-cheapest candidate tried: one replica's
+        missing registration must neither 404 a servable request nor
+        abort a ``fail()`` replay mid-loop (the half-failed-over
+        state that method's contract forbids).  Only when EVERY
+        candidate rejects does the adapter error surface."""
         cands = [r for r in self.replicas if r.accepting]
         if not cands:
             raise RuntimeError(
@@ -281,29 +290,41 @@ class RequestRouter:
                 "not placed"
             )
         cands = self._role_filter(cands, routed.request)
-        cost, rep = min(((r.place_cost(routed.request), r) for r in cands),
+        ranked = sorted(((r.place_cost(routed.request), r) for r in cands),
                         key=lambda cr: (cr[0], cr[1].replica_id))
-        attrs = dict(request_id=routed.global_id, replica=rep.replica_id,
-                     trace=routed.trace_id, cost=round(cost, 4),
-                     queue_depth=rep.engine.scheduler.depth)
-        if rep.role != "mixed" and self.disagg_prompt_threshold > 0:
-            # disagg fabrics only: with threshold 0 roles are inert and
-            # spans stay byte-stable vs a role-less router
-            attrs["role"] = rep.role
-        if rep.engine.hybrid:
-            attrs["free_pages"] = rep.engine.page_pool.free_pages
-        # propagate the entry's trace id through the request object only
-        # for the duration of the submit (the scheduler copies it onto
-        # its tracker), then restore the caller's value
-        prev_trace = routed.request.trace_id
-        routed.request.trace_id = routed.trace_id
-        try:
-            with self.tracer.span("serving_route", **attrs):
-                local_id = rep.submit(routed.request)
-        finally:
-            routed.request.trace_id = prev_trace
-        routed.replica_id, routed.local_id = rep.replica_id, local_id
-        self._by_local[(rep.replica_id, local_id)] = routed
+        adapter_err = None
+        for cost, rep in ranked:
+            attrs = dict(request_id=routed.global_id,
+                         replica=rep.replica_id,
+                         trace=routed.trace_id, cost=round(cost, 4),
+                         queue_depth=rep.engine.scheduler.depth)
+            if rep.role != "mixed" and self.disagg_prompt_threshold > 0:
+                # disagg fabrics only: with threshold 0 roles are inert
+                # and spans stay byte-stable vs a role-less router
+                attrs["role"] = rep.role
+            if rep.engine.hybrid:
+                attrs["free_pages"] = rep.engine.page_pool.free_pages
+            # propagate the entry's trace id through the request object
+            # only for the duration of the submit (the scheduler copies
+            # it onto its tracker), then restore the caller's value
+            prev_trace = routed.request.trace_id
+            routed.request.trace_id = routed.trace_id
+            try:
+                with self.tracer.span("serving_route", **attrs):
+                    local_id = rep.submit(routed.request)
+            except ValueError as e:
+                if "UnknownAdapterError" not in (
+                        f"{type(e).__name__}: {e}"):
+                    raise  # a per-request validation error: uniform
+                    # across replicas, retrying elsewhere can't help
+                adapter_err = e
+                continue
+            finally:
+                routed.request.trace_id = prev_trace
+            routed.replica_id, routed.local_id = rep.replica_id, local_id
+            self._by_local[(rep.replica_id, local_id)] = routed
+            return
+        raise adapter_err
 
     # --------------------------------------------------- SSE resume attach
 

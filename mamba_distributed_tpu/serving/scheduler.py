@@ -66,6 +66,13 @@ class GenerationRequest:
     # resumed later without re-prefill — when a higher-priority
     # request is stuck queued with no free slot (serving/engine.py).
     priority: int | None = None
+    # named LoRA adapter this request decodes under (serving/
+    # adapters.py; None = the base model).  Validated at submit against
+    # the engine's AdapterRegistry — an unknown name raises the named
+    # UnknownAdapterError, never a hang — and carried through the
+    # service wire, failover replay, SSE resume and tier migration
+    # (the target engine re-pins the factors from its own cache).
+    adapter: str | None = None
 
     def resolve_key(self) -> jax.Array:
         key = self.key if self.key is not None else jax.random.PRNGKey(self.seed)
@@ -178,6 +185,14 @@ class _Tracked:
     spec_pending: list = dataclasses.field(default_factory=list)
     spec_pending_emitted: int = 0
     spec_observed: int = 0
+    # --- multi-tenant LoRA (serving/adapters.py): the device factor-
+    # pool row this request's slot multiplies (0 = the zero "no
+    # adapter" row; None = no cache ref held).  A ref is acquired at
+    # admission (like KV pages) and released at finish/failure; it
+    # RIDES a preemption snapshot (resume must not re-miss) and is
+    # released when the request migrates out (the target re-pins from
+    # its own engine-local cache).
+    adapter_slot: int | None = None
 
 
 class FCFSScheduler:

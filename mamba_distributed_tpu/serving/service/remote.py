@@ -290,13 +290,43 @@ class RemoteReplica:
     def place_cost(self, request=None) -> float:
         """Load + hybrid page pressure from the last-reported stats —
         the in-process cost minus the prefix-affinity probe (an
-        O(prompt) engine-side walk the wire deliberately skips)."""
+        O(prompt) engine-side walk the wire deliberately skips) —
+        minus the LoRA adapter-affinity discount when the worker's
+        last stats report the request's adapter device-RESIDENT
+        (serving/replica.ADAPTER_AFFINITY: one tenant's traffic
+        converges on the workers already holding its factors)."""
         s = self.stats
         cap = max(1, int(s.get("capacity", 1)))
         load = (int(s.get("depth", 0)) + int(s.get("resident", 0))) / cap
         if s.get("hybrid") and s.get("num_pages"):
             load += int(s.get("pages_in_use", 0)) / int(s["num_pages"])
+        adapter = (getattr(request, "adapter", None)
+                   if request is not None else None)
+        if adapter and adapter in (s.get("adapters_resident") or ()):
+            from mamba_distributed_tpu.serving.replica import (
+                ADAPTER_AFFINITY,
+            )
+
+            load -= ADAPTER_AFFINITY
         return load
+
+    def adapters_registered(self) -> list:
+        """Adapter names this worker can serve (from its last stats) —
+        the front end's 404 gate reads it."""
+        return list(self.stats.get("adapters_registered") or [])
+
+    def load_adapter(self, name: str, factors: dict,
+                     alpha: float | None = None) -> None:
+        """Ship one adapter's (unscaled) factors to the worker
+        (idempotent on an already-registered name).  NON-fatal on wire
+        failure, like ping: a transient socket fault on a factor push
+        must not condemn a healthy replica to failover — the caller
+        sees the WireError and can retry or place elsewhere."""
+        self._rpc("load_adapter", {
+            "name": name,
+            "factors": wire.encode_tree(factors),
+            "alpha": alpha,
+        }, expect="load_adapter_ack", fatal=False)
 
     def submit(self, request, force: bool = False) -> int:
         if not self.accepting and not force:

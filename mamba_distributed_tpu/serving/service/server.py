@@ -71,11 +71,26 @@ _EVENT_POLL_S = 120.0
 class FabricController(threading.Thread):
     """Single-threaded owner of the router; see module docstring."""
 
-    def __init__(self, router, *, health=None, poll_s: float = 0.002):
+    def __init__(self, router, *, health=None, poll_s: float = 0.002,
+                 adapters: dict | None = None):
         super().__init__(daemon=True, name="fabric-controller")
         self.router = router
         self.health = health
         self.poll_s = poll_s
+        # multi-tenant LoRA: the front end's host-side factor store —
+        # name -> {"factors": {target: {"A", "B"}}, "alpha": float|None}
+        # (scripts/serve_fabric.py --adapter name=path fills it).
+        # ensure_adapter() ships entries to workers that have not
+        # preloaded them (the load_adapter RPC), so an adapter loaded
+        # ANYWHERE in the fabric is servable EVERYWHERE.
+        self.adapters = adapters or {}
+        # push outcomes memoized per (replica, worker boot, name) so a
+        # hot adapter's MB-scale factor payload ships AT MOST ONCE per
+        # worker generation — not once per request — and a worker that
+        # REJECTED a push (LoRA off, registry full) is never hammered
+        # again; a worker restart changes its boot id, naturally
+        # invalidating both
+        self._adapter_pushes: dict = {}
         self._commands: queue.Queue = queue.Queue()
         self._sinks: dict[int, queue.Queue] = {}
         self._stop_requested = threading.Event()
@@ -105,6 +120,62 @@ class FabricController(threading.Thread):
 
     def stop(self) -> None:
         self._stop_requested.set()
+
+    # ------------------------------------------------ multi-tenant LoRA
+
+    def ensure_adapter(self, name: str) -> bool:
+        """Make ``name`` servable: True once at least one alive replica
+        has it registered — pushing this controller's own factor store
+        to workers that lack it (the ``load_adapter`` RPC; idempotent).
+        False = the adapter is known NOWHERE (no preload, no store
+        entry): the HTTP layer answers 404 with the named
+        ``UnknownAdapterError`` body, never a hang.  Runs on the
+        controller thread (``call``)."""
+        ok = False
+        local = self.adapters.get(name)
+        for rep in self.router.replicas:
+            if not rep.alive:
+                continue
+            if hasattr(rep, "adapters_registered"):  # a RemoteReplica
+                if name in rep.adapters_registered():
+                    ok = True
+                    continue
+                push_key = (rep.replica_id,
+                            getattr(rep, "boot_id", None), name)
+                prior = self._adapter_pushes.get(push_key)
+                if prior is not None:
+                    ok = ok or prior
+                    continue
+                if local is not None:
+                    try:
+                        rep.load_adapter(name, local["factors"],
+                                         local.get("alpha"))
+                        self._adapter_pushes[push_key] = True
+                        ok = True
+                    except wire.WireError:
+                        pass  # transient socket fault: retry later
+                    except Exception:  # noqa: BLE001 — one worker's
+                        # failed push must not fail the request, and a
+                        # REJECTED push (LoRA off, registry full) must
+                        # not re-ship the MB-scale payload per request
+                        self._adapter_pushes[push_key] = False
+            else:  # in-process EngineReplica: registries may be shared
+                reg = getattr(rep.engine, "adapters", None)
+                if reg is None:
+                    continue
+                if name in reg:
+                    ok = True
+                    continue
+                if local is not None:
+                    try:
+                        reg.register(name, local["factors"],
+                                     alpha=local.get("alpha"))
+                        ok = True
+                    except ValueError:
+                        # registry full, or a shared instance another
+                        # replica's pass already filled
+                        ok = ok or name in reg
+        return ok
 
     # ------------------------------------------------------------ the loop
 
@@ -419,6 +490,7 @@ class FabricHTTPServer:
                 eos_id=spec.get("eos_id"),
                 seed=int(spec.get("seed", 0)),
                 priority=spec.get("priority"),
+                adapter=spec.get("adapter"),
             )
         except (ValueError, KeyError, TypeError,
                 json.JSONDecodeError) as e:
@@ -427,12 +499,37 @@ class FabricHTTPServer:
             writer.write(_json_response(
                 "400 Bad Request", {"error": f"bad request body: {e}"}))
             return
+        if request.adapter:
+            # multi-tenant LoRA: the adapter must be servable SOMEWHERE
+            # before placement (ensure_adapter pushes this front end's
+            # factors to workers that lack them) — an unknown name is a
+            # 404 with the NAMED error body, never a hang or a silent
+            # base-model stream
+            known = await asyncio.wrap_future(self.controller.call(
+                lambda: self.controller.ensure_adapter(request.adapter)
+            ))
+            if not known:
+                writer.write(_json_response("404 Not Found", {
+                    "error": f"unknown adapter {request.adapter!r}: not "
+                             f"preloaded on any worker and not in this "
+                             f"front end's factor store",
+                    "error_type": "UnknownAdapterError",
+                }))
+                return
         try:
             gid, sink = await asyncio.wrap_future(
                 self.controller.submit_request(request)
             )
         except (ValueError, RuntimeError) as e:
             # invalid request, or nothing accepting (all draining/dead)
+            if "UnknownAdapterError" in f"{type(e).__name__}: {e}":
+                # an engine-level rejection that slipped past the gate
+                # (e.g. a race with a registry eviction): same 404 body
+                writer.write(_json_response("404 Not Found", {
+                    "error": str(e),
+                    "error_type": "UnknownAdapterError",
+                }))
+                return
             status = ("400 Bad Request" if isinstance(e, ValueError)
                       else "503 Service Unavailable")
             writer.write(_json_response(status, {"error": str(e)}))

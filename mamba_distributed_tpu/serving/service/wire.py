@@ -45,8 +45,13 @@ import numpy as np
 # cursors (encode/decode_resume_token) — a v1 front end cannot drive
 # the re-attach protocol, so the version negotiation (and every resume
 # cursor, which embeds its schema version) fails the skew loudly
-# through UnknownWireVersionError instead of half-working.
-WIRE_VERSION = 2
+# through UnknownWireVersionError instead of half-working.  v3:
+# multi-tenant LoRA — requests carry an ``adapter`` identity the
+# engine VALIDATES (an older worker would silently serve the base
+# model for an adapter request: wrong tokens, not a missing feature),
+# and the worker RPC surface grew ``load_adapter`` (factor shipping
+# host->worker); skew fails through the same named error.
+WIRE_VERSION = 3
 
 # one frame's hard ceiling (a hybrid migration artifact is page-count
 # sized — MBs, not GBs; anything bigger is a corrupt length prefix)
@@ -160,6 +165,7 @@ def encode_request(request) -> dict:
         "seed": int(request.seed),
         "trace_id": request.trace_id,
         "priority": request.priority,
+        "adapter": getattr(request, "adapter", None),
     }
     if request.key is not None:
         d["key"] = encode_array(np.asarray(request.resolve_key()))
@@ -180,6 +186,7 @@ def decode_request(d: dict):
         key=key,
         trace_id=d.get("trace_id"),
         priority=d.get("priority"),
+        adapter=d.get("adapter"),
     )
 
 

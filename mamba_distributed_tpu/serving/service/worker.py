@@ -53,7 +53,7 @@ from mamba_distributed_tpu.serving.service import wire
 # message types the session dispatcher understands (anything else is a
 # named error back to the peer, never a hang)
 _HANDLED = ("hello", "submit", "submit_migrated", "step", "ping", "drain",
-            "replay", "summary", "shutdown")
+            "replay", "load_adapter", "summary", "shutdown")
 
 
 # ------------------------------------------------------------- config I/O
@@ -148,6 +148,13 @@ class WorkerServer:
             s["free_pages"] = eng.page_pool.free_pages
             s["num_pages"] = eng.page_pool.num_pages
             s["pages_in_use"] = eng.page_pool.pages_in_use
+        if getattr(eng, "lora", False):
+            # multi-tenant LoRA (serving/adapters.py): which adapters
+            # this worker can serve at all (registered) and which are
+            # device-RESIDENT right now (the controller's adapter-
+            # affinity placement term and 404 gate read these)
+            s["adapters_registered"] = eng.adapters.names()
+            s["adapters_resident"] = eng.adapter_cache.resident_names()
         return s
 
     # ------------------------------------------------------------ migration
@@ -329,6 +336,37 @@ class WorkerServer:
                 if info.get("request") is not None:
                     out["request"] = wire.encode_request(info["request"])
                 wire.send_msg(conn, "replay_result", out)
+        elif mtype == "load_adapter":
+            # multi-tenant LoRA factor shipping (host -> worker): the
+            # controller pushes a named adapter's (unscaled) factors so
+            # a worker that never preloaded it can serve its requests
+            # (and a migration target can re-pin them).  Idempotent on
+            # an already-registered name — re-shipping the same
+            # identity is a no-op ack, never an error (every submit
+            # may race a concurrent load of the same adapter).
+            try:
+                eng = rep.engine
+                if not getattr(eng, "lora", False):
+                    raise ValueError(
+                        "this worker serves the base model only "
+                        "(cfg.lora_max_adapters=0); re-deploy with "
+                        "LoRA serving on to load adapters"
+                    )
+                name = payload["name"]
+                if name not in eng.adapters:
+                    eng.adapters.register(
+                        name, wire.decode_tree(payload["factors"]),
+                        alpha=payload.get("alpha"),
+                    )
+            except Exception as e:  # noqa: BLE001 — serialized back
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "load_adapter_ack", {
+                "stats": self._stats(),
+            })
         elif mtype == "summary":
             from mamba_distributed_tpu.obs import jsonable
 

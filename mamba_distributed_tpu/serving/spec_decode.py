@@ -108,7 +108,8 @@ TRACE_COUNTS = {
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh"),
                    donate_argnums=(1,))
 def spec_verify(params: dict, state, ids: jax.Array, token_mask: jax.Array,
-                cfg: ModelConfig, mesh=None):
+                cfg: ModelConfig, mesh=None,
+                adapter_ids: jax.Array | None = None):
     """The verify launch: feed every row's ``ids`` (b, W) through
     ``lm_verify_chunk`` from ``state`` and score all W positions.
 
@@ -138,6 +139,16 @@ def spec_verify(params: dict, state, ids: jax.Array, token_mask: jax.Array,
         )
 
         params = constrain_serving_params(params, mesh)
+    if adapter_ids is not None:
+        # multi-tenant LoRA (serving/adapters.py): per-row adapter ids
+        # bound into the attached factor pools, so heterogeneous-
+        # adapter streams share this ONE verify launch exactly as they
+        # share the plain tick
+        from mamba_distributed_tpu.serving.adapters import (
+            bind_adapter_ids,
+        )
+
+        params = bind_adapter_ids(params, adapter_ids)
     old = {"blocks": state["blocks"]}
     if "attn_meta" in state:
         old["attn_meta"] = state["attn_meta"]

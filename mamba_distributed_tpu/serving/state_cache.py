@@ -27,8 +27,17 @@ The pool is a plain pytree:
       "top_k":       (S,) i32    # per-slot top-k (<= the engine's static k_max)
       "temperature": (S,) f32
       "eos_id":      (S,) i32    # -1 => no EOS stopping
+      "adapter_id":  (S,) i32    # LoRA factor-pool row (0 = none)
     },
   }
+
+``adapter_id`` is the multi-tenant LoRA identity (serving/adapters.py):
+the device AdapterCache slot whose stacked factors this slot's rows
+multiply inside the tick — 0 (the default, and the only value on
+LoRA-less engines) selects the reserved all-zero factor row, an exact
+no-op.  It lives in the pool meta — not a separate tick argument — so
+the compacted-tick gathers/scatters move it with the other axis-0
+meta rows for free.
 
 ``insert``/``evict`` are jit-compiled with the pool donated: the slot
 index is a traced scalar, so admitting a request into ANY slot reuses
@@ -316,6 +325,7 @@ def init_pool(cfg: ModelConfig, capacity: int, num_shards: int = 1) -> dict:
             "top_k": jnp.ones((S,), jnp.int32),
             "temperature": jnp.ones((S,), jnp.float32),
             "eos_id": jnp.full((S,), -1, jnp.int32),
+            "adapter_id": jnp.zeros((S,), jnp.int32),
         },
     }
 
@@ -337,10 +347,12 @@ def insert(
     top_k: jax.Array,
     temperature: jax.Array,
     eos_id: jax.Array,
+    adapter_id: jax.Array = 0,
 ) -> dict:
     """Admit a prefilled request (batch-1 ``state`` + last ``logits``)
     into ``slot``.  One trace serves every (slot, request) combination —
-    all arguments are traced, the pool buffers are donated."""
+    all arguments are traced, the pool buffers are donated.
+    ``adapter_id`` is the request's LoRA factor-pool row (0 = none)."""
     # state leaves are layer-stacked (L, 1, ...) -> write batch axis 1
     new_state = _write_blocks(pool["state"], slot, state)
     meta = pool["meta"]
@@ -354,6 +366,7 @@ def insert(
         "top_k": _set_row(meta["top_k"], slot, top_k),
         "temperature": _set_row(meta["temperature"], slot, temperature),
         "eos_id": _set_row(meta["eos_id"], slot, eos_id),
+        "adapter_id": _set_row(meta["adapter_id"], slot, adapter_id),
     }
     return {
         "state": new_state,
@@ -374,12 +387,15 @@ def restore(
     top_k: jax.Array,
     temperature: jax.Array,
     eos_id: jax.Array,
+    adapter_id: jax.Array = 0,
 ) -> dict:
     """Re-admit a PREEMPTED request mid-decode: identical to ``insert``
     except the generated-token counter is restored instead of zeroed,
     so the next tick samples ``fold_in(key, step)`` — the stream
     continues bit-exactly where the swap-out cut it (the engine's
-    priority-preemption path, serving/engine.py)."""
+    priority-preemption path, serving/engine.py).  ``adapter_id`` is
+    re-stamped from the tracker (the factor-pool row may differ on a
+    migration target — cache slots are engine-local)."""
     new_state = _write_blocks(pool["state"], slot, state)
     meta = pool["meta"]
     new_meta = {
@@ -392,6 +408,7 @@ def restore(
         "top_k": _set_row(meta["top_k"], slot, top_k),
         "temperature": _set_row(meta["temperature"], slot, temperature),
         "eos_id": _set_row(meta["eos_id"], slot, eos_id),
+        "adapter_id": _set_row(meta["adapter_id"], slot, adapter_id),
     }
     return {
         "state": new_state,
@@ -584,6 +601,7 @@ def stash_prefill(
     top_k: jax.Array,
     temperature: jax.Array,
     eos_id: jax.Array,
+    adapter_id: jax.Array = 0,
 ) -> dict:
     """Park a PARTIAL prefill carry in ``slot``: the request occupies the
     slot (``active=True``) with its chunk-scan carry and its sampling
@@ -604,6 +622,7 @@ def stash_prefill(
         "top_k": _set_row(meta["top_k"], slot, top_k),
         "temperature": _set_row(meta["temperature"], slot, temperature),
         "eos_id": _set_row(meta["eos_id"], slot, eos_id),
+        "adapter_id": _set_row(meta["adapter_id"], slot, adapter_id),
     }
     return {
         "state": _write_blocks(pool["state"], slot, state),

@@ -233,6 +233,23 @@ class ServingMetrics:
         self.compaction_ticks = 0  # ticks that ran NARROWER than capacity
         self.compaction_hist: dict[int, int] = {}  # lane width -> ticks
         self.compaction_lanes_saved = 0
+        # multi-tenant LoRA serving (serving/adapters.py): the engine
+        # calls configure_adapters() when cfg.lora_max_adapters > 0,
+        # unlocking summary()["adapters"] — registry/cache shape,
+        # cache hit/miss/eviction totals and the per-tick distinct-
+        # adapter gauge.  Off by default so LoRA-less summaries and
+        # records stay byte-stable.
+        self._adapters_on = False
+        self.lora_max_adapters: int | None = None
+        self.lora_rank: int | None = None
+        self.lora_cache_slots: int | None = None
+        self.adapters_resident: int = 0
+        self.adapter_cache_hits = 0
+        self.adapter_cache_misses = 0
+        self.adapter_cache_evictions = 0
+        self.peak_adapters_live = 0
+        self._adapters_live_sum = 0
+        self._adapter_ticks = 0
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
         # disaggregated prefill/decode handoffs (docs/SERVING.md
@@ -343,6 +360,18 @@ class ServingMetrics:
         self.spec_tokens_cfg = spec_tokens
         self.spec_drafter = drafter
 
+    # ---------------------------------------------- multi-tenant LoRA
+
+    def configure_adapters(self, max_adapters: int, rank: int,
+                           cache_slots: int) -> None:
+        """Mark multi-tenant LoRA serving live (engine construction):
+        ``summary()`` gains its ``adapters`` section and tick records
+        their adapter-cache stamps."""
+        self._adapters_on = True
+        self.lora_max_adapters = int(max_adapters)
+        self.lora_rank = int(rank)
+        self.lora_cache_slots = int(cache_slots)
+
     # --------------------------------------------------- quantized serving
 
     def configure_memory(self, weight_bytes: int, page_pool_bytes: int,
@@ -427,6 +456,11 @@ class ServingMetrics:
         spec_accepted: int | None = None,
         spec_streams: int | None = None,
         compaction_width: int | None = None,
+        adapters_resident: int | None = None,
+        adapter_cache_hits: int = 0,
+        adapter_cache_misses: int = 0,
+        adapter_cache_evictions: int = 0,
+        adapters_live: int = 0,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -569,6 +603,26 @@ class ServingMetrics:
             record["spec_drafted"] = spec_drafted
             record["spec_accepted"] = spec_accepted
             record["spec_streams"] = spec_streams
+        if adapters_resident is not None:
+            # multi-tenant LoRA gauges (stamped only when LoRA serving
+            # is on — records stay byte-stable otherwise): cache
+            # residency, this window's hit/miss/eviction churn, and
+            # how many DISTINCT adapters this tick's one launch mixed
+            self.adapters_resident = adapters_resident
+            self.adapter_cache_hits += adapter_cache_hits
+            self.adapter_cache_misses += adapter_cache_misses
+            self.adapter_cache_evictions += adapter_cache_evictions
+            self.peak_adapters_live = max(self.peak_adapters_live,
+                                          adapters_live)
+            self._adapters_live_sum += adapters_live
+            self._adapter_ticks += 1
+            record.update({
+                "adapters_resident": adapters_resident,
+                "adapter_cache_hits": adapter_cache_hits,
+                "adapter_cache_misses": adapter_cache_misses,
+                "adapter_cache_evictions": adapter_cache_evictions,
+                "adapters_live": adapters_live,
+            })
         if compaction_width is not None:
             # occupancy-adaptive compaction stamp (only when the engine
             # has compaction on — records stay byte-stable otherwise):
@@ -698,6 +752,21 @@ class ServingMetrics:
                 "accepted_tokens_per_tick": (
                     round(self.decode_tokens / self.spec_stream_ticks, 2)
                     if self.spec_stream_ticks else None
+                ),
+            }),
+            "adapters": (None if not self._adapters_on else {
+                "max_adapters": self.lora_max_adapters,
+                "rank": self.lora_rank,
+                "cache_slots": self.lora_cache_slots,
+                "resident": self.adapters_resident,
+                "cache_hits": self.adapter_cache_hits,
+                "cache_misses": self.adapter_cache_misses,
+                "cache_evictions": self.adapter_cache_evictions,
+                "peak_live": self.peak_adapters_live,
+                "mean_live": (
+                    round(self._adapters_live_sum
+                          / self._adapter_ticks, 2)
+                    if self._adapter_ticks else None
                 ),
             }),
             "memory": (None if not self._memory_on else {

@@ -529,6 +529,110 @@ def _shared_prefix_bench(cfg, params, capacity, tokens_per_tick, n_requests,
     return out, m_warm.summary()
 
 
+def _lora_bench(cfg, params, n_adapters, rank, capacity, tokens_per_tick,
+                n_requests, pmin, pmax, max_new, rng, jsonl):
+    """Multi-tenant LoRA headline (docs/SERVING.md "Multi-tenant
+    LoRA"): an N-adapter mixed workload on ONE engine (heterogeneous
+    adapters batched into one launch via the segmented factor pools)
+    vs N sequential single-adapter engines each serving its tenant's
+    share — the one-deployment-per-tenant strawman multi-tenancy
+    replaces.  Decode is weight-bandwidth-bound, so the mixed engine's
+    higher occupancy per launch is the win; streams are asserted
+    IDENTICAL between the two modes first (same engine math per
+    request), so the timing compares layouts, not outputs."""
+    import dataclasses as _dc
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.serving import GenerationRequest, ServingEngine
+    from mamba_distributed_tpu.serving.adapters import AdapterRegistry
+
+    lcfg = _dc.replace(cfg, lora_max_adapters=n_adapters, lora_rank=rank)
+    registry = AdapterRegistry(lcfg, params)
+    names = [f"tenant-{i}" for i in range(n_adapters)]
+    for i, name in enumerate(names):
+        registry.register_random(name, seed=100 + i)
+    base = _workload(rng, n_requests, pmin, pmax, max_new,
+                     cfg.vocab_size)
+    by_adapter = {nm: [] for nm in names}
+    for i, r in enumerate(base):
+        by_adapter[names[i % n_adapters]].append(
+            (i, r.prompt_ids, r.max_new_tokens, r.seed)
+        )
+
+    def reqs(items, adapter):
+        # fresh request objects per submit (ids/streams are per-submit)
+        return [GenerationRequest(prompt_ids=np.asarray(p),
+                                  max_new_tokens=mx, seed=sd,
+                                  adapter=adapter)
+                for i, p, mx, sd in items]
+
+    kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick,
+              adapters=registry)
+
+    def run_mixed(metrics=None):
+        """ALL tenants' requests on one engine at once, submitted in
+        arrival (round-robin) order — heterogeneous adapters
+        co-resident in the slot pool, one launch per tick."""
+        eng = ServingEngine(params, lcfg, metrics=metrics, **kw)
+        tagged = sorted(
+            (i, r)
+            for nm in names
+            for (i, _, _, _), r in zip(by_adapter[nm],
+                                       reqs(by_adapter[nm], nm))
+        )
+        done = eng.run([r for _, r in tagged])
+        return dict(zip((i for i, _ in tagged), done)), eng
+
+    def run_sequential():
+        """One engine PER tenant, run one after another — the
+        deployment-per-adapter strawman (each run's occupancy is only
+        its own tenant's share)."""
+        results = {}
+        wall = 0.0
+        for nm in names:
+            eng = ServingEngine(params, lcfg, **kw)
+            rs = reqs(by_adapter[nm], nm)
+            t0 = _time.perf_counter()
+            done = eng.run(rs)
+            wall += _time.perf_counter() - t0
+            for (i, _, _, _), r in zip(by_adapter[nm], done):
+                results[i] = r
+        return results, wall
+
+    # jit warm + stream-identity assertion off the clock: the mixed
+    # engine and the per-tenant engines run the identical per-request
+    # math, so their streams must agree token-for-token
+    mixed_by_i, _ = run_mixed()
+    seq_res, _ = run_sequential()
+    for i in seq_res:
+        assert (mixed_by_i[i].new_tokens.tolist()
+                == seq_res[i].new_tokens.tolist()), (
+            f"mixed vs sequential stream mismatch on request {i}"
+        )
+    _progress("streams identical mixed vs sequential; timing...")
+
+    out = {}
+    m = _capture_metrics(capacity, jsonl_path=jsonl)
+    m.configure_adapters(n_adapters, rank, n_adapters)
+    t0 = _time.perf_counter()
+    mixed_by_i, eng = run_mixed(metrics=m)
+    wall_mixed = _time.perf_counter() - t0
+    total_tokens = sum(len(r.new_tokens) for r in mixed_by_i.values())
+    _, wall_seq = run_sequential()
+    out["one_engine_tok_s"] = round(total_tokens / wall_mixed, 1)
+    out["sequential_tok_s"] = round(total_tokens / wall_seq, 1)
+    out["wall_s_one_engine"] = round(wall_mixed, 3)
+    out["wall_s_sequential"] = round(wall_seq, 3)
+    out["multi_tenant_speedup"] = round(wall_seq / wall_mixed, 2)
+    _progress(f"one engine {out['one_engine_tok_s']} tok/s vs "
+              f"{n_adapters} sequential engines "
+              f"{out['sequential_tok_s']} tok/s "
+              f"({out['multi_tenant_speedup']}x)")
+    return out, eng.metrics.summary()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jsonl", default=None, metavar="PATH",
@@ -624,6 +728,17 @@ def main() -> None:
                          "BENCH_SERVING.json spec_ngram row.  "
                          "SERVE_SPEC_PATTERN (8) sets the repeated "
                          "pattern length")
+    ap.add_argument("--lora-adapters", type=int, default=0, metavar="N",
+                    help="multi-tenant LoRA comparison (cfg.lora_max_"
+                         "adapters=N; docs/SERVING.md 'Multi-tenant "
+                         "LoRA'): an N-adapter mixed workload on ONE "
+                         "engine (heterogeneous adapters share each "
+                         "launch) vs N sequential single-adapter "
+                         "engines — the BENCH_SERVING.json "
+                         "lora_multi_tenant row")
+    ap.add_argument("--lora-rank", type=int, default=8, metavar="R",
+                    help="low-rank dimension for --lora-adapters "
+                         "(cfg.lora_rank)")
     ap.add_argument("--spec-drafter", default="ngram",
                     choices=["ngram", "model"],
                     help="drafter for --spec-tokens: 'ngram' (prompt-"
@@ -638,6 +753,7 @@ def main() -> None:
                              ("--quant-kv-capacity",
                               args.quant_kv_capacity),
                              ("--spec-tokens", bool(args.spec_tokens)),
+                             ("--lora-adapters", bool(args.lora_adapters)),
                              ("--service", args.service),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
@@ -1145,6 +1261,38 @@ def main() -> None:
             "prefill_chunks": summary["prefill_chunks"],
             "prefill_stall_ms": summary["prefill_stall_ms"],
             "latency": summary["latency"],
+            "device": dev.device_kind,
+        }
+        if args.jsonl:
+            record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
+
+    if args.lora_adapters:
+        if args.lora_adapters < 2:
+            raise SystemExit(
+                "--lora-adapters needs N >= 2 (multi-tenancy is the "
+                "point of the comparison)"
+            )
+        fields, summary = _lora_bench(
+            cfg, params, args.lora_adapters, args.lora_rank, capacity,
+            tokens_per_tick, n_requests, pmin, pmax, max_new, rng,
+            args.jsonl,
+        )
+        record = {
+            "metric": (f"serving_lora_multi_tenant_speedup_"
+                       f"{preset.replace('-', '_')}"),
+            "value": fields["multi_tenant_speedup"],
+            "unit": ("x aggregate tok/s, one mixed-adapter engine vs "
+                     "N sequential single-adapter engines"),
+            **fields,
+            "adapters": args.lora_adapters,
+            "lora_rank": args.lora_rank,
+            "requests": n_requests,
+            "max_new_tokens": max_new,
+            "capacity": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "adapter_cache": summary["adapters"],
             "device": dev.device_kind,
         }
         if args.jsonl:

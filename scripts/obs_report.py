@@ -284,6 +284,25 @@ def build_report(events: list[dict]) -> dict:
                 "weight_bytes": last.get("weight_bytes"),
                 "page_pool_bytes": last.get("page_pool_bytes"),
             }
+        # multi-tenant LoRA gauges (absent unless a LoRA-serving engine
+        # wrote the stream): adapter-cache churn totals, last residency
+        # gauge and the per-tick distinct-adapter peak (docs/SERVING.md
+        # "Multi-tenant LoRA")
+        aticks = [e for e in ticks
+                  if e.get("adapters_resident") is not None]
+        adapters = None
+        if aticks:
+            adapters = {
+                "resident": aticks[-1]["adapters_resident"],
+                "cache_hits": sum(
+                    e.get("adapter_cache_hits", 0) for e in aticks),
+                "cache_misses": sum(
+                    e.get("adapter_cache_misses", 0) for e in aticks),
+                "cache_evictions": sum(
+                    e.get("adapter_cache_evictions", 0) for e in aticks),
+                "peak_live": max(
+                    e.get("adapters_live", 0) for e in aticks),
+            }
         report["serving"] = {
             "ticks": len(ticks),
             "decode_tokens": tokens,
@@ -306,6 +325,7 @@ def build_report(events: list[dict]) -> dict:
             "prefix_cache": prefix,
             "compaction": compaction,
             "speculation": speculation,
+            "adapters": adapters,
             "preemptions": preemptions,
             "migrations": {"handoffs": handoffs} if handoffs else None,
             "kv_pages": kv_pages,
@@ -647,6 +667,14 @@ def format_report(report: dict) -> str:
                 f"({'-' if rate is None else f'{rate * 100:.1f}%'})   "
                 f"accepted tokens/tick: "
                 f"{_fmt(sp['accepted_tokens_per_tick'])}"
+            )
+        if s.get("adapters"):
+            a = s["adapters"]
+            head += (
+                f"\nadapters: {a['resident']} resident   cache "
+                f"{a['cache_hits']} hits / {a['cache_misses']} misses / "
+                f"{a['cache_evictions']} evictions   peak live/tick: "
+                f"{a['peak_live']}"
             )
         if s.get("preemptions"):
             head += f"\npreemptions: {s['preemptions']}"

@@ -47,6 +47,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def spawn_worker(config_path: str, replica_id: int, role: str, *,
                  capacity: int, tokens_per_tick: int, param_seed: int,
                  jsonl: str | None = None, spans: str | None = None,
+                 adapters: list[str] | None = None,
                  timeout_s: float = 120.0) -> tuple[subprocess.Popen, int]:
     """Spawn one serve_worker.py subprocess; returns (proc, port) once
     its READY line arrives.  Shared by this CLI, the tests, and
@@ -62,6 +63,8 @@ def spawn_worker(config_path: str, replica_id: int, role: str, *,
         cmd += ["--jsonl", jsonl]
     if spans:
         cmd += ["--spans", spans]
+    for spec in adapters or []:
+        cmd += ["--adapter", spec]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 
@@ -123,6 +126,13 @@ def main() -> int:
                     help="per-worker slot capacity (spawn mode)")
     ap.add_argument("--tokens-per-tick", type=int, default=8)
     ap.add_argument("--param-seed", type=int, default=0)
+    ap.add_argument("--adapter", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="LoRA adapter factors (serving.adapters."
+                         "save_adapter_file npz); repeatable.  Spawned "
+                         "workers preload them; externally-started "
+                         "workers get them pushed over the wire "
+                         "(load_adapter RPC) at first use")
     ap.add_argument("--jsonl", default=None, metavar="PATH",
                     help="fabric serving_health record stream")
     ap.add_argument("--spans", default=None, metavar="PATH",
@@ -160,7 +170,7 @@ def main() -> int:
             proc, port = spawn_worker(
                 args.config, i, roles[i], capacity=args.capacity,
                 tokens_per_tick=args.tokens_per_tick,
-                param_seed=args.param_seed,
+                param_seed=args.param_seed, adapters=args.adapter,
             )
             procs.append(proc)
             addrs.append(f"127.0.0.1:{port}")
@@ -175,11 +185,22 @@ def main() -> int:
         emit = lambda rec: append_jsonl(args.jsonl, rec)  # noqa: E731
     else:
         emit = None
+    adapter_store = {}
+    if args.adapter:
+        from mamba_distributed_tpu.serving.adapters import load_adapter_file
+
+        for spec in args.adapter:
+            name, _, path = spec.partition("=")
+            if not name or not path:
+                ap.error(f"--adapter expects NAME=PATH, got {spec!r}")
+            adapter_store[name] = {"factors": load_adapter_file(path),
+                                   "alpha": None}
     router = RequestRouter(None, cfg, replicas=replicas, tracer=tracer,
                            retain_results=False)
     health = HeartbeatMonitor(router, interval_ms=args.heartbeat_ms,
                               miss_threshold=args.miss_threshold, emit=emit)
-    controller = FabricController(router, health=health)
+    controller = FabricController(router, health=health,
+                                  adapters=adapter_store)
     controller.start()
     http = FabricHTTPServer(controller, args.http_host, args.http_port)
     port = http.start_background()

@@ -59,6 +59,12 @@ def main() -> int:
                     help="serve trained params from this checkpoint "
                          "(Orbax dir or reference .pt) instead of "
                          "seed-initialized ones — requires --preset")
+    ap.add_argument("--adapter", action="append", default=[],
+                    metavar="NAME=PATH",
+                    help="preload a LoRA adapter: NAME=path-to-npz "
+                         "(serving.adapters.save_adapter_file format); "
+                         "repeatable.  Needs cfg.lora_max_adapters > 0 "
+                         "(docs/SERVING.md 'Multi-tenant LoRA')")
     ap.add_argument("--jsonl", default=None, metavar="PATH",
                     help="this replica's serving_tick/request stream "
                          "(obs_report.py input)")
@@ -93,10 +99,27 @@ def main() -> int:
     metrics = ServingMetrics(args.capacity, jsonl_path=args.jsonl,
                              replica=args.replica_id)
     tracer = SpanTracer(args.spans) if args.spans else NULL_TRACER
+    engine_kw = {}
+    if args.adapter:
+        from mamba_distributed_tpu.serving.adapters import (
+            AdapterRegistry,
+            load_adapter_file,
+        )
+
+        if cfg.lora_max_adapters <= 0:
+            ap.error("--adapter needs a config with lora_max_adapters "
+                     "> 0 (multi-tenant LoRA serving, docs/SERVING.md)")
+        registry = AdapterRegistry(cfg, params)
+        for spec in args.adapter:
+            name, _, path = spec.partition("=")
+            if not name or not path:
+                ap.error(f"--adapter expects NAME=PATH, got {spec!r}")
+            registry.register(name, load_adapter_file(path))
+        engine_kw["adapters"] = registry
     replica = EngineReplica(
         args.replica_id, params, cfg, metrics=metrics, tracer=tracer,
         role=args.role, capacity=args.capacity, retain_results=False,
-        tokens_per_tick=args.tokens_per_tick,
+        tokens_per_tick=args.tokens_per_tick, **engine_kw,
     )
     worker = WorkerServer(replica, args.host, args.port)
     for sig in (signal.SIGTERM, signal.SIGINT):

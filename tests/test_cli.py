@@ -134,6 +134,45 @@ def test_bench_serving_long_prompt_smoke(tmp_path):
 
 
 @pytest.mark.serving
+@pytest.mark.lora
+def test_bench_serving_lora_smoke(tmp_path):
+    """CI smoke for the multi-tenant LoRA bench: ``--lora-adapters``
+    must run the mixed-adapter engine and the N sequential single-
+    adapter engines end-to-end (streams asserted identical inside the
+    bench), report the speedup pair, and leave a tick stream whose
+    adapters: line obs_report.py renders (ISSUE 15 satellites)."""
+    import json
+
+    jsonl = str(tmp_path / "lora.jsonl")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="4", SERVE_CAPACITY="4",
+               SERVE_PROMPT_MIN="6", SERVE_PROMPT_MAX="12",
+               SERVE_MAX_NEW="8", SERVE_TOKENS_PER_TICK="2")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--lora-adapters", "2", "--lora-rank", "4", "--jsonl", jsonl],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["adapters"] == 2
+    assert rec["lora_rank"] == 4
+    assert rec["one_engine_tok_s"] > 0
+    assert rec["sequential_tok_s"] > 0
+    assert rec["adapter_cache"]["resident"] == 2
+    ticks = [json.loads(ln) for ln in open(jsonl)
+             if json.loads(ln).get("kind") == "serving_tick"]
+    assert ticks and all("adapters_resident" in t for t in ticks)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "adapters:" in r.stdout
+
+
+@pytest.mark.serving
 @pytest.mark.spec
 def test_bench_serving_spec_smoke(tmp_path):
     """CI smoke for the speculative-decoding bench: ``--spec-tokens``
