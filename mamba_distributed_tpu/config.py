@@ -348,6 +348,17 @@ class ModelConfig:
     # (hybrid) head counts must divide evenly — checked with a clear
     # error at engine construction.
     serving_model_shards: int = 1
+    # Durable session store (docs/SERVING.md "Durable sessions"):
+    # parked sessions' time-to-live in seconds — the background sweeper
+    # reaps older ones (0 = park forever; explicit parks may override
+    # per call) — and the host-RAM tier's byte budget, above which the
+    # LRU parked sessions demote to the disk tier (0 = write-through:
+    # everything demotes immediately when a disk tier exists).  Both
+    # only take effect where a store is constructed (--state-dir on
+    # serve_worker/serve_fabric, or session_store= in code); the
+    # default engine/router path carries no store and is byte-stable.
+    session_ttl_s: float = 0.0
+    session_host_bytes: int = 0
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
@@ -504,6 +515,16 @@ class ModelConfig:
                     f"lora_cache_slots must be >= 0 (0 => auto: "
                     f"lora_max_adapters), got {self.lora_cache_slots}"
                 )
+        if self.session_ttl_s < 0:
+            raise ValueError(
+                f"session_ttl_s must be >= 0 (0 = parked sessions never "
+                f"expire), got {self.session_ttl_s}"
+            )
+        if self.session_host_bytes < 0:
+            raise ValueError(
+                f"session_host_bytes must be >= 0 (0 = write-through to "
+                f"the disk tier), got {self.session_host_bytes}"
+            )
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"attn_impl must be 'auto', 'xla' or 'pallas', got "

@@ -36,6 +36,11 @@ docs/SERVING.md has the architecture; the short version:
                up to K+2 greedy tokens per full weight read) with
                n-gram and companion-model drafters — lossless under
                argmax (docs/SERVING.md "Speculative decoding")
+  sessions/    durable session fabric: tiered park/resume store
+               (device slot -> host RAM -> disk) whose artifact is the
+               migration artifact — parked sessions cost zero device
+               memory and resume bit-exactly on any replica
+               (docs/SERVING.md "Durable sessions")
   service/     the deployable shape of all of the above: versioned
                wire codec, one replica per worker PROCESS, an asyncio
                HTTP/SSE front end running the UNCHANGED router, and
@@ -61,6 +66,11 @@ from mamba_distributed_tpu.serving.replica import (
     ReplicaState,
 )
 from mamba_distributed_tpu.serving.router import RequestRouter
+from mamba_distributed_tpu.serving.sessions import (
+    DiskSessionStore,
+    SessionStore,
+    SessionStoreError,
+)
 from mamba_distributed_tpu.serving.prefill import (
     ChunkPlan,
     chunked_prefill,
@@ -92,6 +102,7 @@ __all__ = [
     "AdapterRegistry",
     "UnknownAdapterError",
     "ChunkPlan",
+    "DiskSessionStore",
     "Drafter",
     "EngineReplica",
     "ModelDrafter",
@@ -108,6 +119,8 @@ __all__ = [
     "RequestRouter",
     "RequestStatus",
     "ServingEngine",
+    "SessionStore",
+    "SessionStoreError",
     "TokenEvent",
     "chunked_prefill",
     "evict",

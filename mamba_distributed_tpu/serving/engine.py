@@ -76,6 +76,7 @@ from mamba_distributed_tpu.serving import adapters as adapters_mod
 from mamba_distributed_tpu.serving import prefix_cache as prefix_cache_mod
 from mamba_distributed_tpu.serving import spec_decode
 from mamba_distributed_tpu.serving import state_cache
+from mamba_distributed_tpu.serving.sessions import SessionStoreError
 from mamba_distributed_tpu.serving.prefix_cache import PrefixCache
 from mamba_distributed_tpu.serving.prefill import (
     cast_decode_params,
@@ -405,6 +406,7 @@ class ServingEngine:
         migrate_hook=None,
         drafter: spec_decode.Drafter | None = None,
         adapters: adapters_mod.AdapterRegistry | None = None,
+        session_store=None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -669,6 +671,22 @@ class ServingEngine:
         else:
             self.adapters = None
             self.adapter_cache = None
+        # --- durable session fabric (serving/sessions/; docs/SERVING.md
+        # "Durable sessions"): an attached SessionStore lets streams
+        # PARK — slot, KV pages and adapter ref all released, the
+        # stream serialized into the migration artifact (+ its emitted
+        # tokens) — and resume bit-exactly later, here or on any
+        # replica.  The admission valve parks pressure victims through
+        # it (full artifact to the tiered store, a tiny session-pointer
+        # snapshot on the requeued tracker) instead of pinning their
+        # carries in host RAM forever.  Off (default) is the
+        # byte-stable status quo: no stamps, no spans, no sweeps.
+        self.session_store = session_store
+        self._session_parks = 0  # window counters -> tick records
+        self._session_resumes = 0
+        self._session_expires = 0
+        if session_store is not None:
+            self.metrics.configure_sessions()
         # recently finished streams' tokens (bounded), so a restarted
         # front end can re-attach an SSE stream whose final events died
         # with the old connection (stream_state; docs/SERVING.md
@@ -788,6 +806,15 @@ class ServingEngine:
         tracked.snapshot = snapshot
         tracked.no_migrate = True  # never bounce back to a prefill tier
         tracked.migration_source = source_replica
+        # a PARKED session's artifact additionally carries the tokens
+        # already streamed to the client (a migration artifact never
+        # does — migration happens before the first token): restore
+        # them so the resumed stream CONTINUES — token indices, the
+        # max_new_tokens budget and the artifact's ``step`` all line up
+        # with the park point instead of replaying from zero
+        prior = snapshot.get("new_tokens")
+        if prior:
+            tracked.new_tokens.extend(int(t) for t in prior)
         now = time.perf_counter()
         if snapshot.get("t_submit_age_s") is not None:
             # cross-host-safe: reconstruct the original stamps on THIS
@@ -1557,6 +1584,104 @@ class ServingEngine:
             self._free.sort()
             self.scheduler.requeue(tracked)
 
+    def _pressure_evict(self, victim: _Tracked) -> None:
+        """Free the victim's slot for the queue's best request: PREEMPT
+        (carry to host RAM, KV page refs kept — the status quo), or —
+        with a session store attached — PARK: the full replica-unbound
+        artifact (KV page CONTENTS included) goes to the tiered store,
+        the victim's pages recycle immediately, and its requeued
+        tracker holds only a tiny session pointer.  Parking is the
+        generalized valve: a pressure victim costs zero device pages
+        and near-zero host RAM while it waits, instead of pinning a
+        snapshot in RAM forever."""
+        if self.session_store is None:
+            self._preempt(victim)
+        else:
+            self._park_victim(victim)
+
+    def _park_victim(self, tracked: _Tracked) -> None:
+        """Pressure-driven park of a decoding slot: package the full
+        migration-format artifact, store it, release slot + pages +
+        adapter ref, and requeue the tracker with a session-pointer
+        snapshot (``{"migrated", "parked", "session"}``) that
+        ``_resume`` hydrates from the store only once a slot is
+        actually available.  ``pop_preempted`` skips the pointer (it
+        is ``migrated``-flagged — the resume needs a full page
+        re-allocation, so it competes through normal admission)."""
+        slot = tracked.slot
+        with self.tracer.span("serving_park", slot=slot,
+                              request=tracked.request_id,
+                              trace=tracked.trace_id, pressure=True):
+            snap = self._package_migration(slot, tracked)
+            snap["parked"] = True
+            # no TTL: the queued tracker owns this session's lifetime
+            sid = self.session_store.park(
+                {"request": None, "snapshot": snap}, ttl_s=0)
+            self.pool = state_cache.evict(self.pool, slot)
+            self._release_pages(slot, tracked)
+            self._release_adapter_ref(tracked)
+            del self._slots[slot]
+            self._free.append(slot)
+            self._free.sort()
+            tracked.snapshot = {"migrated": True, "parked": True,
+                                "session": sid}
+            tracked.preempted += 1
+            self._preemptions += 1
+            self.metrics.record_preemption()
+            self._session_parks += 1
+            self.metrics.record_session_park()
+            self.scheduler.requeue(tracked)
+
+    def park(self, request_id: int) -> tuple[GenerationRequest, dict]:
+        """Explicitly park a DECODING stream (client idled, or
+        ``POST /v1/park``): serialize it into the replica-unbound park
+        artifact — the migration artifact plus the tokens already
+        emitted — release its slot, KV pages and adapter ref, and DROP
+        it from this engine.  Returns ``(request, artifact)``; the
+        caller persists the pair (a ``SessionStore``, or the
+        controller's over the park RPC) and later resumes it through
+        ``submit_migrated`` on ANY replica — the artifact carries page
+        contents, never physical ids, so the resumed stream is
+        bit-identical to one that never parked.  Raises ``ValueError``
+        (retriable) for a stream not in a parkable state: queued or
+        mid-prefill streams have no decode carry yet, and a stream
+        with in-flight speculative drafts parks on the next tick, once
+        the verify launch drains them."""
+        tracked = next((t for t in self._slots.values()
+                        if t.request_id == request_id), None)
+        if tracked is None or tracked.status is not RequestStatus.DECODE:
+            raise ValueError(
+                f"request {request_id} is not parkable: only a resident "
+                f"DECODING stream has the carry the park artifact "
+                f"serializes (queued/prefilling streams finish prefill "
+                f"first; retry shortly)"
+            )
+        if self.spec and tracked.spec_pending:
+            raise ValueError(
+                f"request {request_id} has {len(tracked.spec_pending)} "
+                f"speculative draft token(s) in flight; retry after the "
+                f"next verify tick drains them"
+            )
+        slot = tracked.slot
+        with self.tracer.span("serving_park", slot=slot,
+                              request=tracked.request_id,
+                              trace=tracked.trace_id):
+            snap = self._package_migration(slot, tracked)
+            snap["parked"] = True
+            snap["new_tokens"] = [int(t) for t in tracked.new_tokens]
+            self.pool = state_cache.evict(self.pool, slot)
+            self._release_pages(slot, tracked)
+            self._release_adapter_ref(tracked)
+            del self._slots[slot]
+            self._free.append(slot)
+            self._free.sort()
+            if self.spec:
+                self.drafter.forget(tracked.request_id)
+            if self.session_store is not None:
+                self._session_parks += 1
+                self.metrics.record_session_park()
+        return tracked.request, snap
+
     def _resume(self, tracked: _Tracked) -> bool:
         """Re-admit a request from a host snapshot with ``step``
         preserved: a PREEMPTED request back into a free slot — the
@@ -1597,8 +1722,35 @@ class ServingEngine:
             self.scheduler.requeue(tracked)
             return False
         self._free.remove(slot)
-        r = tracked.request
         t0 = time.perf_counter()
+        if "session" in snap:
+            # pressure-parked: hydrate the full artifact from the
+            # tiered store only now that a slot is actually free (an
+            # eager hydrate on a tracker that then failed admission
+            # would haul the artifact back into host RAM for nothing)
+            try:
+                snap = self.session_store.resume(snap["session"])["snapshot"]
+                tracked.snapshot = snap
+            except (KeyError, SessionStoreError):
+                # the parked artifact is gone (store restarted without
+                # its state dir, or the frame failed its CRC): this
+                # stream cannot continue — drop it finished-with-error
+                # instead of crashing the admission loop (the
+                # named-error/skip contract), its emitted tokens still
+                # replayable from the recent-finished ring
+                self._release_adapter_ref(tracked)
+                self._free.insert(0, slot)
+                self._free.sort()
+                tracked.snapshot = None
+                self._recent_finished[tracked.request_id] = (
+                    list(tracked.new_tokens), "session_lost")
+                while (len(self._recent_finished)
+                       > self.RECENT_FINISHED_KEEP):
+                    self._recent_finished.pop(
+                        next(iter(self._recent_finished)))
+                return True
+        parked = bool(snap.get("parked"))
+        r = tracked.request
         try:
             with self.tracer.span("serving_resume", slot=slot,
                                   request=tracked.request_id,
@@ -1675,7 +1827,7 @@ class ServingEngine:
             # histogram starts empty (no token has streamed yet)
             tracked.t_admit = snap.get("t_admit") or time.perf_counter()
             tracked.itl_hist = StreamingHistogram()
-        if migrated:
+        if migrated and not parked:
             # handoff latency = source-side packaging + this restore's
             # host dispatch (the router's serving_migrate span covers
             # the placement hop between them)
@@ -1685,6 +1837,13 @@ class ServingEngine:
             tracked.migration_ms += dt_ms
             self._migrations_in += 1
             self.metrics.record_migration_in(dt_ms)
+        elif parked and self.session_store is not None:
+            # a parked resume is NOT a tier migration (the counters
+            # stay clean); it lands in the sessions resume-latency
+            # histogram instead — store hydrate + restore dispatch
+            self._session_resumes += 1
+            self.metrics.record_session_resume(
+                (time.perf_counter() - t0) * 1000)
         return True
 
     # ------------------------------------- disaggregated tier migration
@@ -1846,7 +2005,7 @@ class ServingEngine:
                         next_victim = None
                         if victim is None:
                             break
-                        self._preempt(victim)
+                        self._pressure_evict(victim)
                     if not self._admit(self.scheduler.pop()):
                         # the head stalled on KV pages or a shard-pinned
                         # slot.  A suitable victim may still unblock it
@@ -1858,7 +2017,7 @@ class ServingEngine:
                         # head is waiting on.
                         victim = self._pick_victim()
                         if victim is not None:
-                            self._preempt(victim)
+                            self._pressure_evict(victim)
                             continue
                         self._resume_parked()
                         break
@@ -2503,6 +2662,26 @@ class ServingEngine:
             self._ad_hits0 = ac.hits
             self._ad_misses0 = ac.misses
             self._ad_evictions0 = ac.evictions
+        session_gauges = {}
+        if self.session_store is not None:
+            # durable-session gauges + window counters ride every tick
+            # record when a store is attached (absent otherwise —
+            # records stay byte-stable with sessions off); the TTL
+            # sweep piggybacks here, rate-limited inside the store
+            expired = self.session_store.maybe_sweep()
+            if expired:
+                self._session_expires += expired
+                self.metrics.record_session_expire(expired)
+            st = self.session_store.stats()
+            session_gauges = dict(
+                sessions_parked_host=st["parked_host"],
+                sessions_parked_disk=st["parked_disk"],
+                sessions_bytes_host=st["bytes_host"],
+                sessions_bytes_disk=st["bytes_disk"],
+                session_parks=self._session_parks,
+                session_resumes=self._session_resumes,
+                session_expires=self._session_expires,
+            )
         quant_gauges = {}
         if self.quantized_weights or self.quantized_kv:
             # int8 serving stamps its dtype pair + resident-bytes
@@ -2542,10 +2721,14 @@ class ServingEngine:
             **quant_gauges,
             **spec_gauges,
             **lora_gauges,
+            **session_gauges,
         )
         self._preemptions = 0
         self._migrations_out = 0
         self._migrations_in = 0
+        self._session_parks = 0
+        self._session_resumes = 0
+        self._session_expires = 0
         self._pending_stall_ms = 0.0
         self._pending_chunk_tokens = 0
         self._pending_chunk_real_tokens = 0

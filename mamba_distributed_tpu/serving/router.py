@@ -133,6 +133,14 @@ class RequestRouter:
         request routes to the prefill tier; None (default) takes
         ``cfg.disagg_prompt_threshold`` (0 = role-blind routing even
         if roles were assigned).
+      session_store: a ``serving.sessions.SessionStore`` backing the
+        durable-session surface (docs/SERVING.md "Durable sessions"):
+        ``park()``/``resume_parked()`` move whole streams between the
+        fabric and the store, and a drain with no accepting survivor
+        parks its displaced queue instead of stranding it.  Locally
+        constructed replicas additionally share the store as their
+        engines' pressure-park sink (the PR-9 valve).  None (default)
+        keeps every path byte-identical to the store-less fabric.
       engine_kw: forwarded to every ServingEngine (max_top_k,
         tokens_per_tick, prefill_tokens_per_tick, mesh, ...).
     """
@@ -142,7 +150,7 @@ class RequestRouter:
                  tracer=NULL_TRACER, replica_tracers=None,
                  retain_results: bool = True, roles=None,
                  disagg_prompt_threshold: int | None = None,
-                 replicas=None, **engine_kw):
+                 replicas=None, session_store=None, **engine_kw):
         if replicas is not None:
             # pre-built placement units — the cross-host service path
             # (serving/service/remote.RemoteReplica duck-types
@@ -182,6 +190,7 @@ class RequestRouter:
         self.cfg = cfg
         self.tracer = tracer
         self.retain_results = retain_results
+        self.session_store = session_store
         self.disagg_prompt_threshold = (
             cfg.disagg_prompt_threshold if disagg_prompt_threshold is None
             else disagg_prompt_threshold
@@ -209,7 +218,10 @@ class RequestRouter:
                     tracer=(replica_tracers[i] if replica_tracers
                             else tracer),
                     role=(roles[i] if roles else "mixed"),
-                    capacity=capacity, retain_results=False, **engine_kw,
+                    capacity=capacity, retain_results=False,
+                    **({} if session_store is None
+                       else {"session_store": session_store}),
+                    **engine_kw,
                 ))
         if self.disagg_prompt_threshold > 0:
             # threshold 0 keeps roles inert — no role filter AND no
@@ -224,6 +236,10 @@ class RequestRouter:
                         self._migrate_from(_src, tracked, package)
                     )
         self.migrations = 0  # successful cross-replica handoffs
+        # durable sessions: global id -> session id for streams a
+        # no-survivor drain parked instead of stranding (the caller's
+        # map from its in-flight ids to resumable sessions)
+        self.drain_parked: dict[int, str] = {}
         self._routed: dict[int, _Routed] = {}
         self._by_local: dict[tuple[int, int], _Routed] = {}
         self._next_id = 0
@@ -503,6 +519,139 @@ class RequestRouter:
             return True
         return False
 
+    # ------------------------------------------------------- durable sessions
+
+    def park(self, global_id: int, *, ttl_s: float | None = None) -> str:
+        """Park one in-flight stream into the session store
+        (docs/SERVING.md "Durable sessions"): the stream's replica
+        serializes it into the replica-unbound PARK artifact (the
+        migration artifact + the tokens already emitted), the router
+        forgets it, and the returned session id is the client's handle
+        to ``resume_parked`` — on ANY replica, later, bit-exactly.
+
+        Raises KeyError for an unknown/finished id, ValueError
+        (retriable) when the stream is not yet DECODE-resident on its
+        replica (still queued/prefilling — re-ask after a step), and
+        RuntimeError when the router has no session store."""
+        if self.session_store is None:
+            raise RuntimeError(
+                "this fabric has no session store (pass session_store= "
+                "or --state-dir); park/resume is off"
+            )
+        routed = self._routed.get(global_id)
+        if routed is None or routed.done:
+            raise KeyError(
+                f"no in-flight stream {global_id} to park (finished or "
+                f"never admitted)"
+            )
+        from mamba_distributed_tpu.serving.service import wire
+
+        rep = self.replicas[routed.replica_id]
+        with self.tracer.span("serving_park", request_id=global_id,
+                              trace=routed.trace_id,
+                              replica=routed.replica_id):
+            request, snap = rep.engine.park(routed.local_id)
+        sid = self.session_store.park({
+            "request": wire.encode_request_tree(request),
+            "snapshot": snap,
+            "emitted": routed.emitted,
+            "trace_id": routed.trace_id,
+        }, ttl_s=ttl_s)
+        self._by_local.pop((routed.replica_id, routed.local_id), None)
+        del self._routed[global_id]
+        return sid
+
+    def resume_parked(self, session_id: str) -> int:
+        """Re-admit a parked session under a FRESH global id: pops the
+        artifact from the store, places it on the lowest-``place_cost``
+        accepting replica (the normal cost — adapter affinity included;
+        any replica works, the artifact is replica-unbound) and
+        restores via ``submit_migrated``/the wire v4 ``resume_parked``
+        RPC.  The stream CONTINUES: its emitted-token prefix rides the
+        artifact, so subsequent TokenEvents carry the post-park
+        indices.  A queue-only session (a no-survivor drain parked it
+        before any prefill) re-places through normal admission.
+
+        KeyError = unknown/expired session, ``SessionStoreError`` =
+        corrupt frame (the store already skipped it); when every
+        accepting replica rejects the artifact the session is re-parked
+        under the SAME id before the error surfaces — a failed resume
+        never loses the session."""
+        if self.session_store is None:
+            raise RuntimeError(
+                "this fabric has no session store (pass session_store= "
+                "or --state-dir); park/resume is off"
+            )
+        payload = self.session_store.resume(session_id)
+        from mamba_distributed_tpu.serving.service import wire
+
+        request = wire.decode_request_tree(payload["request"])
+        snap = payload.get("snapshot")
+        routed = _Routed(request=request, global_id=self._next_id,
+                         trace_id=(payload.get("trace_id")
+                                   or mint_trace_id()))
+        routed.emitted = int(payload.get("emitted") or 0)
+        if self.retain_results and snap is not None:
+            routed.tokens = [int(t) for t in snap.get("new_tokens") or []]
+        try:
+            if snap is None:
+                # drain-parked before any prefill: a plain re-placement
+                self._place(routed)
+            else:
+                self._place_parked(routed, snap, session_id)
+        except Exception:
+            # the artifact is already OUT of the store — put it back
+            # under the same id so the caller can retry; a failed
+            # resume must never lose the session
+            self.session_store.park(payload, session_id=session_id)
+            raise
+        self._next_id += 1
+        self._routed[routed.global_id] = routed
+        return routed.global_id
+
+    def _place_parked(self, routed: _Routed, snap: dict,
+                      session_id: str) -> None:
+        """Least-``place_cost`` placement of a PARK artifact — the
+        normal cost WITH the request (a parked adapter-bound stream
+        converges back on workers holding its factors), restore via
+        the replica's parked-resume entry point (``resume_parked`` over
+        the wire, ``submit_migrated`` in process — same path)."""
+        cands = [r for r in self.replicas if r.accepting]
+        if not cands:
+            raise RuntimeError(
+                f"no accepting replicas (all draining or dead); session "
+                f"{session_id} stays parked"
+            )
+        ranked = sorted(((r.place_cost(routed.request), r) for r in cands),
+                        key=lambda cr: (cr[0], cr[1].replica_id))
+        last_err: Exception | None = None
+        for cost, rep in ranked:
+            attrs = dict(request_id=routed.global_id,
+                         trace=routed.trace_id, session=session_id,
+                         replica=rep.replica_id, cost=round(cost, 4))
+            prev_trace = routed.request.trace_id
+            routed.request.trace_id = routed.trace_id
+            try:
+                resume = getattr(rep.engine, "resume_parked", None)
+                if resume is None:
+                    resume = rep.engine.submit_migrated
+                with self.tracer.span("serving_resume_parked", **attrs):
+                    local_id = resume(routed.request, snap)
+            except ValueError as e:
+                # this replica can never hold the artifact (sharded
+                # page pool too narrow, adapter not registered) — try
+                # the next candidate
+                last_err = e
+                continue
+            finally:
+                routed.request.trace_id = prev_trace
+            routed.replica_id, routed.local_id = rep.replica_id, local_id
+            self._by_local[(rep.replica_id, local_id)] = routed
+            return
+        raise last_err if last_err is not None else RuntimeError(
+            f"no replica admitted parked session {session_id}"
+        )
+
     # ------------------------------------------------------------ lifecycle
 
     def drain(self, replica_id: int, *,
@@ -518,14 +667,38 @@ class RequestRouter:
         keeps stepping it.  Started work (resident slots, preemption
         snapshots, migrated-in artifacts) always finishes in place.
         Returns the re-placed global ids.  When no OTHER replica is
-        accepting, nothing is withdrawn (the drain still finishes its
-        queue locally — graceful degradation, never a stranded
-        request)."""
+        accepting: with a session store attached the displaced queue is
+        PARKED instead of stranded — each withdrawn request lands in
+        the store (``drain_parked`` maps its global id to the session
+        id, resumable on whatever replica comes back); without one,
+        nothing is withdrawn (the drain still finishes its queue
+        locally — graceful degradation, never a stranded request)."""
         rep = self.replicas[replica_id]
-        requeue = requeue_queued and any(
-            r.accepting for r in self.replicas if r is not rep
+        survivors = any(r.accepting for r in self.replicas if r is not rep)
+        requeue = requeue_queued and (
+            survivors or self.session_store is not None
         )
         withdrawn = rep.drain(requeue=requeue)
+        if requeue and not survivors:
+            # no accepting survivor: park the displaced queue instead
+            # of erroring out of _place (the satellite fix) — these
+            # requests never started, so the session is queue-only
+            # (no snapshot) and resume_parked re-places it fresh
+            from mamba_distributed_tpu.serving.service import wire
+
+            for local_id in withdrawn:
+                routed = self._by_local.pop((replica_id, local_id), None)
+                if routed is None:
+                    continue  # not router-managed (direct engine submit)
+                sid = self.session_store.park({
+                    "request": wire.encode_request_tree(routed.request),
+                    "snapshot": None,
+                    "emitted": routed.emitted,
+                    "trace_id": routed.trace_id,
+                })
+                self.drain_parked[routed.global_id] = sid
+                del self._routed[routed.global_id]
+            return []
         moved = []
         for local_id in withdrawn:
             routed = self._by_local.pop((replica_id, local_id), None)

@@ -126,6 +126,30 @@ class _EngineProxy:
         }, expect="submit_ack")
         return int(payload["request_id"])
 
+    def park(self, request_id: int) -> tuple:
+        """Wire v4: serialize one DECODE-resident stream on the worker
+        into the replica-unbound PARK artifact (docs/SERVING.md
+        "Durable sessions").  ValueError from the worker (not resident,
+        speculative verify pending) is retriable; the returned
+        ``(request, snapshot)`` is exactly the in-process
+        ``engine.park`` pair after a wire round-trip."""
+        payload = self._rep._rpc("park", {
+            "request_id": int(request_id),
+        }, expect="park_result")
+        return (wire.decode_request(payload["request"]),
+                wire.decode_tree(payload["snapshot"]))
+
+    def resume_parked(self, request, snapshot: dict, *,
+                      source_replica: int | None = None) -> int:
+        """Wire v4: re-admit a PARK artifact on this worker (any
+        replica works — the artifact is replica-unbound)."""
+        payload = self._rep._rpc("resume_parked", {
+            "request": wire.encode_request(request),
+            "snapshot": wire.encode_tree(snapshot),
+            "source_replica": source_replica,
+        }, expect="submit_ack")
+        return int(payload["request_id"])
+
 
 class RemoteReplica:
     """One worker process, as the router's placement unit."""

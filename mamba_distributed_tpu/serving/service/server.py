@@ -26,7 +26,19 @@ pin, which is why remote streams can be token-identical to solo
   POST /v1/resume        {"resume": "<cursor>"} -> the same SSE stream,
                          replayed from the cursor and continuing live;
                          version-skewed cursors 400 with the named
-                         UnknownWireVersionError, unknown streams 410
+                         UnknownWireVersionError, unknown streams 410.
+                         {"session": "<id>"} instead resumes a PARKED
+                         session (docs/SERVING.md "Durable sessions"):
+                         the artifact re-places on any replica and the
+                         stream CONTINUES from the park point; unknown/
+                         expired sessions 410, corrupt frames 410 with
+                         the named SessionStoreError
+  POST /v1/park          {"request_id": N, "ttl_s": null} -> park one
+                         in-flight stream into the session store (its
+                         slot and pages free immediately); replies
+                         {"session": "<id>"} — the resume handle.
+                         Not-yet-decoding streams 409 (retriable),
+                         unknown ids 404, no store configured 503
   GET  /healthz          fabric + per-replica health (heartbeat ages,
                          missed beats, lifecycle states)
   POST /drain/<replica>  graceful retire; queued-but-unplaced work
@@ -72,11 +84,20 @@ class FabricController(threading.Thread):
     """Single-threaded owner of the router; see module docstring."""
 
     def __init__(self, router, *, health=None, poll_s: float = 0.002,
-                 adapters: dict | None = None):
+                 adapters: dict | None = None,
+                 session_sweep_s: float = 5.0, emit=None):
         super().__init__(daemon=True, name="fabric-controller")
         self.router = router
         self.health = health
         self.poll_s = poll_s
+        # durable sessions: the background TTL sweeper's cadence over
+        # the router's session store (when one is attached) and the
+        # jsonl emitter its ``sessions_gc`` records land on (the same
+        # sink serve_fabric wires for serving_health records).  No
+        # store, or nothing expired, emits nothing — byte-stable.
+        self.session_sweep_s = session_sweep_s
+        self.emit = emit
+        self._next_session_sweep = time.monotonic() + session_sweep_s
         # multi-tenant LoRA: the front end's host-side factor store —
         # name -> {"factors": {target: {"A", "B"}}, "alpha": float|None}
         # (scripts/serve_fabric.py --adapter name=path fills it).
@@ -113,6 +134,39 @@ class FabricController(threading.Thread):
         def _do():
             sink: queue.Queue = queue.Queue()
             gid = self.router.submit(request)
+            self._sinks[gid] = sink
+            return gid, sink
+
+        return self.call(_do)
+
+    def park_session(self, global_id: int, ttl_s: float | None = None
+                     ) -> concurrent.futures.Future:
+        """Park one in-flight stream into the fabric's session store;
+        Future of the session id.  The stream's open SSE sink (if any)
+        ends with a ``finish_reason: "parked"`` marker carrying the id,
+        so an attached consumer learns its resume handle as the stream
+        closes."""
+
+        def _do():
+            sid = self.router.park(global_id, ttl_s=ttl_s)
+            sink = self._sinks.pop(global_id, None)
+            if sink is not None:
+                sink.put({"request_id": global_id, "done": True,
+                          "finish_reason": "parked", "session": sid})
+            return sid
+
+        return self.call(_do)
+
+    def resume_session(self, session_id: str) -> concurrent.futures.Future:
+        """Re-admit a parked session; Future of (global_id, sink
+        queue).  The stream CONTINUES from the park point — no replay
+        of tokens the client already has (the session id is the
+        client's proof it consumed them; the SSE cursor path covers
+        mid-stream re-attach)."""
+
+        def _do():
+            gid = self.router.resume_parked(session_id)
+            sink: queue.Queue = queue.Queue()
             self._sinks[gid] = sink
             return gid, sink
 
@@ -182,6 +236,7 @@ class FabricController(threading.Thread):
     def run(self) -> None:
         while not self._stop_requested.is_set():
             worked = self._drain_commands()
+            self._sweep_sessions()
             if self.health is not None:
                 try:
                     self.health.tick()
@@ -264,6 +319,31 @@ class FabricController(threading.Thread):
             return gid, sink
 
         return self.call(_do)
+
+    def _sweep_sessions(self) -> None:
+        """Background TTL GC over the router's session store (when one
+        is attached): rate-limited to ``session_sweep_s``, emits one
+        ``sessions_gc`` obs record per sweep that reaped anything.  A
+        sweep failure (a disk frame going bad under us) is counted by
+        the store, never fatal to the fabric loop."""
+        store = getattr(self.router, "session_store", None)
+        if store is None or time.monotonic() < self._next_session_sweep:
+            return
+        self._next_session_sweep = time.monotonic() + self.session_sweep_s
+        try:
+            expired = store.sweep()
+        except Exception:  # noqa: BLE001 — GC must never kill serving
+            return
+        if expired and self.emit is not None:
+            st = store.stats()
+            self.emit({
+                "kind": "sessions_gc", "t": time.time(),
+                "expired": expired,
+                "parked_host": st["parked_host"],
+                "parked_disk": st["parked_disk"],
+                "bytes_host": st["bytes_host"],
+                "bytes_disk": st["bytes_disk"],
+            })
 
     def _drain_commands(self) -> bool:
         worked = False
@@ -420,6 +500,8 @@ class FabricHTTPServer:
             await self._generate(body, writer)
         elif method == "POST" and path == "/v1/resume":
             await self._resume(body, writer)
+        elif method == "POST" and path == "/v1/park":
+            await self._park(body, writer)
         elif method == "GET" and path == "/healthz":
             snap = await asyncio.wrap_future(ctrl.call(self._health_payload))
             writer.write(_json_response("200 OK", snap))
@@ -470,6 +552,9 @@ class FabricHTTPServer:
                 for r in router.replicas
             },
         }
+        store = getattr(router, "session_store", None)
+        if store is not None:
+            payload["sessions"] = store.stats()
         if self.controller.health is not None:
             for rid, h in self.controller.health.snapshot().items():
                 payload["replicas"][str(rid)].update(h)
@@ -541,6 +626,44 @@ class FabricHTTPServer:
         await writer.drain()
         await self._stream_sse(writer, gid, sink)
 
+    async def _park(self, body: bytes,
+                    writer: asyncio.StreamWriter) -> None:
+        """POST /v1/park {"request_id": N, "ttl_s": null} — park one
+        in-flight stream into the session store (docs/SERVING.md
+        "Durable sessions"): its slot and pages free immediately, the
+        reply carries the session id, and ``POST /v1/resume
+        {"session": "<id>"}`` continues the stream later on ANY
+        replica.  Unknown ids 404; a stream still queued/prefilling
+        409s (retriable — re-ask after a tick); no store 503."""
+        try:
+            spec = json.loads(body.decode("utf-8"))
+            gid = int(spec["request_id"])
+            ttl_s = spec.get("ttl_s")
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            writer.write(_json_response(
+                "400 Bad Request", {"error": f"bad park body: {e}"}))
+            return
+        try:
+            sid = await asyncio.wrap_future(
+                self.controller.park_session(
+                    gid, None if ttl_s is None else float(ttl_s))
+            )
+        except KeyError as e:
+            writer.write(_json_response(
+                "404 Not Found", {"error": str(e).strip("'\"")}))
+            return
+        except ValueError as e:
+            # not yet DECODE-resident: the client may retry
+            writer.write(_json_response(
+                "409 Conflict", {"error": str(e), "retriable": True}))
+            return
+        except RuntimeError as e:
+            writer.write(_json_response(
+                "503 Service Unavailable", {"error": str(e)}))
+            return
+        writer.write(_json_response(
+            "200 OK", {"request_id": gid, "session": sid}))
+
     async def _resume(self, body: bytes,
                       writer: asyncio.StreamWriter) -> None:
         """POST /v1/resume {"resume": "<cursor>"} — re-attach an SSE
@@ -550,13 +673,28 @@ class FabricHTTPServer:
         stream, replays everything past the cursor, and keeps
         streaming.  A version-skewed cursor 400s with the NAMED
         ``UnknownWireVersionError``; an unknown stream 410s (resubmit —
-        same seed, same tokens)."""
+        same seed, same tokens).
+
+        {"session": "<id>"} instead resumes a PARKED session: the
+        artifact re-places on any accepting replica and the SSE stream
+        CONTINUES from the park point.  Unknown/expired sessions 410;
+        a corrupt frame 410s with the NAMED ``SessionStoreError`` (the
+        store already skipped the session)."""
         try:
             spec = json.loads(body.decode("utf-8"))
-            token = spec["resume"]
+            token = spec.get("resume")
+            session = spec.get("session")
+            if (token is None) == (session is None):
+                raise KeyError(
+                    "exactly one of 'resume' (an SSE cursor) or "
+                    "'session' (a park id) is required"
+                )
         except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
             writer.write(_json_response(
                 "400 Bad Request", {"error": f"bad resume body: {e}"}))
+            return
+        if session is not None:
+            await self._resume_session(str(session), writer)
             return
         try:
             gid, sink = await asyncio.wrap_future(
@@ -570,6 +708,39 @@ class FabricHTTPServer:
         except wire.WireError as e:
             writer.write(_json_response(
                 "400 Bad Request", {"error": f"bad resume token: {e}"}))
+            return
+        except KeyError as e:
+            writer.write(_json_response(
+                "410 Gone", {"error": str(e).strip("'\"")}))
+            return
+        except (ValueError, RuntimeError) as e:
+            writer.write(_json_response(
+                "409 Conflict" if isinstance(e, ValueError)
+                else "503 Service Unavailable", {"error": str(e)}))
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        await self._stream_sse(writer, gid, sink)
+
+    async def _resume_session(self, session_id: str,
+                              writer: asyncio.StreamWriter) -> None:
+        """The parked-session half of POST /v1/resume: re-admit the
+        artifact and stream the continuation."""
+        from mamba_distributed_tpu.serving.sessions import SessionStoreError
+
+        try:
+            gid, sink = await asyncio.wrap_future(
+                self.controller.resume_session(session_id)
+            )
+        except SessionStoreError as e:
+            # corrupt/truncated frame: the store skipped the session;
+            # the NAMED error reaches the client, never a crash
+            writer.write(_json_response(
+                "410 Gone",
+                {"error": str(e), "error_type": type(e).__name__}))
             return
         except KeyError as e:
             writer.write(_json_response(

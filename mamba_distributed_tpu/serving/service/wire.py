@@ -50,8 +50,15 @@ import numpy as np
 # engine VALIDATES (an older worker would silently serve the base
 # model for an adapter request: wrong tokens, not a missing feature),
 # and the worker RPC surface grew ``load_adapter`` (factor shipping
-# host->worker); skew fails through the same named error.
-WIRE_VERSION = 3
+# host->worker); skew fails through the same named error.  v4: durable
+# sessions — the worker RPC surface grew ``park`` (serialize a live
+# stream into the replica-unbound park artifact and free its slot)
+# and ``resume_parked`` (re-admit one, emitted tokens included); an
+# older peer would drop the request's parked continuation on the
+# floor, so park/resume against a v3 worker fails loudly through
+# UnknownWireVersionError instead of replaying tokens the client
+# already has.
+WIRE_VERSION = 4
 
 # one frame's hard ceiling (a hybrid migration artifact is page-count
 # sized — MBs, not GBs; anything bigger is a corrupt length prefix)
@@ -184,6 +191,49 @@ def decode_request(d: dict):
         eos_id=d.get("eos_id"),
         seed=d.get("seed", 0),
         key=key,
+        trace_id=d.get("trace_id"),
+        priority=d.get("priority"),
+        adapter=d.get("adapter"),
+    )
+
+
+def encode_request_tree(request) -> dict:
+    """A ``GenerationRequest`` as a PLAIN pytree — raw ndarrays, no
+    codec tags — the form that nests INSIDE a larger ``encode_tree``
+    payload (the durable-session PARK frame stores the request next to
+    its snapshot this way; ``encode_request`` output cannot nest there,
+    its tagged arrays collide with the tree codec's own tags)."""
+    d = {
+        "prompt_ids": np.asarray(request.prompt_ids, np.int32),
+        "max_new_tokens": int(request.max_new_tokens),
+        "top_k": int(request.top_k),
+        "temperature": float(request.temperature),
+        "eos_id": None if request.eos_id is None else int(request.eos_id),
+        "seed": int(request.seed),
+        "trace_id": request.trace_id,
+        "priority": request.priority,
+        "adapter": getattr(request, "adapter", None),
+    }
+    if request.key is not None:
+        d["key"] = np.asarray(request.resolve_key())
+    return d
+
+
+def decode_request_tree(d: dict):
+    """Invert ``encode_request_tree`` AFTER the tree codec has already
+    restored the arrays (a session frame's ``decode_session_frame`` /
+    a payload's ``decode_tree``)."""
+    from mamba_distributed_tpu.serving.scheduler import GenerationRequest
+
+    key = d.get("key")
+    return GenerationRequest(
+        prompt_ids=np.asarray(d["prompt_ids"], np.int32),
+        max_new_tokens=d["max_new_tokens"],
+        top_k=d["top_k"],
+        temperature=d["temperature"],
+        eos_id=d.get("eos_id"),
+        seed=d.get("seed", 0),
+        key=None if key is None else np.asarray(key),
         trace_id=d.get("trace_id"),
         priority=d.get("priority"),
         adapter=d.get("adapter"),

@@ -11,6 +11,13 @@ parity against:
   submit           -> submit_ack {request_id, stats} | error
   submit_migrated  -> submit_ack | error {retriable}  (wire-crossed
                       PR-10 migration artifact -> engine.submit_migrated)
+  park             -> park_result {request, snapshot} | error (wire v4:
+                      evict one DECODE-resident stream into the PARK
+                      artifact — the migration artifact + emitted
+                      tokens; docs/SERVING.md "Durable sessions")
+  resume_parked    -> submit_ack | error {retriable}  (wire v4: re-admit
+                      a PARK artifact on ANY replica; the emitted-token
+                      prefix rides the artifact so the stream CONTINUES)
   step             -> migrate_offer* -> step_result {events, stats}
   ping             -> pong {stats}              (heartbeat probe)
   drain            -> drain_ack {withdrawn, stats}
@@ -52,8 +59,9 @@ from mamba_distributed_tpu.serving.service import wire
 
 # message types the session dispatcher understands (anything else is a
 # named error back to the peer, never a hang)
-_HANDLED = ("hello", "submit", "submit_migrated", "step", "ping", "drain",
-            "replay", "load_adapter", "summary", "shutdown")
+_HANDLED = ("hello", "submit", "submit_migrated", "park", "resume_parked",
+            "step", "ping", "drain", "replay", "load_adapter", "summary",
+            "shutdown")
 
 
 # ------------------------------------------------------------- config I/O
@@ -285,6 +293,46 @@ class WorkerServer:
                 "request_id": local_id, "stats": self._stats(),
             })
         elif mtype == "submit_migrated":
+            try:
+                request = wire.decode_request(payload["request"])
+                snap = wire.decode_tree(payload["snapshot"])
+                local_id = rep.engine.submit_migrated(
+                    request, snap,
+                    source_replica=payload.get("source_replica"),
+                )
+            except Exception as e:  # noqa: BLE001
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "submit_ack", {
+                "request_id": local_id, "stats": self._stats(),
+            })
+        elif mtype == "park":
+            # wire v4: serialize one DECODE-resident stream into the
+            # replica-unbound PARK artifact and free its slot/pages.
+            # ValueError (not resident / verify pending) is retriable —
+            # the controller may re-ask after the next step.
+            try:
+                request, snap = rep.engine.park(
+                    int(payload.get("request_id", -1))
+                )
+            except Exception as e:  # noqa: BLE001 — serialized back
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "park_result", {
+                "request": wire.encode_request(request),
+                "snapshot": wire.encode_tree(snap),
+                "stats": self._stats(),
+            })
+        elif mtype == "resume_parked":
+            # wire v4: re-admit a PARK artifact here — same restore
+            # path as a migration (zero prefill compute), plus the
+            # artifact's emitted-token prefix so the stream CONTINUES
             try:
                 request = wire.decode_request(payload["request"])
                 snap = wire.decode_tree(payload["snapshot"])

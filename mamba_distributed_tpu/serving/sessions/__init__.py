@@ -1,0 +1,22 @@
+"""Durable session fabric: tiered park/resume store (docs/SERVING.md
+"Durable sessions").  The migration artifact is the canonical PARK
+format; parked sessions cost zero device memory and resume bit-exactly
+on any replica."""
+
+from .store import (
+    SESSION_FORMAT_VERSION,
+    DiskSessionStore,
+    SessionStore,
+    SessionStoreError,
+    decode_session_frame,
+    encode_session_frame,
+)
+
+__all__ = [
+    "SESSION_FORMAT_VERSION",
+    "DiskSessionStore",
+    "SessionStore",
+    "SessionStoreError",
+    "decode_session_frame",
+    "encode_session_frame",
+]

@@ -1,0 +1,380 @@
+"""Durable session store: tiered park/resume for idle conversations.
+
+At real user scale most sessions are idle between turns, yet a live
+stream pins a slot, KV pages and (hybrid) page-pool budget on device —
+the fabric's user population is hard-capped by its slot count.  This
+module is the capacity multiplier: a PARKED session is the existing
+migration artifact (O(1) conv/SSM carry + last logits + serialized KV
+page contents + the emitted tokens) moved off-device into a tiered
+store, so it costs ZERO device memory and resumes bit-exactly — on the
+same replica, a different one, or after a worker restart (physical
+page ids never appear in the artifact, which is what makes it
+replica-unbound by construction).
+
+Tiers:
+
+  device slot   -> live stream (status quo; not this module's concern)
+  host RAM      -> ``SessionStore``'s LRU dict of encoded frames
+  disk          -> ``DiskSessionStore``: one wire-encoded frame per
+                   session under ``--state-dir``, CRC + format-version
+                   checked on load, atomic tmp+rename writes
+
+The PARK FRAME is ``wire.encode_tree`` of the payload (the same codec
+every cross-host message rides on — treedef-, dtype- and bit-exact,
+bf16/int8 included) behind a small binary header::
+
+    magic 'MDSF' | u16 format version | u32 crc32(body) | u32 len | body
+
+A frame that fails the magic/version/CRC/length check surfaces the
+NAMED ``SessionStoreError`` — resume callers map it to a client error
+and the sweeper skips (and drops) the frame instead of crashing.
+
+TTL: ``ttl_s > 0`` stamps an absolute wall-clock deadline into each
+frame (wall clock, not ``perf_counter`` — deadlines must survive a
+process restart); ``sweep()`` expires past-deadline sessions in both
+tiers.  Pressure-parked engine streams park with ``ttl_s=0`` (their
+tracker, still queued, owns their lifetime).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import uuid
+import zlib
+from collections import OrderedDict
+
+from ..service import wire
+
+__all__ = [
+    "SessionStoreError",
+    "DiskSessionStore",
+    "SessionStore",
+    "SESSION_FORMAT_VERSION",
+    "encode_session_frame",
+    "decode_session_frame",
+]
+
+
+class SessionStoreError(RuntimeError):
+    """A session frame failed its integrity/version check (corrupted,
+    truncated, or written by an unknown store generation).  NAMED so
+    callers can skip the one bad session instead of crashing the
+    sweep, and so the HTTP front end maps it to a client error."""
+
+
+SESSION_MAGIC = b"MDSF"
+SESSION_FORMAT_VERSION = 1
+_HEADER = struct.Struct(">4sHII")  # magic, version, crc32, body length
+
+
+def encode_session_frame(payload: dict) -> bytes:
+    """One self-verifying session frame: header + the wire-codec body
+    (``wire.encode_tree`` — the bit-exact tree codec the migration
+    artifact already rides, so a disk round-trip can never perturb a
+    resumed stream)."""
+    body = json.dumps(wire.encode_tree(payload)).encode("utf-8")
+    return _HEADER.pack(
+        SESSION_MAGIC, SESSION_FORMAT_VERSION,
+        zlib.crc32(body) & 0xFFFFFFFF, len(body),
+    ) + body
+
+
+def decode_session_frame(frame: bytes) -> dict:
+    """Verify + decode one frame; raises the NAMED ``SessionStoreError``
+    on any corruption (bad magic, unknown version, short body, CRC
+    mismatch) — never a misparse."""
+    if len(frame) < _HEADER.size:
+        raise SessionStoreError(
+            f"session frame truncated: {len(frame)} bytes < "
+            f"{_HEADER.size}-byte header"
+        )
+    magic, version, crc, length = _HEADER.unpack(frame[:_HEADER.size])
+    if magic != SESSION_MAGIC:
+        raise SessionStoreError(
+            f"bad session frame magic {magic!r} (want {SESSION_MAGIC!r})"
+        )
+    if version != SESSION_FORMAT_VERSION:
+        raise SessionStoreError(
+            f"unknown session frame version {version} (this store "
+            f"speaks {SESSION_FORMAT_VERSION})"
+        )
+    body = frame[_HEADER.size:]
+    if len(body) != length:
+        raise SessionStoreError(
+            f"session frame truncated: body {len(body)} bytes, header "
+            f"promised {length}"
+        )
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise SessionStoreError("session frame CRC mismatch (corrupted)")
+    try:
+        return wire.decode_tree(json.loads(body.decode("utf-8")))
+    except (ValueError, wire.WireError) as e:
+        raise SessionStoreError(f"session frame body undecodable: {e}")
+
+
+class DiskSessionStore:
+    """The disk tier: one frame file per session id under
+    ``state_dir`` (created if missing).  Writes are atomic
+    (tmp + rename), so a crash mid-park never leaves a half frame
+    under a live session id.  Construction rescans the directory —
+    sessions parked by a previous process incarnation are immediately
+    resumable (the worker-restart durability half of the tentpole)."""
+
+    SUFFIX = ".session"
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        # sid -> frame bytes on disk (sizes from the rescan; content
+        # is only read back — and only then CRC-checked — on get())
+        self._sizes: dict[str, int] = {}
+        for name in os.listdir(state_dir):
+            if name.endswith(self.SUFFIX):
+                sid = name[: -len(self.SUFFIX)]
+                self._sizes[sid] = os.path.getsize(
+                    os.path.join(state_dir, name))
+
+    def _path(self, sid: str) -> str:
+        return os.path.join(self.state_dir, sid + self.SUFFIX)
+
+    def put(self, sid: str, frame: bytes) -> None:
+        tmp = self._path(sid) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame)
+        os.replace(tmp, self._path(sid))
+        self._sizes[sid] = len(frame)
+
+    def get(self, sid: str) -> bytes:
+        """Raw frame bytes; ``KeyError`` for an unknown session."""
+        if sid not in self._sizes:
+            raise KeyError(sid)
+        try:
+            with open(self._path(sid), "rb") as f:
+                return f.read()
+        except OSError:
+            self._sizes.pop(sid, None)
+            raise KeyError(sid)
+
+    def delete(self, sid: str) -> None:
+        self._sizes.pop(sid, None)
+        try:
+            os.unlink(self._path(sid))
+        except OSError:
+            pass
+
+    def ids(self) -> list[str]:
+        return list(self._sizes)
+
+    def __contains__(self, sid: str) -> bool:
+        return sid in self._sizes
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(self._sizes.values())
+
+
+class SessionStore:
+    """The tiered park/resume store: a host-RAM LRU of encoded frames
+    in front of an optional ``DiskSessionStore``.
+
+    * ``host_bytes > 0`` caps the RAM tier: parks land hot, and the
+      least-recently-touched frames DEMOTE to disk when the cap is
+      exceeded (no disk -> the oldest frames simply stay resident;
+      a byte cap without a disk tier would have to drop sessions).
+    * ``host_bytes == 0`` with a disk tier is write-through: every
+      park goes straight to disk (the durable default for workers).
+    * neither -> a plain in-memory dict (tests, single-process use).
+
+    ``park`` -> session id; ``resume`` removes and returns the payload
+    (a parked session is single-resume by design: the resuming engine
+    owns the stream again).  ``sweep`` expires TTL-past sessions in
+    both tiers, SKIPPING corrupted disk frames (dropped + counted, per
+    the ``SessionStoreError`` contract).  All methods are
+    thread-safe — the HTTP front end parks from handler threads while
+    the controller thread resumes.
+    """
+
+    def __init__(self, *, ttl_s: float = 0.0, host_bytes: int = 0,
+                 disk: DiskSessionStore | None = None, clock=time.time):
+        if ttl_s < 0:
+            raise ValueError(f"ttl_s must be >= 0, got {ttl_s}")
+        if host_bytes < 0:
+            raise ValueError(f"host_bytes must be >= 0, got {host_bytes}")
+        self.ttl_s = float(ttl_s)
+        self.host_bytes = int(host_bytes)
+        self.disk = disk
+        self._clock = clock
+        self._lock = threading.Lock()
+        # sid -> frame bytes, LRU order (last = most recently touched)
+        self._host: OrderedDict[str, bytes] = OrderedDict()
+        # sid -> absolute wall-clock deadline (0 = never), both tiers;
+        # disk frames parked by a PREVIOUS incarnation are absent here
+        # and carry their deadline inside the frame instead
+        self._deadlines: dict[str, float] = {}
+        self._host_nbytes = 0
+        self._next_sweep = 0.0
+        self.parks = 0
+        self.resumes = 0
+        self.expires = 0
+        self.corrupt_skipped = 0
+
+    # ------------------------------------------------------------ tiers
+
+    def _demote_lru(self) -> None:
+        """Move least-recently-touched host frames to disk until the
+        RAM tier fits its byte budget (lock held)."""
+        if self.disk is None:
+            return
+        while self._host and (
+            self._host_nbytes > self.host_bytes or self.host_bytes == 0
+        ):
+            sid, frame = self._host.popitem(last=False)
+            self._host_nbytes -= len(frame)
+            self.disk.put(sid, frame)
+
+    def park(self, payload: dict, *, session_id: str | None = None,
+             ttl_s: float | None = None) -> str:
+        """Store one session payload; returns its session id.  The
+        frame carries its own absolute expiry deadline (``ttl_s``
+        overrides the store default; 0 = never expire — what the
+        engine's pressure valve uses, since the queued tracker owns
+        that session's lifetime)."""
+        sid = session_id or uuid.uuid4().hex
+        ttl = self.ttl_s if ttl_s is None else float(ttl_s)
+        deadline = self._clock() + ttl if ttl > 0 else 0.0
+        frame = encode_session_frame(
+            {"expires_at": deadline or None, "data": payload})
+        with self._lock:
+            self._drop_locked(sid)  # re-park under the same id replaces
+            self._host[sid] = frame
+            self._host_nbytes += len(frame)
+            self._deadlines[sid] = deadline
+            self._demote_lru()
+            self.parks += 1
+        return sid
+
+    def resume(self, sid: str) -> dict:
+        """Remove + return one parked payload.  ``KeyError`` for an
+        unknown/expired session; the NAMED ``SessionStoreError`` (with
+        the bad frame dropped, so retries don't re-hit it) for a frame
+        that fails its integrity check."""
+        with self._lock:
+            frame = self._host.pop(sid, None)
+            if frame is not None:
+                self._host_nbytes -= len(frame)
+            elif self.disk is not None and sid in self.disk:
+                try:
+                    frame = self.disk.get(sid)
+                finally:
+                    self.disk.delete(sid)
+            self._deadlines.pop(sid, None)
+            if frame is None:
+                raise KeyError(f"unknown session {sid!r}")
+            try:
+                record = decode_session_frame(frame)
+            except SessionStoreError:
+                self.corrupt_skipped += 1
+                raise
+            deadline = record.get("expires_at")
+            if deadline and self._clock() >= deadline:
+                self.expires += 1
+                raise KeyError(f"session {sid!r} expired")
+            self.resumes += 1
+            return record["data"]
+
+    def _drop_locked(self, sid: str) -> None:
+        frame = self._host.pop(sid, None)
+        if frame is not None:
+            self._host_nbytes -= len(frame)
+        if self.disk is not None and sid in self.disk:
+            self.disk.delete(sid)
+        self._deadlines.pop(sid, None)
+
+    def drop(self, sid: str) -> None:
+        """Discard a parked session (no error if unknown)."""
+        with self._lock:
+            self._drop_locked(sid)
+
+    def __contains__(self, sid: str) -> bool:
+        with self._lock:
+            return sid in self._host or (
+                self.disk is not None and sid in self.disk)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._host) + (
+                len(self.disk) if self.disk is not None else 0)
+
+    # ------------------------------------------------------------ sweep
+
+    def sweep(self, now: float | None = None) -> int:
+        """Expire every session past its deadline; returns the count.
+        Disk frames from a previous incarnation (no in-memory deadline)
+        are decoded to read their embedded deadline; a frame that fails
+        its integrity check is SKIPPED — dropped and counted in
+        ``corrupt_skipped`` — never a crash."""
+        now = self._clock() if now is None else now
+        expired = 0
+        with self._lock:
+            for sid, deadline in list(self._deadlines.items()):
+                if deadline and now >= deadline:
+                    self._drop_locked(sid)
+                    expired += 1
+            if self.disk is not None:
+                for sid in self.disk.ids():
+                    if sid in self._deadlines:
+                        continue  # handled above
+                    try:
+                        record = decode_session_frame(self.disk.get(sid))
+                    except KeyError:
+                        continue
+                    except SessionStoreError:
+                        self.disk.delete(sid)
+                        self.corrupt_skipped += 1
+                        continue
+                    deadline = record.get("expires_at") or 0.0
+                    self._deadlines[sid] = deadline
+                    if deadline and now >= deadline:
+                        self._drop_locked(sid)
+                        expired += 1
+            self.expires += expired
+        return expired
+
+    def maybe_sweep(self, now: float | None = None,
+                    interval_s: float = 1.0) -> int:
+        """Rate-limited ``sweep`` for per-tick callers: a no-op (0)
+        unless TTL is on and ``interval_s`` has passed since the last
+        sweep."""
+        if self.ttl_s <= 0:
+            return 0
+        now = self._clock() if now is None else now
+        if now < self._next_sweep:
+            return 0
+        self._next_sweep = now + interval_s
+        return self.sweep(now)
+
+    # ------------------------------------------------------------ stats
+
+    def stats(self) -> dict:
+        """Tier gauges + lifetime counters (the ``summary()["sessions"]``
+        and tick-record feed)."""
+        with self._lock:
+            return {
+                "parked_host": len(self._host),
+                "parked_disk": (len(self.disk)
+                                if self.disk is not None else 0),
+                "bytes_host": self._host_nbytes,
+                "bytes_disk": (self.disk.nbytes
+                               if self.disk is not None else 0),
+                "parks": self.parks,
+                "resumes": self.resumes,
+                "expires": self.expires,
+                "corrupt_skipped": self.corrupt_skipped,
+            }

@@ -252,6 +252,21 @@ class ServingMetrics:
         self._adapter_ticks = 0
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
+        # durable sessions (serving/sessions/store.py): the engine
+        # calls configure_sessions() when a session store is attached,
+        # unlocking summary()["sessions"] — park/resume/expire totals,
+        # the per-resume restore latency histogram and the last-seen
+        # tier gauges (host entries/bytes vs disk entries/bytes).  Off
+        # by default so store-less summaries/records stay byte-stable.
+        self._sessions_on = False
+        self.session_parks = 0
+        self.session_resumes = 0
+        self.session_expires = 0
+        self.session_resume_ms = StreamingHistogram()
+        self.sessions_parked_host: int | None = None
+        self.sessions_parked_disk: int | None = None
+        self.sessions_bytes_host: int | None = None
+        self.sessions_bytes_disk: int | None = None
         # disaggregated prefill/decode handoffs (docs/SERVING.md
         # "Disaggregated tiers"): migrations OUT of this engine (a
         # prefill replica exporting its finished carry) vs IN (a
@@ -403,6 +418,29 @@ class ServingMetrics:
         self.migrations_in += 1
         self.migration_ms.record(dt_ms)
 
+    # ---------------------------------------------------- durable sessions
+
+    def configure_sessions(self) -> None:
+        """Mark the durable session store live (engine construction):
+        ``summary()`` gains its ``sessions`` section and tick records
+        their session stamps (docs/SERVING.md "Durable sessions")."""
+        self._sessions_on = True
+
+    def record_session_park(self) -> None:
+        """One live stream serialized into the session store (explicit
+        ``park()`` or the admission valve's pressure park)."""
+        self.session_parks += 1
+
+    def record_session_resume(self, dt_ms: float) -> None:
+        """One parked session restored into a slot here; ``dt_ms`` is
+        the restore's host latency (store read + decode + dispatch)."""
+        self.session_resumes += 1
+        self.session_resume_ms.record(dt_ms)
+
+    def record_session_expire(self, n: int = 1) -> None:
+        """``n`` parked sessions reaped by the TTL sweeper."""
+        self.session_expires += n
+
     # ------------------------------------------------- per-request latency
 
     def record_queue_wait(self, dt_s: float) -> None:
@@ -461,6 +499,13 @@ class ServingMetrics:
         adapter_cache_misses: int = 0,
         adapter_cache_evictions: int = 0,
         adapters_live: int = 0,
+        sessions_parked_host: int | None = None,
+        sessions_parked_disk: int | None = None,
+        sessions_bytes_host: int | None = None,
+        sessions_bytes_disk: int | None = None,
+        session_parks: int = 0,
+        session_resumes: int = 0,
+        session_expires: int = 0,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -623,6 +668,24 @@ class ServingMetrics:
                 "adapter_cache_evictions": adapter_cache_evictions,
                 "adapters_live": adapters_live,
             })
+        if sessions_parked_host is not None:
+            # durable-session gauges (stamped only when a session
+            # store is attached — store-less records stay byte-stable):
+            # tier occupancy at this tick plus this window's
+            # park/resume/expire churn
+            self.sessions_parked_host = sessions_parked_host
+            self.sessions_parked_disk = sessions_parked_disk
+            self.sessions_bytes_host = sessions_bytes_host
+            self.sessions_bytes_disk = sessions_bytes_disk
+            record.update({
+                "sessions_parked_host": sessions_parked_host,
+                "sessions_parked_disk": sessions_parked_disk,
+                "sessions_bytes_host": sessions_bytes_host,
+                "sessions_bytes_disk": sessions_bytes_disk,
+                "session_parks": session_parks,
+                "session_resumes": session_resumes,
+                "session_expires": session_expires,
+            })
         if compaction_width is not None:
             # occupancy-adaptive compaction stamp (only when the engine
             # has compaction on — records stay byte-stable otherwise):
@@ -768,6 +831,16 @@ class ServingMetrics:
                           / self._adapter_ticks, 2)
                     if self._adapter_ticks else None
                 ),
+            }),
+            "sessions": (None if not self._sessions_on else {
+                "parked_host": self.sessions_parked_host,
+                "parked_disk": self.sessions_parked_disk,
+                "bytes_host": self.sessions_bytes_host,
+                "bytes_disk": self.sessions_bytes_disk,
+                "parks": self.session_parks,
+                "resumes": self.session_resumes,
+                "expires": self.session_expires,
+                "resume_ms": self.session_resume_ms.summary(),
             }),
             "memory": (None if not self._memory_on else {
                 "weight_bytes": self.weight_bytes,

@@ -739,6 +739,19 @@ def main() -> None:
     ap.add_argument("--lora-rank", type=int, default=8, metavar="R",
                     help="low-rank dimension for --lora-adapters "
                          "(cfg.lora_rank)")
+    ap.add_argument("--park", action="store_true",
+                    help="durable-session park/resume headline "
+                         "(docs/SERVING.md 'Durable sessions'): "
+                         "SERVE_PARK_WAVES (4) x SERVE_CAPACITY streams "
+                         "served by ONE capacity-slot engine by parking "
+                         "every wave mid-decode into a disk-backed "
+                         "SessionStore, then resuming each session to "
+                         "completion; token streams asserted identical "
+                         "to a never-parked engine.  The value is "
+                         "sessions-per-slot (conversations one slot "
+                         "pool sustained) — the BENCH_SERVING.json "
+                         "park_resume row, gated via bench_gate.py "
+                         "--case park_resume_cpu")
     ap.add_argument("--spec-drafter", default="ngram",
                     choices=["ngram", "model"],
                     help="drafter for --spec-tokens: 'ngram' (prompt-"
@@ -755,6 +768,7 @@ def main() -> None:
                              ("--spec-tokens", bool(args.spec_tokens)),
                              ("--lora-adapters", bool(args.lora_adapters)),
                              ("--service", args.service),
+                             ("--park", args.park),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
         ap.error(f"{' and '.join(modes)} are separate bench modes; "
@@ -864,6 +878,142 @@ def main() -> None:
             jax.block_until_ready(out)
         dt_seq = time.perf_counter() - t0
         return served, dt_serve, dt_seq, metrics.summary(), results
+
+    if args.park:
+        # durable-session park/resume: SERVE_PARK_WAVES x capacity
+        # streams through ONE capacity-slot engine.  Each wave decodes
+        # its first token(s), parks into a disk-backed SessionStore
+        # (the full wire-framed round trip: encode_request_tree +
+        # migration artifact -> PARK frame on disk), and frees every
+        # slot for the next wave; once all waves are parked the
+        # sessions resume through submit_migrated and run to
+        # completion.  Parity oracle: the identical requests through a
+        # never-parked engine — the streams must be token-identical.
+        import tempfile
+
+        from mamba_distributed_tpu.serving import (
+            DiskSessionStore,
+            GenerationRequest,
+            SessionStore,
+        )
+        from mamba_distributed_tpu.serving.scheduler import RequestStatus
+        from mamba_distributed_tpu.serving.service import wire
+
+        waves = int(os.environ.get("SERVE_PARK_WAVES", "4"))
+        n_total = waves * capacity
+        requests = _workload(rng, n_total, pmin, pmax, max_new,
+                             cfg.vocab_size)
+
+        def fresh(rs):
+            return [GenerationRequest(
+                prompt_ids=np.asarray(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens, seed=r.seed,
+            ) for r in rs]
+
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        # parity oracle + warmup: the identical requests straight
+        # through a never-parked engine (unique per-request seeds key
+        # the reference streams)
+        ref_results = ServingEngine(params, cfg, **kw).run(fresh(requests))
+        ref = {requests[i].seed: [int(t) for t in res.new_tokens]
+               for i, res in enumerate(ref_results)}
+        _progress(f"reference run done ({len(ref)} streams)")
+
+        state_dir = tempfile.mkdtemp(prefix="bench_park_")
+        store = SessionStore(disk=DiskSessionStore(state_dir))
+        metrics = ServingMetrics(capacity, jsonl_path=args.jsonl)
+        engine = ServingEngine(params, cfg, metrics=metrics,
+                               session_store=store, **kw)
+
+        def park_ready(rid):
+            """The wave member's tracker once it is parkable (DECODE
+            with at least one emitted token), else None."""
+            t = next((t for t in engine._slots.values()
+                      if t.request_id == rid), None)
+            if (t is not None and t.status is RequestStatus.DECODE
+                    and len(t.new_tokens) >= 1):
+                return t
+            return None
+
+        rid2seed = {}
+        sids = []  # (session_id, seed) in park order
+        t0 = time.perf_counter()
+        for w in range(waves):
+            wave = fresh(requests[w * capacity:(w + 1) * capacity])
+            live = set()
+            for r in wave:
+                rid = engine.submit(r)
+                rid2seed[rid] = r.seed
+                live.add(rid)
+            while live:
+                engine.step()
+                for rid in list(live):
+                    if rid in engine.results:  # beat the park to EOS
+                        live.discard(rid)
+                        continue
+                    if park_ready(rid) is None:
+                        continue
+                    req, snap = engine.park(rid)
+                    sid = store.park({
+                        "request": wire.encode_request_tree(req),
+                        "snapshot": snap,
+                    })
+                    sids.append((sid, rid2seed.pop(rid)))
+                    live.discard(rid)
+            _progress(f"wave {w}: {len(sids)} total parked")
+        t_park = time.perf_counter() - t0
+        st_peak = store.stats()
+
+        resume_ms = []
+        for sid, seed in sids:
+            t1 = time.perf_counter()
+            payload = store.resume(sid)
+            req = wire.decode_request_tree(payload["request"])
+            rid = engine.submit_migrated(req, payload["snapshot"])
+            resume_ms.append((time.perf_counter() - t1) * 1000)
+            rid2seed[rid] = seed
+        for _ in engine.serve():
+            pass
+        t_total = time.perf_counter() - t0
+
+        mismatches = [seed for rid, seed in rid2seed.items()
+                      if [int(t) for t in engine.results[rid].new_tokens]
+                      != ref[seed]]
+        if mismatches:
+            raise SystemExit(
+                f"park/resume parity broke for seeds {mismatches}: "
+                f"resumed streams must be token-identical to the "
+                f"never-parked reference"
+            )
+        _progress(f"parity OK: {len(rid2seed)} streams token-identical "
+                  f"across the disk round trip")
+
+        sessions_per_slot = round(len(sids) / capacity, 2)
+        record = {
+            "metric": (f"serving_park_sessions_per_slot_"
+                       f"{preset.replace('-', '_')}"),
+            "value": sessions_per_slot,
+            "unit": ("parked sessions sustained per device slot "
+                     "(disk tier, zero device memory while parked)"),
+            "sessions_parked": len(sids),
+            "capacity": capacity,
+            "waves": waves,
+            "requests": n_total,
+            "parked_disk_peak": st_peak["parked_disk"],
+            "bytes_disk_peak": st_peak["bytes_disk"],
+            "resume_ms_p50": (round(float(np.percentile(resume_ms, 50)), 3)
+                              if resume_ms else None),
+            "resume_ms_p95": _p95(resume_ms),
+            "park_wall_s": round(t_park, 3),
+            "total_wall_s": round(t_total, 3),
+            "parity": "token-identical vs never-parked engine",
+            "prompt_len_range": [pmin, pmax],
+            "max_new_tokens": max_new,
+            "tokens_per_tick": tokens_per_tick,
+            "device": dev.device_kind,
+        }
+        emit_bench_record(record, args.json)
+        return
 
     if args.spec_tokens:
         # speculative decoding: a REPETITIVE-SUFFIX greedy workload

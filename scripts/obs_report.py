@@ -288,6 +288,32 @@ def build_report(events: list[dict]) -> dict:
         # wrote the stream): adapter-cache churn totals, last residency
         # gauge and the per-tick distinct-adapter peak (docs/SERVING.md
         # "Multi-tenant LoRA")
+        # durable-session gauges (absent unless a session-store engine
+        # wrote the stream): park/resume/expire totals from the tick
+        # windows, last tier-occupancy gauges, plus the background
+        # sweeper's sessions_gc reap count (docs/SERVING.md "Durable
+        # sessions")
+        sticks = [e for e in ticks
+                  if e.get("sessions_parked_host") is not None]
+        sessions = None
+        if sticks:
+            last = sticks[-1]
+            sessions = {
+                "parked_host": last["sessions_parked_host"],
+                "parked_disk": last.get("sessions_parked_disk"),
+                "bytes_host": last.get("sessions_bytes_host"),
+                "bytes_disk": last.get("sessions_bytes_disk"),
+                "parks": sum(e.get("session_parks", 0) for e in sticks),
+                "resumes": sum(
+                    e.get("session_resumes", 0) for e in sticks),
+                "expires": sum(
+                    e.get("session_expires", 0) for e in sticks),
+                "gc_sweeps": sum(
+                    1 for e in events if e.get("kind") == "sessions_gc"),
+                "gc_expired": sum(
+                    e.get("expired", 0) for e in events
+                    if e.get("kind") == "sessions_gc"),
+            }
         aticks = [e for e in ticks
                   if e.get("adapters_resident") is not None]
         adapters = None
@@ -326,6 +352,7 @@ def build_report(events: list[dict]) -> dict:
             "compaction": compaction,
             "speculation": speculation,
             "adapters": adapters,
+            "sessions": sessions,
             "preemptions": preemptions,
             "migrations": {"handoffs": handoffs} if handoffs else None,
             "kv_pages": kv_pages,
@@ -675,6 +702,15 @@ def format_report(report: dict) -> str:
                 f"{a['cache_hits']} hits / {a['cache_misses']} misses / "
                 f"{a['cache_evictions']} evictions   peak live/tick: "
                 f"{a['peak_live']}"
+            )
+        if s.get("sessions"):
+            se = s["sessions"]
+            head += (
+                f"\nsessions: {se['parked_host']} host / "
+                f"{_fmt(se['parked_disk'])} disk parked   "
+                f"{se['parks']} parks / {se['resumes']} resumes / "
+                f"{se['expires']} expired   gc: {se['gc_sweeps']} sweeps "
+                f"({se['gc_expired']} reaped)"
             )
         if s.get("preemptions"):
             head += f"\npreemptions: {s['preemptions']}"

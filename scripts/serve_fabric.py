@@ -137,6 +137,14 @@ def main() -> int:
                     help="fabric serving_health record stream")
     ap.add_argument("--spans", default=None, metavar="PATH",
                     help="router span stream (trace_export.py input)")
+    ap.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="durable session store for the fabric "
+                         "(docs/SERVING.md 'Durable sessions'): "
+                         "POST /v1/park serializes streams here and "
+                         "POST /v1/resume {'session': id} re-admits "
+                         "them on any worker; sessions survive front-"
+                         "end restarts.  TTL/budget come from "
+                         "cfg.session_ttl_s and cfg.session_host_bytes")
     args = ap.parse_args()
 
     from mamba_distributed_tpu.obs import (
@@ -195,12 +203,25 @@ def main() -> int:
                 ap.error(f"--adapter expects NAME=PATH, got {spec!r}")
             adapter_store[name] = {"factors": load_adapter_file(path),
                                    "alpha": None}
+    session_store = None
+    if args.state_dir:
+        from mamba_distributed_tpu.serving.sessions import (
+            DiskSessionStore,
+            SessionStore,
+        )
+
+        session_store = SessionStore(
+            ttl_s=float(cfg.session_ttl_s),
+            host_bytes=int(cfg.session_host_bytes),
+            disk=DiskSessionStore(args.state_dir),
+        )
     router = RequestRouter(None, cfg, replicas=replicas, tracer=tracer,
-                           retain_results=False)
+                           retain_results=False,
+                           session_store=session_store)
     health = HeartbeatMonitor(router, interval_ms=args.heartbeat_ms,
                               miss_threshold=args.miss_threshold, emit=emit)
     controller = FabricController(router, health=health,
-                                  adapters=adapter_store)
+                                  adapters=adapter_store, emit=emit)
     controller.start()
     http = FabricHTTPServer(controller, args.http_host, args.http_port)
     port = http.start_background()

@@ -71,6 +71,14 @@ def main() -> int:
     ap.add_argument("--spans", default=None, metavar="PATH",
                     help="this replica's span stream (trace_export.py "
                          "merges it with the server's)")
+    ap.add_argument("--state-dir", default=None, metavar="DIR",
+                    help="durable session store for this engine "
+                         "(docs/SERVING.md 'Durable sessions'): the "
+                         "admission valve PARKS displaced streams here "
+                         "instead of holding them in host RAM, and "
+                         "park/resume_parked RPCs round-trip through "
+                         "it.  TTL/budget come from cfg.session_ttl_s "
+                         "and cfg.session_host_bytes")
     args = ap.parse_args()
 
     import jax
@@ -116,6 +124,17 @@ def main() -> int:
                 ap.error(f"--adapter expects NAME=PATH, got {spec!r}")
             registry.register(name, load_adapter_file(path))
         engine_kw["adapters"] = registry
+    if args.state_dir:
+        from mamba_distributed_tpu.serving.sessions import (
+            DiskSessionStore,
+            SessionStore,
+        )
+
+        engine_kw["session_store"] = SessionStore(
+            ttl_s=float(cfg.session_ttl_s),
+            host_bytes=int(cfg.session_host_bytes),
+            disk=DiskSessionStore(args.state_dir),
+        )
     replica = EngineReplica(
         args.replica_id, params, cfg, metrics=metrics, tracer=tracer,
         role=args.role, capacity=args.capacity, retain_results=False,

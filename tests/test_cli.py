@@ -575,3 +575,58 @@ def test_serve_fabric_cli_smoke(tmp_path):
         assert proc.wait(timeout=120) == 0
     finally:
         proc.kill()
+
+
+@pytest.mark.serving
+@pytest.mark.sessions
+def test_bench_serving_park_smoke(tmp_path):
+    """CI smoke for the durable-session bench (ISSUE 16 satellite):
+    ``--park`` must drive every wave through the disk PARK round trip
+    (parity vs the never-parked engine asserted inside the bench),
+    leave a tick stream whose sessions line obs_report.py renders, and
+    gate against the committed park_resume_cpu row."""
+    import json
+
+    jsonl = str(tmp_path / "park.jsonl")
+    json_out = str(tmp_path / "park.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_CAPACITY="2",
+               SERVE_PARK_WAVES="2", SERVE_PROMPT_MIN="4",
+               SERVE_PROMPT_MAX="8", SERVE_MAX_NEW="24",
+               SERVE_TOKENS_PER_TICK="4")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--park", "--jsonl", jsonl, "--json", json_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["sessions_parked"] >= 1
+    assert rec["value"] == round(rec["sessions_parked"] / 2, 2)
+    assert rec["parked_disk_peak"] == rec["sessions_parked"]
+    assert rec["bytes_disk_peak"] > 0
+    assert rec["resume_ms_p95"] is not None
+    assert rec["parity"] == "token-identical vs never-parked engine"
+    # the timed run's tick stream carries the session gauges and
+    # obs_report renders the sessions line
+    ticks = [json.loads(ln) for ln in open(jsonl)
+             if json.loads(ln).get("kind") == "serving_tick"]
+    assert ticks and all("sessions_parked_host" in t for t in ticks)
+    assert sum(t.get("session_parks", 0)
+               for t in ticks) == rec["sessions_parked"]
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sessions:" in r.stdout
+    # the registered gate path (huge band: the smoke's tiny workload is
+    # a different operating point than the committed default run)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "park_resume_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "park_resume_cpu" in g.stdout

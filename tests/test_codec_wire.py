@@ -274,3 +274,57 @@ def test_request_defaults_roundtrip_minimal():
     assert out.key is None and out.trace_id is None
     assert out.priority is None and out.eos_id is None
     assert out.max_new_tokens == req.max_new_tokens
+
+
+# ------------------------------------------------- wire v4: park/resume
+
+
+def test_wire_v4_park_rpcs_from_old_peer_are_named_error():
+    """An older front end (wire v3) sending the v4 park/resume_parked
+    RPCs gets the NAMED UnknownWireVersionError on the worker side —
+    never a misparse, never a hang (satellite c)."""
+    assert wire.WIRE_VERSION >= 4  # park/resume_parked entered at v4
+    for mtype in ("park", "resume_parked"):
+        body = json.dumps({"v": wire.WIRE_VERSION - 1, "type": mtype,
+                           "payload": {"request_id": 0}}).encode()
+        frame = struct.pack(">I", len(body)) + body
+        a, b = socket.socketpair()
+        try:
+            a.sendall(frame)
+            with pytest.raises(wire.UnknownWireVersionError,
+                               match=f"version {wire.WIRE_VERSION - 1}"):
+                wire.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+def test_request_tree_nests_inside_tree_payload():
+    """The PARK-frame path: ``encode_request_tree`` output nests inside
+    a larger ``encode_tree`` payload (where ``encode_request``'s tagged
+    arrays cannot), and the request survives — prompt bits, sampling
+    params, resolved key, adapter and trace identity."""
+    req = GenerationRequest(
+        prompt_ids=rand_prompt(11), max_new_tokens=9, top_k=5,
+        temperature=0.5, eos_id=7, seed=3,
+        key=jax.random.PRNGKey(42), trace_id="t-abc", priority=2,
+        adapter="alice",
+    )
+    payload = {"request": wire.encode_request_tree(req),
+               "snapshot": {"step": 4,
+                            "blocks": [np.ones((2, 3), np.float32)]}}
+    out = wire.decode_tree(wire.encode_tree(payload))
+    got = wire.decode_request_tree(out["request"])
+    assert got.prompt_ids.tolist() == req.prompt_ids.tolist()
+    assert got.prompt_ids.dtype == np.int32
+    assert (got.max_new_tokens, got.top_k, got.temperature,
+            got.eos_id, got.seed) == (9, 5, 0.5, 7, 3)
+    assert got.trace_id == "t-abc" and got.priority == 2
+    assert got.adapter == "alice"
+    assert np.asarray(got.key).tolist() == np.asarray(
+        req.resolve_key()).tolist()
+    # a keyless request stays keyless (seed-derived sampling intact)
+    bare = GenerationRequest(prompt_ids=np.asarray([1, 2], np.int32))
+    back = wire.decode_request_tree(wire.decode_tree(wire.encode_tree(
+        wire.encode_request_tree(bare))))
+    assert back.key is None and back.seed == bare.seed

@@ -1,0 +1,559 @@
+"""Durable session fabric tests (serving/sessions/).
+
+The ISSUE 16 acceptance contract:
+
+  * PARK FRAME — the tiered store's on-disk artifact (magic + format
+    version + CRC + wire-codec body) round-trips bit-exactly; every
+    corruption mode (truncation, bad magic, unknown version, flipped
+    byte) surfaces the NAMED ``SessionStoreError``, and a corrupt disk
+    frame is SKIPPED (dropped + counted), never a crash.
+  * TIERS + TTL — host-RAM LRU demotes to disk under its byte budget;
+    write-through when the budget is 0; TTL deadlines are absolute
+    wall-clock and survive a store restart (frames carry them); a
+    parked session is single-resume.
+  * RESUME PARITY — park mid-decode -> disk -> resume on a FRESH
+    engine (worker restart / different replica by construction: the
+    artifact is replica-unbound) is token-identical to a never-parked
+    stream, for mamba1/mamba2/hybrid, chunked long prompts, int8-KV
+    pages and adapter-bound streams.
+  * PRESSURE VALVE — with a store attached the priority valve PARKS
+    its victim (zero device pages, zero host-RAM snapshot) instead of
+    preempting, invisibly in the tokens.
+  * FABRIC — router park/resume on ANY replica; a no-survivor drain
+    parks queued streams instead of erroring (resumable by a later
+    fabric generation over the same store); POST /v1/park + resume-by-
+    session-id over HTTP/SSE.
+  * OFF BY DEFAULT — ``session_store=None`` changes nothing: tick
+    records stay byte-stable and ``summary()["sessions"]`` is None.
+
+Runnable standalone: ``pytest -m sessions``.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.serving import (
+    AdapterRegistry,
+    DiskSessionStore,
+    GenerationRequest,
+    RequestRouter,
+    ServingEngine,
+    SessionStore,
+    SessionStoreError,
+)
+from mamba_distributed_tpu.serving.sessions.store import (
+    SESSION_MAGIC,
+    decode_session_frame,
+    encode_session_frame,
+)
+from mamba_distributed_tpu.serving.service import wire
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+pytestmark = [pytest.mark.sessions, pytest.mark.serving]
+
+CHUNK = 16
+
+
+def tiny_cfg(layer="mamba2", **kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    return ModelConfig(d_model=32, n_layer=2, vocab_size=64, ssm_layer=layer,
+                       headdim=8, chunk_size=16, d_state=16,
+                       compute_dtype="float32", **kw)
+
+
+def hybrid_cfg(**kw):
+    return tiny_cfg(attn_layer_idx=(1,), attn_num_heads=4,
+                    attn_num_kv_heads=2, remat=False, kv_page_tokens=8,
+                    kv_slot_tokens=64, **kw)
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab), np.int32
+    )
+
+
+def solo(params, cfg, prompt, seed, max_new):
+    out = generate(params, cfg, jnp.asarray(prompt, jnp.int32)[None],
+                   jax.random.PRNGKey(seed), max_new_tokens=max_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+@pytest.fixture(scope="module")
+def models():
+    built = {}
+
+    def get(layer):
+        if layer not in built:
+            cfg = hybrid_cfg() if layer == "hybrid" else tiny_cfg(layer)
+            built[layer] = (cfg, init_lm_params(jax.random.PRNGKey(0), cfg))
+        return built[layer]
+
+    return get
+
+
+def park_when_decoding(engine, rid, store, *, ttl_s=None):
+    """Step until ``rid`` is parkable, then park it into ``store`` as
+    the service surface does: wire-tree request + artifact."""
+    for _ in range(200):
+        try:
+            request, snap = engine.park(rid)
+        except ValueError:
+            engine.step()
+            continue
+        return store.park({"request": wire.encode_request_tree(request),
+                           "snapshot": snap}, ttl_s=ttl_s)
+    raise AssertionError(f"request {rid} never became parkable")
+
+
+def resume_into(engine, store, sid):
+    payload = store.resume(sid)
+    request = wire.decode_request_tree(payload["request"])
+    return engine.submit_migrated(request, payload["snapshot"])
+
+
+# ----------------------------------------------------------- PARK frames
+
+
+@pytest.mark.fast
+def test_session_frame_roundtrip_bit_exact():
+    payload = {
+        "request": {"prompt_ids": rand_prompt(9),
+                    "key": np.arange(2, dtype=np.uint32)},
+        "snapshot": {"blocks": [np.linspace(0, 1, 7, dtype=np.float32),
+                                np.arange(-4, 4, dtype=np.int8)],
+                     "step": 3, "parked": True},
+        "new_tokens": [1, 2, 3],
+    }
+    frame = encode_session_frame(payload)
+    assert frame[:4] == SESSION_MAGIC
+    out = decode_session_frame(frame)
+    assert out["new_tokens"] == [1, 2, 3]
+    assert out["snapshot"]["parked"] is True
+    for a, b in [(payload["request"]["prompt_ids"],
+                  out["request"]["prompt_ids"]),
+                 (payload["snapshot"]["blocks"][0],
+                  out["snapshot"]["blocks"][0]),
+                 (payload["snapshot"]["blocks"][1],
+                  out["snapshot"]["blocks"][1])]:
+        assert a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
+
+@pytest.mark.fast
+def test_session_frame_corruption_is_named_error():
+    frame = bytearray(encode_session_frame({"x": 1}))
+    with pytest.raises(SessionStoreError, match="truncated"):
+        decode_session_frame(bytes(frame[:8]))  # short header
+    with pytest.raises(SessionStoreError, match="truncated"):
+        decode_session_frame(bytes(frame[:-3]))  # short body
+    bad_magic = b"NOPE" + bytes(frame[4:])
+    with pytest.raises(SessionStoreError, match="magic"):
+        decode_session_frame(bad_magic)
+    bad_version = bytes(frame[:4]) + b"\x00\x63" + bytes(frame[6:])
+    with pytest.raises(SessionStoreError, match="version 99"):
+        decode_session_frame(bad_version)
+    frame[-1] ^= 0xFF  # body bit-flip -> CRC mismatch
+    with pytest.raises(SessionStoreError, match="CRC"):
+        decode_session_frame(bytes(frame))
+
+
+# ------------------------------------------------------ tiers / TTL / LRU
+
+
+@pytest.mark.fast
+def test_store_park_resume_single_use_and_ttl():
+    clock = [1000.0]
+    store = SessionStore(ttl_s=10.0, clock=lambda: clock[0])
+    sid = store.park({"n": 1})
+    assert sid in store and len(store) == 1
+    assert store.resume(sid) == {"n": 1}
+    with pytest.raises(KeyError):  # single-resume by design
+        store.resume(sid)
+    # TTL: the deadline is absolute; resume past it is a KeyError and
+    # sweep reaps it
+    sid2 = store.park({"n": 2})
+    clock[0] += 11.0
+    with pytest.raises(KeyError, match="expired"):
+        store.resume(sid2)
+    sid3 = store.park({"n": 3}, ttl_s=5.0)
+    sid4 = store.park({"n": 4}, ttl_s=0.0)  # 0 = never expires
+    clock[0] += 6.0
+    assert store.sweep() == 1  # sid3 only
+    assert sid3 not in store and sid4 in store
+    st = store.stats()
+    assert st["parks"] == 4 and st["resumes"] == 1 and st["expires"] == 2
+
+
+@pytest.mark.fast
+def test_store_lru_demotion_and_write_through(tmp_path):
+    # the store frames an {"expires_at", "data"} envelope around each
+    # payload — measure the REAL frame so the budget holds exactly two
+    frame_len = len(encode_session_frame(
+        {"expires_at": None, "data": {"i": 0}}))
+    disk = DiskSessionStore(str(tmp_path / "s"))
+    store = SessionStore(host_bytes=2 * frame_len, disk=disk)
+    sids = [store.park({"i": i}) for i in range(4)]
+    st = store.stats()
+    # the two OLDEST frames demoted to disk; the two newest stay hot
+    assert st["parked_host"] == 2 and st["parked_disk"] == 2
+    assert set(disk.ids()) == set(sids[:2])
+    assert st["bytes_host"] <= store.host_bytes
+    # resume hits both tiers and empties them
+    assert [store.resume(s)["i"] for s in sids] == [0, 1, 2, 3]
+    assert len(store) == 0 and disk.nbytes == 0
+    # host_bytes=0 + disk = write-through: nothing stays in RAM
+    wt = SessionStore(disk=DiskSessionStore(str(tmp_path / "wt")))
+    wt.park({"x": 1})
+    st = wt.stats()
+    assert st["parked_host"] == 0 and st["parked_disk"] == 1
+
+
+@pytest.mark.fast
+def test_store_restart_rescan_and_embedded_ttl(tmp_path):
+    state_dir = str(tmp_path / "state")
+    clock = [5000.0]
+    store = SessionStore(ttl_s=30.0, disk=DiskSessionStore(state_dir),
+                         clock=lambda: clock[0])
+    keep = store.park({"who": "keep"}, ttl_s=0.0)
+    doomed = store.park({"who": "doomed"})  # expires at 5030
+    del store
+    # a NEW incarnation over the same dir (worker restart): sessions
+    # are immediately resumable, and the frame-embedded deadline still
+    # governs expiry
+    store2 = SessionStore(disk=DiskSessionStore(state_dir),
+                          clock=lambda: clock[0])
+    assert keep in store2 and doomed in store2
+    clock[0] = 5031.0
+    with pytest.raises(KeyError, match="expired"):
+        store2.resume(doomed)
+    assert store2.resume(keep) == {"who": "keep"}
+
+
+@pytest.mark.fast
+def test_corrupt_disk_frame_skipped_never_crashes(tmp_path):
+    state_dir = str(tmp_path / "state")
+    disk = DiskSessionStore(state_dir)
+    store = SessionStore(disk=disk)
+    good = store.park({"ok": True})
+    # two bad frames landing beside it: garbage bytes and a truncation
+    with open(os.path.join(state_dir, "garbage.session"), "wb") as f:
+        f.write(b"not a session frame at all")
+    frame = encode_session_frame({"ok": False})
+    with open(os.path.join(state_dir, "truncated.session"), "wb") as f:
+        f.write(frame[:-5])
+    store2 = SessionStore(disk=DiskSessionStore(state_dir))
+    with pytest.raises(SessionStoreError):
+        store2.resume("garbage")
+    assert "garbage" not in store2  # dropped: retries don't re-hit it
+    # the sweeper skips + drops the other bad frame and the good
+    # session still resumes
+    store2.sweep()
+    assert store2.stats()["corrupt_skipped"] == 2
+    assert "truncated" not in store2
+    assert store2.resume(good) == {"ok": True}
+
+
+# ------------------------------------------------- engine resume parity
+
+
+@pytest.mark.parametrize("layer", ["mamba1", "mamba2", "hybrid"])
+def test_park_resume_cross_engine_parity(models, layer, tmp_path):
+    """Park mid-decode -> disk frame -> store RESTART -> resume on a
+    FRESH engine (the worker-restart + different-replica case: the
+    artifact is replica-unbound) is token-identical to solo
+    generate() — including a chunk-spanning long prompt."""
+    cfg, params = models(layer)
+    state_dir = str(tmp_path / layer)
+    store = SessionStore(disk=DiskSessionStore(state_dir))
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        session_store=store)
+    prompts = [rand_prompt(9, seed=3), rand_prompt(2 * CHUNK + 5, seed=4)]
+    rids = [eng.submit(GenerationRequest(prompt_ids=p, max_new_tokens=10,
+                                         seed=7 + i))
+            for i, p in enumerate(prompts)]
+    sids = [park_when_decoding(eng, r, store) for r in rids]
+    assert eng.pending == 0  # parked streams left the engine entirely
+    # resume through a NEW store incarnation on a FRESH engine
+    store2 = SessionStore(disk=DiskSessionStore(state_dir))
+    eng2 = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                         session_store=store2)
+    new_rids = [resume_into(eng2, store2, s) for s in sids]
+    while eng2.pending:
+        eng2.step()
+    for i, (p, rid) in enumerate(zip(prompts, new_rids)):
+        got = eng2.results[rid].new_tokens.tolist()
+        assert got == solo(params, cfg, p, 7 + i, 10), f"prompt {i}"
+    assert eng2.metrics.summary()["sessions"]["resumes"] == 2
+
+
+def test_park_resume_parity_int8_kv(models, tmp_path):
+    """int8 KV pages survive the park round trip exactly: the artifact
+    ships quantized page contents + scales, so the resumed stream is
+    token-identical to the same engine never parking."""
+    cfg = hybrid_cfg(kv_page_dtype="int8")
+    params = models("hybrid")[1]
+    prompt = rand_prompt(CHUNK + 5, seed=11)
+    req = lambda: GenerationRequest(prompt_ids=prompt, max_new_tokens=10,  # noqa: E731
+                                    seed=3)
+    ref = ServingEngine(params, cfg, capacity=2,
+                        tokens_per_tick=2).run([req()])[0]
+    store = SessionStore(disk=DiskSessionStore(str(tmp_path / "i8")))
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        session_store=store)
+    sid = park_when_decoding(eng, eng.submit(req()), store)
+    eng2 = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                         session_store=store)
+    rid = resume_into(eng2, store, sid)
+    while eng2.pending:
+        eng2.step()
+    assert eng2.results[rid].new_tokens.tolist() == ref.new_tokens.tolist()
+
+
+def test_park_resume_parity_adapter_bound(models, tmp_path):
+    """An adapter-bound stream parks and resumes onto an engine with
+    the same registry, still token-identical to never parking."""
+    cfg = dataclasses.replace(tiny_cfg(), lora_max_adapters=2, lora_rank=4,
+                              lora_alpha=8.0)
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    reg = AdapterRegistry(cfg, params)
+    reg.register_random("alice", seed=10)
+    prompt = rand_prompt(9, seed=21)
+    req = lambda: GenerationRequest(prompt_ids=prompt, max_new_tokens=10,  # noqa: E731
+                                    seed=5, top_k=1, adapter="alice")
+    ref = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        adapters=reg).run([req()])[0]
+    store = SessionStore(disk=DiskSessionStore(str(tmp_path / "a")))
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        adapters=reg, session_store=store)
+    sid = park_when_decoding(eng, eng.submit(req()), store)
+    eng2 = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                         adapters=reg, session_store=store)
+    rid = resume_into(eng2, store, sid)
+    while eng2.pending:
+        eng2.step()
+    assert eng2.results[rid].new_tokens.tolist() == ref.new_tokens.tolist()
+
+
+def test_pressure_valve_parks_instead_of_preempting(models, tmp_path):
+    """With a store attached the priority valve PARKS its victim (full
+    artifact to the tiered store, zero host-RAM snapshot) — and the
+    swap stays invisible in the tokens."""
+    cfg, params = models("mamba2")
+    store = SessionStore(disk=DiskSessionStore(str(tmp_path / "v")))
+    eng = ServingEngine(params, cfg, capacity=1, tokens_per_tick=2,
+                        session_store=store)
+    plo, phi = rand_prompt(9, seed=40), rand_prompt(7, seed=41)
+    rlo = eng.submit(GenerationRequest(prompt_ids=plo, max_new_tokens=12,
+                                       seed=31, priority=0))
+    eng.step()
+    eng.step()  # the low-priority request is mid-decode
+    rhi = eng.submit(GenerationRequest(prompt_ids=phi, max_new_tokens=4,
+                                       seed=32, priority=5))
+    while eng.pending:
+        eng.step()
+    assert eng.metrics.preemptions == 1  # the valve fired...
+    st = store.stats()
+    assert st["parks"] == 1 and st["resumes"] == 1  # ...as a park
+    assert len(store) == 0  # the resumed victim reclaimed its session
+    assert eng.results[rlo].new_tokens.tolist() == solo(
+        params, cfg, plo, 31, 12)
+    assert eng.results[rhi].new_tokens.tolist() == solo(
+        params, cfg, phi, 32, 4)
+
+
+# ------------------------------------------------------- off by default
+
+
+def test_store_off_is_byte_stable(models, tmp_path):
+    """``session_store=None`` (the default) leaves the telemetry
+    byte-identical: no sessions_* tick keys, summary()["sessions"] is
+    None, zero extra records."""
+    cfg, params = models("mamba2")
+    jsonl = str(tmp_path / "ticks.jsonl")
+    metrics = ServingMetrics(2, jsonl_path=jsonl)
+    eng = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                        metrics=metrics)
+    eng.run([GenerationRequest(prompt_ids=rand_prompt(7, seed=2),
+                               max_new_tokens=4, seed=1)])
+    with open(jsonl) as f:
+        records = [json.loads(ln) for ln in f if ln.strip()]
+    assert records
+    for rec in records:
+        assert not any(k.startswith(("sessions_", "session_"))
+                       for k in rec), rec
+    assert metrics.summary()["sessions"] is None
+    # and ON: the gauges ride every tick + summary grows the block
+    store = SessionStore(disk=DiskSessionStore(str(tmp_path / "on")))
+    m2 = ServingMetrics(2, jsonl_path=str(tmp_path / "on.jsonl"))
+    eng2 = ServingEngine(params, cfg, capacity=2, tokens_per_tick=2,
+                         metrics=m2, session_store=store)
+    eng2.run([GenerationRequest(prompt_ids=rand_prompt(7, seed=2),
+                                max_new_tokens=4, seed=1)])
+    with open(str(tmp_path / "on.jsonl")) as f:
+        ticks = [json.loads(ln) for ln in f
+                 if '"serving_tick"' in ln]
+    assert ticks and all("sessions_parked_host" in t for t in ticks)
+    s = m2.summary()["sessions"]
+    assert s is not None and s["parks"] == 0
+
+
+# ------------------------------------------------------------ the fabric
+
+
+def test_router_park_resume_any_replica(models, tmp_path):
+    """Router-level park frees the stream's replica entirely; resume
+    places on ANY accepting replica via the normal cost and the stream
+    CONTINUES token-identically.  No store -> NAMED RuntimeError."""
+    cfg, params = models("mamba2")
+    store = SessionStore(disk=DiskSessionStore(str(tmp_path / "r")))
+    router = RequestRouter(params, cfg, num_replicas=2, capacity=2,
+                           tokens_per_tick=2, session_store=store)
+    prompt = rand_prompt(9, seed=61)
+    gid = router.submit(GenerationRequest(prompt_ids=prompt,
+                                          max_new_tokens=10, seed=17))
+    sid = None
+    for _ in range(100):
+        try:
+            sid = router.park(gid)
+            break
+        except ValueError:
+            router.step()
+    assert sid is not None
+    with pytest.raises(KeyError):
+        router.park(gid)  # the router forgot the stream
+    new_gid = router.resume_parked(sid)
+    assert new_gid != gid
+    while router.pending:
+        router.step()
+    assert router.results[new_gid].new_tokens.tolist() == solo(
+        params, cfg, prompt, 17, 10)
+    # unknown session -> KeyError; storeless fabric -> RuntimeError
+    with pytest.raises(KeyError):
+        router.resume_parked("nope")
+    bare = RequestRouter(params, cfg, num_replicas=1, capacity=2,
+                         tokens_per_tick=2)
+    with pytest.raises(RuntimeError, match="no session store"):
+        bare.park(0)
+    with pytest.raises(RuntimeError, match="no session store"):
+        bare.resume_parked("x")
+
+
+def test_drain_with_no_survivors_parks_queued(models, tmp_path):
+    """REGRESSION (satellite a): draining the LAST accepting replica
+    with queued work used to strand/error those streams; with a store
+    they park as queue-only sessions, resumable by a later fabric
+    generation over the same state dir."""
+    cfg, params = models("mamba2")
+    state_dir = str(tmp_path / "drain")
+    store = SessionStore(disk=DiskSessionStore(state_dir))
+    router = RequestRouter(params, cfg, num_replicas=1, capacity=1,
+                           tokens_per_tick=2, session_store=store)
+    prompts = [rand_prompt(7 + i, seed=70 + i) for i in range(3)]
+    gids = [router.submit(GenerationRequest(prompt_ids=p, max_new_tokens=6,
+                                            seed=80 + i))
+            for i, p in enumerate(prompts)]
+    displaced = router.drain(0, requeue_queued=True)
+    assert displaced == []  # parked, not re-placed (and not an error)
+    assert router.drain_parked  # gid -> session id map for the operator
+    parked = dict(router.drain_parked)
+    assert set(parked) <= set(gids) and len(parked) >= 1
+    # resume on a SECOND fabric over the same store: queue-only
+    # sessions go through plain admission (fresh prefill) and still
+    # match solo generate()
+    router2 = RequestRouter(params, cfg, num_replicas=1, capacity=1,
+                            tokens_per_tick=2, session_store=store)
+    for gid, sid in parked.items():
+        i = gids.index(gid)
+        new_gid = router2.resume_parked(sid)
+        while router2.pending:
+            router2.step()
+        assert router2.results[new_gid].new_tokens.tolist() == solo(
+            params, cfg, prompts[i], 80 + i, 6), f"gid {gid}"
+    assert len(store) == 0
+
+
+def test_http_park_resume_sse(models, tmp_path):
+    """The service surface: POST /v1/park ends the live SSE stream
+    with finish_reason "parked" + the session id; POST /v1/resume
+    {"session": id} streams the CONTINUATION; park/resume errors map
+    to 404/409/410/503, never a hang."""
+    import threading
+
+    from mamba_distributed_tpu.serving.service import client as svc_client
+    from mamba_distributed_tpu.serving.service.server import (
+        FabricController,
+        FabricHTTPServer,
+    )
+
+    cfg, params = models("mamba2")
+    store = SessionStore(disk=DiskSessionStore(str(tmp_path / "http")))
+    router = RequestRouter(params, cfg, num_replicas=1, capacity=2,
+                           tokens_per_tick=2, retain_results=False,
+                           session_store=store)
+    controller = FabricController(router)
+    controller.start()
+    http = FabricHTTPServer(controller)
+    port = http.start_background()
+    try:
+        prompt = rand_prompt(9, seed=91)
+        want = solo(params, cfg, prompt, 13, 40)
+        first_tok = threading.Event()
+        state = {}
+
+        def on_event(ev):
+            if "request_id" in ev:
+                state["gid"] = ev["request_id"]
+            if "token" in ev:
+                first_tok.set()
+
+        spec = {"prompt_ids": prompt.tolist(), "seed": 13,
+                "max_new_tokens": 40, "top_k": 50}
+        out = {}
+
+        def drive():
+            out.update(svc_client.stream_generate(
+                "127.0.0.1", port, spec, on_event=on_event))
+
+        t = threading.Thread(target=drive)
+        t.start()
+        assert first_tok.wait(60), "stream never produced a token"
+        parked = None
+        for _ in range(100):
+            parked = svc_client.http_json(
+                "127.0.0.1", port, "POST", "/v1/park",
+                {"request_id": state["gid"]})
+            if parked["_status"] == 200:
+                break
+            assert parked["_status"] == 409 and parked.get("retriable")
+        t.join(60)
+        assert parked["_status"] == 200
+        sid = parked["session"]
+        assert out["finish_reason"] == "parked"
+        prefix = out["tokens"]
+        assert prefix == want[:len(prefix)] and len(prefix) < len(want)
+        # the continuation picks up exactly where the park cut in
+        res = svc_client.stream_generate(
+            "127.0.0.1", port, {"session": sid}, path="/v1/resume")
+        assert prefix + res["tokens"] == want
+        assert res["events"][0]["index"] == len(prefix)
+        # error mapping: unknown id -> 404; gone session -> 410
+        assert svc_client.http_json(
+            "127.0.0.1", port, "POST", "/v1/park",
+            {"request_id": 12345})["_status"] == 404
+        gone = svc_client.http_json(
+            "127.0.0.1", port, "POST", "/v1/resume", {"session": sid})
+        assert gone["_status"] == 410
+    finally:
+        http.stop()
+        controller.stop()
+        controller.join(timeout=10)
