@@ -747,6 +747,28 @@ class TelemetryConfig:
     slo_itl_p95_ms: float = 0.0
     slo_queue_wait_p95_ms: float = 0.0
     slo_window_requests: int = 64
+    # --- live telemetry plane (docs/OBSERVABILITY.md "Live telemetry
+    # plane") ---
+    # byte cap on a SpanTracer's jsonl file: exceeding it rolls the
+    # file to `<name>.1` (one generation kept; obs/export.load_jsonl
+    # reads the pair oldest-first).  0 = never rotate.
+    span_rotate_bytes: int = 0
+    # XLA compile watchdog (obs/watchdog.py): count/time every backend
+    # compile, stamp `compiles`/`compile_ms` on serving_tick records
+    # and expose them on GET /metrics.  Off (default) keeps records
+    # byte-stable.
+    compile_watchdog: bool = False
+    # > threshold compiles inside one tumbling window fires ONE
+    # `compile_thrash` event record (0 = count only, never fire)
+    compile_thrash_threshold: int = 0
+    compile_thrash_window_s: float = 60.0
+    # --- tick-latency regression sentinel (obs/slo.py
+    # TickRegressionDetector): breach when the EWMA-smoothed tick
+    # latency exceeds `tick_regression_factor` x the learned baseline.
+    # factor 0 (default) = off. ---
+    tick_regression_factor: float = 0.0
+    tick_ewma_alpha: float = 0.1
+    tick_regression_warmup: int = 32
 
     def __post_init__(self):
         if self.flight_recorder_len < 1:
@@ -776,6 +798,37 @@ class TelemetryConfig:
             raise ValueError(
                 f"slo_window_requests must be >= 1, got "
                 f"{self.slo_window_requests}"
+            )
+        if self.span_rotate_bytes < 0:
+            raise ValueError(
+                f"span_rotate_bytes must be >= 0 (0 = never rotate), "
+                f"got {self.span_rotate_bytes}"
+            )
+        if self.compile_thrash_threshold < 0:
+            raise ValueError(
+                f"compile_thrash_threshold must be >= 0 (0 = count "
+                f"only), got {self.compile_thrash_threshold}"
+            )
+        if self.compile_thrash_window_s <= 0:
+            raise ValueError(
+                f"compile_thrash_window_s must be > 0, got "
+                f"{self.compile_thrash_window_s}"
+            )
+        if self.tick_regression_factor and self.tick_regression_factor <= 1:
+            raise ValueError(
+                f"tick_regression_factor must be > 1 (breach = factor "
+                f"x baseline; 0 disables), got "
+                f"{self.tick_regression_factor}"
+            )
+        if not 0.0 < self.tick_ewma_alpha <= 1.0:
+            raise ValueError(
+                f"tick_ewma_alpha must be in (0, 1], got "
+                f"{self.tick_ewma_alpha}"
+            )
+        if self.tick_regression_warmup < 1:
+            raise ValueError(
+                f"tick_regression_warmup must be >= 1, got "
+                f"{self.tick_regression_warmup}"
             )
 
 

@@ -32,10 +32,11 @@ schema and span taxonomy.
 from mamba_distributed_tpu.obs.context import mint_trace_id
 from mamba_distributed_tpu.obs.export import (
     export_chrome_trace,
+    split_pulled_stream,
     to_chrome_trace,
 )
 from mamba_distributed_tpu.obs.histogram import StreamingHistogram
-from mamba_distributed_tpu.obs.slo import SLOMonitor
+from mamba_distributed_tpu.obs.slo import SLOMonitor, TickRegressionDetector
 from mamba_distributed_tpu.obs.sentinel import (
     DivergenceError,
     DivergenceSentinel,
@@ -47,8 +48,10 @@ from mamba_distributed_tpu.obs.tracer import (
     append_jsonl,
     jsonable,
 )
+from mamba_distributed_tpu.obs.watchdog import CompileWatchdog
 
 __all__ = [
+    "CompileWatchdog",
     "DivergenceError",
     "DivergenceSentinel",
     "FlightRecorder",
@@ -56,9 +59,11 @@ __all__ = [
     "SLOMonitor",
     "SpanTracer",
     "StreamingHistogram",
+    "TickRegressionDetector",
     "append_jsonl",
     "export_chrome_trace",
     "jsonable",
     "mint_trace_id",
+    "split_pulled_stream",
     "to_chrome_trace",
 ]

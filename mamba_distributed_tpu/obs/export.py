@@ -44,25 +44,59 @@ def load_jsonl(path: str, bad_lines: list | None = None) -> list[dict]:
     lines (crashed writer) are skipped — an export must still come out
     of a post-mortem stream.  Pass ``bad_lines`` to collect the skipped
     raw lines (scripts/obs_report.py warns on their count); this is the
-    ONE tolerant jsonl loader every stream consumer shares."""
+    ONE tolerant jsonl loader every stream consumer shares.
+
+    A byte-capped SpanTracer (``rotate_bytes``) rolls its previous
+    generation to ``<path>.1``; when that sibling exists the pair is
+    read oldest-first (``.1`` then ``path``) so rotation never hides
+    history from a consumer that was handed the live path."""
     records = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                if bad_lines is not None:
-                    bad_lines.append(line)
-                continue
-            if isinstance(rec, dict):
-                records.append(rec)
+    rolled = path + ".1"
+    paths = [rolled, path] if os.path.exists(rolled) else [path]
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    if bad_lines is not None:
+                        bad_lines.append(line)
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
     return records
 
 
-_SPAN_META = ("kind", "name", "t_ms", "dur_ms", "depth", "parent", "tid")
+_SPAN_META = ("kind", "name", "t_ms", "dur_ms", "depth", "parent", "tid",
+              "obs_src")
+
+
+def split_pulled_stream(records: list[dict]) -> tuple[list[list[dict]],
+                                                      list[str]]:
+    """Split one merged fabric obs stream into per-origin sub-streams.
+
+    The FabricController's obs drain (``obs_pull``) stamps every record
+    it pulls with ``obs_src`` (the origin replica) before appending it
+    to ONE merged jsonl — a single file holding interleaved records
+    from N worker tracers.  Grouping by ``obs_src`` (order of first
+    appearance; untagged records form a ``"local"`` stream — the
+    controller's own spans) recovers the per-process streams
+    ``to_chrome_trace`` needs: each origin keeps its own
+    ``trace_header`` epoch, so alignment and per-process tracks work
+    exactly as they do for N separate files.
+    """
+    order: list[str] = []
+    by_src: dict[str, list[dict]] = {}
+    for rec in records:
+        src = str(rec.get("obs_src", "local"))
+        if src not in by_src:
+            by_src[src] = []
+            order.append(src)
+        by_src[src].append(rec)
+    return [by_src[s] for s in order], order
 
 
 def to_chrome_trace(
@@ -171,9 +205,25 @@ def to_chrome_trace(
 def export_chrome_trace(paths: list[str], out_path: str) -> dict:
     """File-level driver (what scripts/trace_export.py calls): load each
     stream, merge, write ``out_path``.  Returns the document's metadata
-    block."""
-    streams = [load_jsonl(p) for p in paths]
-    doc = to_chrome_trace(streams, labels=[os.path.basename(p) for p in paths])
+    block.
+
+    A file whose records carry ``obs_src`` tags (the controller's
+    merged pulled stream) expands into one sub-stream per origin, so a
+    single ``--obs-stream`` file renders the same multi-process tracks
+    and cross-replica flow arrows as N worker-local files would."""
+    streams: list[list[dict]] = []
+    labels: list[str] = []
+    for p in paths:
+        records = load_jsonl(p)
+        base = os.path.basename(p)
+        if any("obs_src" in r for r in records):
+            subs, srcs = split_pulled_stream(records)
+            streams.extend(subs)
+            labels.extend(f"{base}:{s}" for s in srcs)
+        else:
+            streams.append(records)
+            labels.append(base)
+    doc = to_chrome_trace(streams, labels=labels)
     with open(out_path, "w") as f:
         json.dump(doc, f)
     return doc["metadata"]

@@ -164,3 +164,121 @@ class SLOMonitor:
                 for m, t in self.targets.items()
             },
         }
+
+
+class TickRegressionDetector:
+    """EWMA-baseline regression sentinel over engine tick latency.
+
+    The SLOMonitor above judges ABSOLUTE targets the operator set; this
+    detector needs no target at all — it learns the engine's own
+    steady-state tick latency as an exponentially-weighted moving
+    average and raises when ticks run a configured factor slower than
+    that baseline.  An ITL degradation (a recompile storm, a noisy
+    neighbor, a fragmenting page pool) becomes an *event* the moment it
+    starts, not a bump discovered in a histogram after the run.
+
+    Same transition discipline as the SLO monitor: one
+    ``tick_regression`` event record when the smoothed latency crosses
+    ``factor x baseline``, one ``tick_recovered`` when it comes back —
+    never a per-tick alarm flood.  The baseline FREEZES while in
+    breach (a regression must not teach the baseline that slow is
+    normal); it resumes adapting on recovery.
+
+    Args:
+      factor: breach when smoothed tick ms > factor * baseline (must
+        be > 1; ``from_config`` returns None when the config's factor
+        is 0 = off).
+      alpha: EWMA weight of the newest tick for the FAST signal.
+      baseline_alpha: EWMA weight for the (out-of-breach) baseline;
+        must be meaningfully smaller than ``alpha`` or the baseline
+        tracks the fast signal and a breach can never open.  Defaults
+        to ``alpha / 10``.
+      warmup: ticks observed before judging starts — the first ticks
+        pay compiles and cache fills and would poison the baseline.
+      tracer: where the event records land.
+    """
+
+    def __init__(self, *, factor: float = 2.0, alpha: float = 0.1,
+                 baseline_alpha: float | None = None,
+                 warmup: int = 32, tracer=NULL_TRACER):
+        if factor <= 1.0:
+            raise ValueError(
+                f"regression factor must be > 1 (breach = factor x "
+                f"baseline), got {factor}"
+            )
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if baseline_alpha is None:
+            baseline_alpha = alpha / 10.0
+        if not 0.0 < baseline_alpha < alpha:
+            raise ValueError(
+                f"baseline_alpha must be in (0, alpha={alpha}) so the "
+                f"baseline lags the fast signal, got {baseline_alpha}"
+            )
+        if warmup < 1:
+            raise ValueError(f"warmup must be >= 1, got {warmup}")
+        self.factor = factor
+        self.alpha = alpha
+        self.baseline_alpha = baseline_alpha
+        self.warmup = warmup
+        self.tracer = tracer
+        self.baseline_ms: float | None = None
+        self.smoothed_ms: float | None = None
+        self.ticks = 0
+        self.breaches = 0
+        self.in_breach = False
+
+    @classmethod
+    def from_config(cls, telemetry,
+                    tracer=NULL_TRACER) -> "TickRegressionDetector | None":
+        """Build from a ``TelemetryConfig``; None when
+        ``tick_regression_factor`` is 0 (off — costs nothing)."""
+        if not telemetry.tick_regression_factor:
+            return None
+        return cls(
+            factor=telemetry.tick_regression_factor,
+            alpha=telemetry.tick_ewma_alpha,
+            warmup=telemetry.tick_regression_warmup,
+            tracer=tracer,
+        )
+
+    def observe_tick(self, tick_ms: float, replica=None) -> None:
+        """One engine tick's wall milliseconds."""
+        if not math.isfinite(tick_ms) or tick_ms < 0:
+            return  # telemetry never throws on a bad input
+        self.ticks += 1
+        a = self.alpha
+        if self.smoothed_ms is None:
+            self.smoothed_ms = tick_ms
+        else:
+            self.smoothed_ms = (1 - a) * self.smoothed_ms + a * tick_ms
+        if self.ticks <= self.warmup:
+            self.baseline_ms = self.smoothed_ms
+            return
+        if not self.in_breach:
+            b = self.baseline_alpha
+            self.baseline_ms = (1 - b) * self.baseline_ms + b * tick_ms
+        breached = self.smoothed_ms > self.factor * self.baseline_ms
+        if breached != self.in_breach:
+            self.in_breach = breached
+            attrs = dict(metric="tick_ms", factor=self.factor,
+                         baseline_ms=round(self.baseline_ms, 3),
+                         smoothed_ms=round(self.smoothed_ms, 3))
+            if replica is not None:
+                attrs["replica"] = replica
+            if breached:
+                self.breaches += 1
+                self.tracer.event("tick_regression", **attrs)
+            else:
+                self.tracer.event("tick_recovered", **attrs)
+
+    def summary(self) -> dict:
+        r = lambda v: None if v is None else round(v, 3)
+        return {
+            "ticks": self.ticks,
+            "baseline_ms": r(self.baseline_ms),
+            "smoothed_ms": r(self.smoothed_ms),
+            "factor": self.factor,
+            "breaches": self.breaches,
+            "in_breach": self.in_breach,
+        }

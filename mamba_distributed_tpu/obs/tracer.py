@@ -17,6 +17,7 @@ a global, so two engines in one process can write disjoint streams.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import math
@@ -62,15 +63,56 @@ class SpanTracer:
     the pid), which is what lets ``obs/export.py`` merge streams from
     different replicas/processes onto one timeline — ``t_ms`` alone is
     a process-local perf_counter offset and not comparable.
+
+    ``jsonl_path=None`` keeps the tracer live with no file behind it —
+    the ring-only mode a remote worker runs in when the controller
+    drains its records over the wire (``obs_pull``) instead of the
+    operator collecting files by hand.
+
+    ``ring_len > 0`` additionally keeps the last N records in a
+    bounded in-memory ring, each stamped with a monotonically
+    increasing sequence number.  ``ring_pull(cursor)`` drains it
+    incrementally — the cursor-resume idea of the PR-5 replay RPC
+    applied to telemetry: a reader that comes back with its last
+    cursor gets exactly the records it missed (or an explicit
+    ``dropped`` count when the ring lapped it).  The ring holds
+    already-jsonable dicts, so pulled records are byte-identical to
+    what the file (if any) received.
+
+    ``rotate_bytes > 0`` caps the jsonl file: when appending a record
+    would push the file past the cap, the current file rolls to
+    ``<path>.1`` (one generation — the previous ``.1`` is dropped) and
+    a fresh ``trace_header`` opens the new file so each generation
+    stays independently alignable.  ``obs/export.load_jsonl`` reads
+    the rolled pair oldest-first.
     """
 
     enabled = True
 
-    def __init__(self, jsonl_path: str, _clock=time.perf_counter):
-        parent = os.path.dirname(jsonl_path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
+    def __init__(self, jsonl_path: str | None = None,
+                 _clock=time.perf_counter, *, ring_len: int = 0,
+                 rotate_bytes: int = 0):
+        if ring_len < 0:
+            raise ValueError(f"ring_len must be >= 0, got {ring_len}")
+        if rotate_bytes < 0:
+            raise ValueError(
+                f"rotate_bytes must be >= 0 (0 = no rotation), got "
+                f"{rotate_bytes}"
+            )
+        if jsonl_path:
+            parent = os.path.dirname(jsonl_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
         self.jsonl_path = jsonl_path
+        self.rotate_bytes = rotate_bytes
+        # ring of (seq, jsonable record); None when disabled
+        self._ring = (collections.deque(maxlen=ring_len)
+                      if ring_len else None)
+        self._seq = 0
+        # file size accounting for rotation; resolved lazily at the
+        # first file write (a preserved-history append starts from the
+        # existing file's size, a truncating first write from 0)
+        self._file_bytes: int | None = None
         self._clock = _clock
         self._t0 = _clock()
         # wall clock paired with _t0 at the same instant: t_ms offsets
@@ -154,21 +196,73 @@ class SpanTracer:
         """Keep the existing stream (called on checkpoint resume)."""
         self._truncate_pending = False
 
+    def _header_record(self) -> dict:
+        return {"kind": "trace_header",
+                "wall_t0_s": round(self.wall_t0, 6),
+                "pid": os.getpid()}
+
+    def _emit(self, record: dict, truncate: bool = False) -> None:
+        """Lock held: one record into the ring and (if any) the file."""
+        record = jsonable(record)
+        if self._ring is not None:
+            self._ring.append((self._seq, record))
+            self._seq += 1
+        if not self.jsonl_path:
+            return
+        line = json.dumps(record) + "\n"
+        if self._file_bytes is None:
+            self._file_bytes = (
+                0 if truncate or not os.path.exists(self.jsonl_path)
+                else os.path.getsize(self.jsonl_path)
+            )
+        if (self.rotate_bytes > 0 and self._file_bytes > 0
+                and self._file_bytes + len(line) > self.rotate_bytes):
+            # roll the full generation aside (one generation kept) and
+            # re-head the fresh file so it stays alignable on its own —
+            # the header does NOT enter the ring again (pulled streams
+            # already carry the original one)
+            os.replace(self.jsonl_path, self.jsonl_path + ".1")
+            header = json.dumps(jsonable(self._header_record())) + "\n"
+            with open(self.jsonl_path, "w") as f:
+                f.write(header)
+            self._file_bytes = len(header)
+        with open(self.jsonl_path, "w" if truncate else "a") as f:
+            f.write(line)
+        self._file_bytes = (len(line) if truncate
+                            else self._file_bytes + len(line))
+
     def write(self, record: dict) -> None:
         with self._lock:
             if self._header_pending:
                 self._header_pending = False
-                append_jsonl(
-                    self.jsonl_path,
-                    {"kind": "trace_header",
-                     "wall_t0_s": round(self.wall_t0, 6),
-                     "pid": os.getpid()},
-                    truncate=self._truncate_pending,
-                )
+                self._emit(self._header_record(),
+                           truncate=self._truncate_pending)
                 self._truncate_pending = False
-            append_jsonl(self.jsonl_path, record,
-                         truncate=self._truncate_pending)
+            self._emit(record, truncate=self._truncate_pending)
             self._truncate_pending = False
+
+    def ring_pull(self, cursor: int = 0, limit: int = 4096) -> dict:
+        """Drain ring records with seq >= ``cursor`` (bounded).
+
+        Returns ``{"records": [...], "cursor": next_cursor,
+        "dropped": n}`` — ``dropped`` counts records that aged out of
+        the ring before this pull (the reader's cursor fell behind the
+        ring's oldest resident seq).  A tracer with no ring returns an
+        empty page at the caller's cursor.
+        """
+        with self._lock:
+            if self._ring is None:
+                return {"records": [], "cursor": cursor, "dropped": 0}
+            dropped = 0
+            if self._ring:
+                oldest = self._ring[0][0]
+                if cursor < oldest:
+                    dropped = oldest - cursor
+                    cursor = oldest
+            out = [rec for seq, rec in self._ring
+                   if seq >= cursor][:max(0, limit)]
+            return {"records": out, "cursor": cursor + len(out),
+                    "dropped": dropped}
 
 
 class _NullTracer:
@@ -188,6 +282,9 @@ class _NullTracer:
 
     def write(self, record: dict) -> None:
         pass
+
+    def ring_pull(self, cursor: int = 0, limit: int = 4096) -> dict:
+        return {"records": [], "cursor": cursor, "dropped": 0}
 
 
 NULL_TRACER = _NullTracer()

@@ -298,6 +298,15 @@ class ServingEngine:
         record (rolling-window p95 targets -> breach events); None
         (default) off.  The router shares ONE monitor across replicas
         so the window is fabric-wide.
+      compile_watchdog: an obs.CompileWatchdog (already installed on
+        jax.monitoring) drained once per tick — window deltas stamp
+        ``compiles``/``compile_ms`` on the tick record, lifetime
+        totals feed summary()["compile"] and GET /metrics.  None
+        (default) off: records stay byte-stable.
+      tick_regression: an obs.TickRegressionDetector fed every tick's
+        wall ms (EWMA baseline; transition-only ``tick_regression``
+        events when ticks run a factor slower than steady state).
+        None (default) off.
       mesh: a ``parallel/mesh.serving_mesh`` — the 2-D sharded path.
         Slot/page state and the tick's batch axis partition over the
         mesh's DATA axis; the weights partition over its MODEL axis
@@ -407,6 +416,8 @@ class ServingEngine:
         drafter: spec_decode.Drafter | None = None,
         adapters: adapters_mod.AdapterRegistry | None = None,
         session_store=None,
+        compile_watchdog=None,
+        tick_regression=None,
     ):
         if not 1 <= max_top_k <= cfg.vocab_size_padded:
             raise ValueError(
@@ -481,6 +492,18 @@ class ServingEngine:
         self.metrics = metrics or ServingMetrics(capacity)
         self.tracer = tracer
         self.slo = slo
+        # --- live telemetry plane (obs/watchdog.py + obs/slo.py;
+        # docs/OBSERVABILITY.md "Live telemetry plane"): an attached
+        # CompileWatchdog is drained once per tick — its window deltas
+        # become the record's `compiles`/`compile_ms` stamps and its
+        # lifetime totals summary()["compile"] / the /metrics counters.
+        # An attached TickRegressionDetector is fed every tick's wall
+        # ms (EWMA baseline -> transition-only `tick_regression`
+        # events).  Both None (default) keep records byte-stable.
+        self.compile_watchdog = compile_watchdog
+        if compile_watchdog is not None:
+            self.metrics.configure_compile()
+        self.tick_regression = tick_regression
         # goodput: analytic FLOPs rates (utils/flops.py, the "model"
         # convention — parameter matmuls + recurrent state math, no
         # device counters, no syncs) so every serving_tick record can
@@ -2692,6 +2715,17 @@ class ServingEngine:
                 weight_bytes=self._weight_bytes,
                 page_pool_bytes=self._pool_bytes,
             )
+        compile_gauges = {}
+        if self.compile_watchdog is not None:
+            # XLA compiles observed since the previous tick record
+            # (absent without a watchdog — records stay byte-stable)
+            n_compiles, compile_ms = self.compile_watchdog.drain()
+            compile_gauges = dict(compiles=n_compiles,
+                                  compile_ms=compile_ms)
+        if self.tick_regression is not None:
+            self.tick_regression.observe_tick(
+                dt * 1000, replica=self.metrics.replica
+            )
         self.metrics.record_tick(
             occupied=occupied, queue_depth=self.scheduler.depth,
             tokens_emitted=len(events), dt_s=dt,
@@ -2722,6 +2756,7 @@ class ServingEngine:
             **spec_gauges,
             **lora_gauges,
             **session_gauges,
+            **compile_gauges,
         )
         self._preemptions = 0
         self._migrations_out = 0

@@ -456,6 +456,37 @@ class RemoteReplica:
             return {}
         return payload.get("summary", {})
 
+    def metrics_snapshot(self) -> dict | None:
+        """The full wire-v5 ``summary_result`` payload — roll-up summary
+        PLUS the raw latency-histogram bucket dicts and live stats the
+        controller's ``GET /metrics`` Prometheus exposition renders.
+        NON-fatal like ping: a scrape must never condemn a replica."""
+        if not self.alive:
+            return None
+        try:
+            return self._rpc("summary", {}, expect="summary_result",
+                             fatal=False)
+        except wire.WireError:
+            return None
+
+    def obs_pull(self, cursor: int = 0, limit: int = 4096) -> dict | None:
+        """Wire v5: drain one page of the worker's in-memory span/record
+        ring from ``cursor`` (see ``SpanTracer.ring_pull``).  Returns
+        ``{records, cursor, dropped, boot_id}`` or None on wire failure.
+        NON-fatal (the ping/replay pattern): telemetry collection must
+        never mark a healthy replica wire-dead — a missed pull just
+        resumes from the same cursor next interval, and the ring absorbs
+        the gap (``dropped`` counts anything that aged out meanwhile)."""
+        if not self.alive:
+            return None
+        try:
+            return self._rpc("obs_pull", {
+                "cursor": int(cursor),
+                "limit": int(limit),
+            }, expect="obs_pull_result", fatal=False)
+        except wire.WireError:
+            return None
+
     def shutdown(self) -> None:
         """Best-effort worker process exit (post-drain)."""
         try:

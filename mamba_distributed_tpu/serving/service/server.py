@@ -40,10 +40,18 @@ pin, which is why remote streams can be token-identical to solo
                          Not-yet-decoding streams 409 (retriable),
                          unknown ids 404, no store configured 503
   GET  /healthz          fabric + per-replica health (heartbeat ages,
-                         missed beats, lifecycle states)
+                         missed beats, lifecycle states); 503 with
+                         ``"ready": false`` when ZERO replicas accept
+                         work, so a load balancer's readiness probe
+                         needs no JSON parsing
   POST /drain/<replica>  graceful retire; queued-but-unplaced work
                          requeues to survivors (rolling restarts)
   GET  /metrics-summary  per-replica engine metrics summaries
+  GET  /metrics          the whole fabric as ONE Prometheus scrape
+                         target (text format 0.0.4): the controller's
+                         fabric gauges + every replica's counters,
+                         gauges and latency histograms, labeled by
+                         {replica, role} (obs/prom.py holds the schema)
 
 Request JSON: {"prompt_ids": [int, ...], "max_new_tokens": 32,
 "top_k": 50, "temperature": 1.0, "eos_id": null, "seed": 0,
@@ -62,6 +70,7 @@ solo ``generate()``).
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import json
 import queue
@@ -70,7 +79,7 @@ import time
 
 import numpy as np
 
-from mamba_distributed_tpu.obs import jsonable
+from mamba_distributed_tpu.obs import jsonable, prom
 from mamba_distributed_tpu.serving.scheduler import GenerationRequest
 from mamba_distributed_tpu.serving.service import wire
 
@@ -85,7 +94,9 @@ class FabricController(threading.Thread):
 
     def __init__(self, router, *, health=None, poll_s: float = 0.002,
                  adapters: dict | None = None,
-                 session_sweep_s: float = 5.0, emit=None):
+                 session_sweep_s: float = 5.0, emit=None,
+                 obs_pull_s: float = 0.0, obs_sink=None,
+                 obs_limit: int = 4096, obs_keep: int = 65536):
         super().__init__(daemon=True, name="fabric-controller")
         self.router = router
         self.health = health
@@ -98,6 +109,24 @@ class FabricController(threading.Thread):
         self.session_sweep_s = session_sweep_s
         self.emit = emit
         self._next_session_sweep = time.monotonic() + session_sweep_s
+        # live telemetry plane (wire v5): at most every ``obs_pull_s``
+        # the controller drains each worker's in-memory span/record
+        # ring (the ``obs_pull`` RPC) into ONE merged fabric stream —
+        # each record stamped ``obs_src`` with its origin replica — so
+        # trace_export/obs_report see the whole multi-host fabric with
+        # zero remote file access.  Per-replica cursors resume across
+        # pulls; a changed worker boot_id resets the cursor (a fresh
+        # ring shares no sequence space with its predecessor).  0 = off
+        # (no RPCs, no records, byte-stable fabric).
+        self.obs_pull_s = obs_pull_s
+        self.obs_sink = obs_sink
+        self.obs_limit = obs_limit
+        self.obs_records: collections.deque = collections.deque(
+            maxlen=obs_keep)
+        self.obs_records_pulled = 0
+        self.obs_records_dropped = 0
+        self._obs_cursors: dict = {}
+        self._next_obs_pull = time.monotonic() + (obs_pull_s or 0.0)
         # multi-tenant LoRA: the front end's host-side factor store —
         # name -> {"factors": {target: {"A", "B"}}, "alpha": float|None}
         # (scripts/serve_fabric.py --adapter name=path fills it).
@@ -237,6 +266,7 @@ class FabricController(threading.Thread):
         while not self._stop_requested.is_set():
             worked = self._drain_commands()
             self._sweep_sessions()
+            self._drain_obs()
             if self.health is not None:
                 try:
                     self.health.tick()
@@ -344,6 +374,62 @@ class FabricController(threading.Thread):
                 "bytes_host": st["bytes_host"],
                 "bytes_disk": st["bytes_disk"],
             })
+
+    def _drain_obs(self) -> None:
+        """Pull each worker's obs ring into the merged fabric stream
+        (rate-limited like ``_sweep_sessions``).  obs_pull is NON-fatal
+        on the replica side, so a wedged worker costs one skipped page,
+        never a failover; in-process replicas with no ring (or ring-
+        less workers) return empty pages and cost nothing."""
+        if not self.obs_pull_s or time.monotonic() < self._next_obs_pull:
+            return
+        self._next_obs_pull = time.monotonic() + self.obs_pull_s
+        for rep in self.router.replicas:
+            if not rep.alive:
+                continue
+            pull = getattr(rep, "obs_pull", None)
+            if pull is not None:  # a RemoteReplica: the wire-v5 RPC
+                state = self._obs_cursors.setdefault(
+                    rep.replica_id, {"cursor": 0, "boot_id": None})
+                page = pull(state["cursor"], self.obs_limit)
+                if page is None:
+                    continue  # transient wire fault: same cursor next pull
+                boot = page.get("boot_id")
+                if (state["boot_id"] is not None
+                        and boot != state["boot_id"]):
+                    # the worker rebooted under us: its fresh ring shares
+                    # no sequence space with the cursor we hold — restart
+                    # from 0 rather than silently mis-resuming
+                    page = pull(0, self.obs_limit)
+                    if page is None:
+                        continue
+                state["boot_id"] = boot
+                state["cursor"] = int(page.get("cursor", state["cursor"]))
+                self.obs_records_dropped += int(page.get("dropped", 0))
+                records = page.get("records", [])
+            else:  # in-process replica: drain its tracer ring directly
+                tracer = getattr(rep.engine, "tracer", None)
+                ring_pull = getattr(tracer, "ring_pull", None)
+                if ring_pull is None:
+                    continue
+                state = self._obs_cursors.setdefault(
+                    rep.replica_id, {"cursor": 0, "boot_id": None})
+                page = ring_pull(state["cursor"], self.obs_limit)
+                state["cursor"] = int(page["cursor"])
+                self.obs_records_dropped += int(page["dropped"])
+                records = page["records"]
+            src = f"replica{rep.replica_id}"
+            for rec in records:
+                rec = dict(rec)
+                rec["obs_src"] = src
+                self.obs_records.append(rec)
+                self.obs_records_pulled += 1
+                if self.obs_sink is not None:
+                    try:
+                        self.obs_sink(rec)
+                    except Exception:  # noqa: BLE001 — a bad sink (disk
+                        # full) must never kill the fabric loop
+                        pass
 
     def _drain_commands(self) -> bool:
         worked = False
@@ -504,12 +590,21 @@ class FabricHTTPServer:
             await self._park(body, writer)
         elif method == "GET" and path == "/healthz":
             snap = await asyncio.wrap_future(ctrl.call(self._health_payload))
-            writer.write(_json_response("200 OK", snap))
+            # a load balancer's readiness probe reads the status line
+            # alone: zero accepting replicas is 503, not a JSON field
+            status = ("200 OK" if snap.get("ready")
+                      else "503 Service Unavailable")
+            writer.write(_json_response(status, snap))
         elif method == "GET" and path == "/metrics-summary":
             summary = await asyncio.wrap_future(
                 ctrl.call(lambda: jsonable(ctrl.router.summary()))
             )
             writer.write(_json_response("200 OK", summary))
+        elif method == "GET" and path == "/metrics":
+            text = await asyncio.wrap_future(ctrl.call(self._metrics_text))
+            writer.write(_http_response(
+                "200 OK", text.encode("utf-8"),
+                content_type=prom.CONTENT_TYPE))
         elif method == "POST" and path.startswith("/drain/"):
             try:
                 rid = int(path.rsplit("/", 1)[1])
@@ -561,7 +656,60 @@ class FabricHTTPServer:
         payload["ok"] = any(
             r.accepting for r in router.replicas
         )
+        # "ready" is the load-balancer bit (drives the 503): kept as a
+        # separate top-level bool so "ok" stays what PR-6 pinned
+        payload["ready"] = payload["ok"]
         return payload
+
+    def _metrics_text(self) -> str:
+        """One fabric-wide Prometheus exposition document (runs on the
+        controller thread): the controller's own fabric gauges plus a
+        per-replica snapshot — RemoteReplicas ship summary + full
+        histogram buckets + live stats over the wire-v5 ``summary``
+        RPC; in-process replicas read their engine metrics directly."""
+        ctrl = self.controller
+        router = ctrl.router
+        snapshots = []
+        for r in router.replicas:
+            if not r.alive:
+                continue
+            snap_rpc = getattr(r, "metrics_snapshot", None)
+            if snap_rpc is not None:  # a RemoteReplica
+                payload = snap_rpc()
+                if payload is None:
+                    continue  # transient wire fault: skip this scrape
+                snapshots.append({
+                    "replica": r.replica_id,
+                    "role": payload.get("role", r.role),
+                    "summary": payload.get("summary") or {},
+                    "histograms": payload.get("histograms") or {},
+                    "stats": payload.get("stats") or r.stats,
+                })
+            else:  # in-process EngineReplica
+                m = r.engine.metrics
+                snapshots.append({
+                    "replica": r.replica_id,
+                    "role": r.role,
+                    "summary": m.summary(),
+                    "histograms": m.histogram_dicts(),
+                    "stats": {
+                        "depth": int(r.engine.scheduler.depth),
+                        "resident": len(r.engine._slots),
+                        "capacity": int(r.engine.capacity),
+                    },
+                })
+        reps = router.replicas
+        plane_on = bool(ctrl.obs_pull_s)
+        return prom.render_fabric(
+            snapshots,
+            replicas=len(reps),
+            accepting=sum(1 for r in reps if r.accepting),
+            ready=any(r.accepting for r in reps),
+            obs_records_pulled=(
+                ctrl.obs_records_pulled if plane_on else None),
+            obs_records_dropped=(
+                ctrl.obs_records_dropped if plane_on else None),
+        )
 
     async def _generate(self, body: bytes,
                         writer: asyncio.StreamWriter) -> None:

@@ -57,8 +57,17 @@ import numpy as np
 # older peer would drop the request's parked continuation on the
 # floor, so park/resume against a v3 worker fails loudly through
 # UnknownWireVersionError instead of replaying tokens the client
-# already has.
-WIRE_VERSION = 4
+# already has.  v5: the live telemetry plane — the worker RPC surface
+# grew ``obs_pull`` (cursor-resumable drain of the worker's in-memory
+# span/record ring, sequence-numbered like the PR-5 replay cursors and
+# invalidated across restarts by the same per-boot nonce), and the
+# ``summary`` reply ships the full latency-histogram buckets + live
+# stats the controller's GET /metrics renders; an older peer cannot
+# ship its telemetry, so a mixed-version fabric would silently present
+# a PARTIAL observability picture — exactly the failure a telemetry
+# plane exists to prevent — and the skew fails loudly through
+# UnknownWireVersionError instead.
+WIRE_VERSION = 5
 
 # one frame's hard ceiling (a hybrid migration artifact is page-count
 # sized — MBs, not GBs; anything bigger is a corrupt length prefix)

@@ -21,7 +21,16 @@ parity against:
   step             -> migrate_offer* -> step_result {events, stats}
   ping             -> pong {stats}              (heartbeat probe)
   drain            -> drain_ack {withdrawn, stats}
-  summary          -> summary_result {summary}
+  summary          -> summary_result {summary, histograms, stats, role}
+                      (histograms: full latency bucket dicts — what the
+                      controller's GET /metrics renders)
+  obs_pull         -> obs_pull_result {records, cursor, dropped,
+                      boot_id}  (wire v5: cursor-resumable drain of the
+                      engine tracer's in-memory span/record ring — the
+                      controller merges every worker's into one fabric
+                      stream with zero remote file access; a cursor
+                      from a previous worker boot is detected via
+                      boot_id and restarted at 0)
   shutdown         -> bye (process exits)
 
 ``step`` is the one RPC with sub-messages: while the engine steps, a
@@ -61,7 +70,7 @@ from mamba_distributed_tpu.serving.service import wire
 # named error back to the peer, never a hang)
 _HANDLED = ("hello", "submit", "submit_migrated", "park", "resume_parked",
             "step", "ping", "drain", "replay", "load_adapter", "summary",
-            "shutdown")
+            "obs_pull", "shutdown")
 
 
 # ------------------------------------------------------------- config I/O
@@ -418,8 +427,33 @@ class WorkerServer:
         elif mtype == "summary":
             from mamba_distributed_tpu.obs import jsonable
 
+            # the full latency-histogram bucket dicts + live stats ride
+            # next to the roll-up (wire v5): the controller's
+            # GET /metrics needs bucket counts, not p95 point estimates
             wire.send_msg(conn, "summary_result", {
                 "summary": jsonable(rep.engine.metrics.summary()),
+                "histograms": rep.engine.metrics.histogram_dicts(),
+                "stats": self._stats(),
+                "role": rep.role,
+            })
+        elif mtype == "obs_pull":
+            # wire v5: cursor-resumable drain of the engine tracer's
+            # in-memory span/record ring (obs/tracer.py ring_pull) —
+            # the controller's background drain merges every worker's
+            # page into ONE fabric stream, so trace_export/obs_report
+            # see a live multi-host fabric with zero remote file
+            # access.  boot_id rides every reply: a controller holding
+            # a cursor from a previous worker boot restarts at 0
+            # instead of silently mis-resuming into a fresh ring.
+            page = rep.engine.tracer.ring_pull(
+                int(payload.get("cursor", 0)),
+                int(payload.get("limit", 4096)),
+            )
+            wire.send_msg(conn, "obs_pull_result", {
+                "records": page["records"],
+                "cursor": page["cursor"],
+                "dropped": page["dropped"],
+                "boot_id": self.boot_id,
             })
         elif mtype == "shutdown":
             wire.send_msg(conn, "bye", {})

@@ -275,6 +275,14 @@ class ServingMetrics:
         self.migrations_out = 0
         self.migrations_in = 0
         self.migration_ms = StreamingHistogram()
+        # XLA compile watchdog (obs/watchdog.py): the engine calls
+        # configure_compile() when a watchdog is attached, unlocking
+        # summary()["compile"] and the per-tick `compiles`/`compile_ms`
+        # stamps.  Off by default so watchdog-less records/summaries
+        # stay byte-stable.
+        self._compile_on = False
+        self.compiles = 0
+        self.compile_ms_total = 0.0
         # same deferred-truncation contract as MetricsLogger/SpanTracer:
         # a reused path starts fresh on the first write unless
         # preserve_history() ran, so two runs can never interleave
@@ -400,6 +408,14 @@ class ServingMetrics:
         self.weight_dtype = weight_dtype
         self.kv_dtype = kv_dtype
 
+    # ----------------------------------------------- compile watchdog
+
+    def configure_compile(self) -> None:
+        """Mark the XLA compile watchdog live (engine construction):
+        ``summary()`` gains its ``compile`` block and tick records
+        their ``compiles``/``compile_ms`` stamps."""
+        self._compile_on = True
+
     def record_greedy_disagreement(self, n: int = 1) -> None:
         """``n`` greedy tokens on which a quantized stream disagreed
         with its reference (fed by ops/quant.assert_stream_close — the
@@ -506,6 +522,8 @@ class ServingMetrics:
         session_parks: int = 0,
         session_resumes: int = 0,
         session_expires: int = 0,
+        compiles: int | None = None,
+        compile_ms: float = 0.0,
     ) -> None:
         """``prefill_stall_ms`` is the host time spent on prefill work
         since the PREVIOUS tick record (an engine step whose slots are
@@ -686,6 +704,17 @@ class ServingMetrics:
                 "session_resumes": session_resumes,
                 "session_expires": session_expires,
             })
+        if compiles is not None:
+            # compile-watchdog window counters (stamped only when a
+            # watchdog is attached — records stay byte-stable
+            # otherwise): XLA backend compiles observed since the
+            # previous tick record and their wall ms.  A steady-state
+            # engine stamps 0/0.0; anything persistently nonzero is
+            # recompile thrash the watchdog's window event names.
+            self.compiles += compiles
+            self.compile_ms_total += compile_ms
+            record["compiles"] = compiles
+            record["compile_ms"] = round(compile_ms, 3)
         if compaction_width is not None:
             # occupancy-adaptive compaction stamp (only when the engine
             # has compaction on — records stay byte-stable otherwise):
@@ -859,9 +888,25 @@ class ServingMetrics:
                     "frees": self.kv_page_frees,
                 }
             ),
+            "compile": (None if not self._compile_on else {
+                "compiles": self.compiles,
+                "compile_ms": round(self.compile_ms_total, 3),
+            }),
             "latency": {
                 "queue_wait_ms": self.queue_wait_ms.summary(),
                 "ttft_ms": self.ttft_ms.summary(),
                 "itl_ms": self.itl_ms.summary(),
             },
+        }
+
+    def histogram_dicts(self) -> dict:
+        """Full sparse bucket forms of the latency histograms
+        (``StreamingHistogram.to_dict``) — what the Prometheus
+        exposition needs (``summary()`` carries only the p50/p95/p99
+        roll-ups; bucket lines need the counts).  Shipped next to the
+        summary in the worker ``summary`` RPC payload."""
+        return {
+            "queue_wait_ms": self.queue_wait_ms.to_dict(),
+            "ttft_ms": self.ttft_ms.to_dict(),
+            "itl_ms": self.itl_ms.to_dict(),
         }

@@ -830,16 +830,89 @@ def format_report(report: dict) -> str:
     return "\n\n".join(out)
 
 
+def _fetch_url(url: str, timeout_s: float = 10.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode("utf-8")
+
+
+def build_live_report(base_url: str) -> dict:
+    """One snapshot of a RUNNING fabric over HTTP — no file access:
+    ``/metrics-summary`` (per-replica engine roll-ups), ``/healthz``
+    (readiness + lifecycle states) and ``/metrics`` (the Prometheus
+    exposition, parsed just enough to list the emitted families)."""
+    base = base_url.rstrip("/")
+    live: dict = {"url": base,
+                  "replicas": json.loads(_fetch_url(base + "/metrics-summary"))}
+    try:
+        live["health"] = json.loads(_fetch_url(base + "/healthz"))
+    except Exception as e:  # noqa: BLE001 — a 503 (not ready) still
+        # carries the JSON body, but an old front end may lack the route
+        import urllib.error
+
+        if isinstance(e, urllib.error.HTTPError):
+            live["health"] = json.loads(e.read().decode("utf-8"))
+    try:
+        from mamba_distributed_tpu.obs import prom
+
+        fams = prom.parse_exposition(_fetch_url(base + "/metrics"))
+        live["metric_families"] = sorted(fams)
+    except Exception:  # noqa: BLE001 — pre-v5 front ends have no /metrics
+        pass
+    return live
+
+
+def format_live_report(live: dict) -> str:
+    out = [f"== live fabric @ {live['url']} =="]
+    health = live.get("health") or {}
+    if health:
+        out.append(f"ready: {health.get('ready')}   "
+                   f"pending: {health.get('pending')}   "
+                   f"migrations: {health.get('migrations')}")
+    rows = []
+    for rid in sorted(live.get("replicas", {}), key=str):
+        s = live["replicas"][rid] or {}
+        hs = (health.get("replicas") or {}).get(str(rid), {})
+        rows.append([rid, hs.get("state", "-"), s.get("ticks", 0),
+                     s.get("decode_tokens", 0),
+                     _fmt(s.get("decode_tokens_per_sec")),
+                     _fmt(s.get("mean_tick_ms")),
+                     s.get("finished_requests", 0),
+                     _fmt((s.get("compile") or {}).get("compiles"))])
+    if rows:
+        out.append(_table(rows, ["replica", "state", "ticks", "tokens",
+                                 "tok/s", "tick ms", "finished",
+                                 "compiles"]))
+    if live.get("metric_families"):
+        out.append(f"/metrics families: {len(live['metric_families'])}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="phase-time breakdown + latency percentiles from the "
                     "repo's jsonl telemetry streams (docs/OBSERVABILITY.md)"
     )
-    p.add_argument("files", nargs="+", help="jsonl stream(s): events.jsonl, "
+    p.add_argument("files", nargs="*", help="jsonl stream(s): events.jsonl, "
                    "metrics.jsonl, serving jsonl — any mix")
+    p.add_argument("--url", default=None, metavar="http://HOST:PORT",
+                   help="report on a LIVE fabric instead of files: "
+                        "fetches /metrics-summary, /healthz and /metrics "
+                        "from the front end (no file access needed)")
     p.add_argument("--json", action="store_true",
                    help="emit the aggregated report as JSON instead of tables")
     args = p.parse_args(argv)
+    if args.url is None and not args.files:
+        p.error("either jsonl files or --url is required")
+    if args.url:
+        live = build_live_report(args.url)
+        if args.json and not args.files:
+            print(json.dumps({"live": live}, indent=1))
+            return 0
+        print(format_live_report(live))
+        if not args.files:
+            return 0
     report = build_report(load_events(args.files))
     if args.json:
         print(json.dumps(report, indent=1))

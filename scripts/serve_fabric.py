@@ -8,9 +8,12 @@ and drives the heartbeat monitor that turns a dead worker into a
 wire-level failover replay:
 
   POST /v1/generate      -> SSE token stream
-  GET  /healthz          -> fabric + heartbeat health
+  GET  /healthz          -> fabric + heartbeat health (503 until a
+                            replica accepts work)
   POST /drain/<replica>  -> graceful retire (queued work requeues)
   GET  /metrics-summary  -> per-replica engine summaries
+  GET  /metrics          -> the whole fabric as one Prometheus scrape
+                            target (text format 0.0.4)
 
 Two ways to get workers:
 
@@ -48,10 +51,14 @@ def spawn_worker(config_path: str, replica_id: int, role: str, *,
                  capacity: int, tokens_per_tick: int, param_seed: int,
                  jsonl: str | None = None, spans: str | None = None,
                  adapters: list[str] | None = None,
+                 obs_ring: int = 0,
+                 extra_args: list[str] | None = None,
                  timeout_s: float = 120.0) -> tuple[subprocess.Popen, int]:
     """Spawn one serve_worker.py subprocess; returns (proc, port) once
     its READY line arrives.  Shared by this CLI, the tests, and
-    ``bench_serving --service``."""
+    ``bench_serving --service``.  ``obs_ring`` sizes the worker's
+    in-memory span ring (the wire-v5 obs_pull source); ``extra_args``
+    passes any further serve_worker flags verbatim."""
     cmd = [sys.executable,
            os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "serve_worker.py"),
@@ -63,8 +70,11 @@ def spawn_worker(config_path: str, replica_id: int, role: str, *,
         cmd += ["--jsonl", jsonl]
     if spans:
         cmd += ["--spans", spans]
+    if obs_ring:
+        cmd += ["--obs-ring", str(obs_ring)]
     for spec in adapters or []:
         cmd += ["--adapter", spec]
+    cmd += extra_args or []
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
 
@@ -137,6 +147,20 @@ def main() -> int:
                     help="fabric serving_health record stream")
     ap.add_argument("--spans", default=None, metavar="PATH",
                     help="router span stream (trace_export.py input)")
+    ap.add_argument("--obs-stream", default=None, metavar="PATH",
+                    help="merged fabric obs stream: the controller "
+                         "drains every worker's in-memory span ring "
+                         "(wire-v5 obs_pull) into ONE jsonl here, each "
+                         "record stamped obs_src=replicaN — "
+                         "trace_export.py/obs_report.py input for a "
+                         "live multi-host fabric with zero remote file "
+                         "access")
+    ap.add_argument("--obs-pull-s", type=float, default=0.5, metavar="S",
+                    help="obs-ring drain interval (with --obs-stream)")
+    ap.add_argument("--obs-ring", type=int, default=4096, metavar="N",
+                    help="span-ring length passed to SPAWNED workers "
+                         "when --obs-stream is set (externally-started "
+                         "workers set their own --obs-ring)")
     ap.add_argument("--state-dir", default=None, metavar="DIR",
                     help="durable session store for the fabric "
                          "(docs/SERVING.md 'Durable sessions'): "
@@ -179,6 +203,7 @@ def main() -> int:
                 args.config, i, roles[i], capacity=args.capacity,
                 tokens_per_tick=args.tokens_per_tick,
                 param_seed=args.param_seed, adapters=args.adapter,
+                obs_ring=(args.obs_ring if args.obs_stream else 0),
             )
             procs.append(proc)
             addrs.append(f"127.0.0.1:{port}")
@@ -220,8 +245,15 @@ def main() -> int:
                            session_store=session_store)
     health = HeartbeatMonitor(router, interval_ms=args.heartbeat_ms,
                               miss_threshold=args.miss_threshold, emit=emit)
-    controller = FabricController(router, health=health,
-                                  adapters=adapter_store, emit=emit)
+    obs_sink = None
+    if args.obs_stream:
+        open(args.obs_stream, "w").close()
+        obs_sink = lambda rec: append_jsonl(args.obs_stream, rec)  # noqa: E731
+    controller = FabricController(
+        router, health=health, adapters=adapter_store, emit=emit,
+        obs_pull_s=(args.obs_pull_s if args.obs_stream else 0.0),
+        obs_sink=obs_sink,
+    )
     controller.start()
     http = FabricHTTPServer(controller, args.http_host, args.http_port)
     port = http.start_background()

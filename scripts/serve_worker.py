@@ -35,6 +35,45 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _start_metrics_server(host: str, port: int, metrics, worker, *,
+                          replica_id: int, role: str) -> int:
+    """Per-worker Prometheus exposition on its own daemon thread
+    (stdlib http.server): the same replica families the front end's
+    fabric-wide /metrics renders, scoped to this one engine — a
+    per-host scrape target that survives a front-end outage.  Returns
+    the bound port (``port=0`` picks an ephemeral one)."""
+    import http.server
+    import threading
+
+    from mamba_distributed_tpu.obs import prom
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — stdlib handler name
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            snap = {
+                "replica": replica_id, "role": role,
+                "summary": metrics.summary(),
+                "histograms": metrics.histogram_dicts(),
+                "stats": worker._stats(),
+            }
+            body = prom.render(prom.replica_families([snap])).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", prom.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *_args):  # silence per-scrape stderr spam
+            pass
+
+    srv = http.server.ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="worker-metrics").start()
+    return srv.server_address[1]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     src = ap.add_mutually_exclusive_group(required=True)
@@ -71,6 +110,42 @@ def main() -> int:
     ap.add_argument("--spans", default=None, metavar="PATH",
                     help="this replica's span stream (trace_export.py "
                          "merges it with the server's)")
+    ap.add_argument("--span-rotate-bytes", type=int, default=0,
+                    metavar="N",
+                    help="roll the --spans jsonl to <path>.1 when it "
+                         "would exceed N bytes (0 = never; one rolled "
+                         "generation is kept and obs/export.load_jsonl "
+                         "reads the pair in order)")
+    ap.add_argument("--obs-ring", type=int, default=0, metavar="N",
+                    help="keep the last N span/event records in memory "
+                         "for the fabric's obs_pull RPC (wire v5) — the "
+                         "controller drains them into one merged stream, "
+                         "so a ring-only worker (--obs-ring without "
+                         "--spans) ships live telemetry with ZERO local "
+                         "files")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="additionally expose THIS worker's Prometheus "
+                         "/metrics on PORT (0 = ephemeral; see the READY "
+                         "line) — per-host scrapers keep working when "
+                         "the front end is down")
+    ap.add_argument("--compile-watchdog", action="store_true",
+                    help="count/time every XLA backend compile "
+                         "(jax.monitoring; falls back to polling the "
+                         "engine's trace counters), stamping compiles/"
+                         "compile_ms on tick records and /metrics")
+    ap.add_argument("--compile-thrash-threshold", type=int, default=0,
+                    metavar="N",
+                    help="raise one compile_thrash obs event per window "
+                         "when more than N compiles land in it (0 = "
+                         "never; needs --compile-watchdog)")
+    ap.add_argument("--compile-thrash-window-s", type=float, default=60.0,
+                    metavar="S", help="compile-thrash window length")
+    ap.add_argument("--tick-regression-factor", type=float, default=0.0,
+                    metavar="F",
+                    help="emit tick_regression/tick_recovered obs events "
+                         "when the EWMA tick latency exceeds F x its "
+                         "rolling baseline (0 = off; obs/slo.py)")
     ap.add_argument("--state-dir", default=None, metavar="DIR",
                     help="durable session store for this engine "
                          "(docs/SERVING.md 'Durable sessions'): the "
@@ -106,8 +181,34 @@ def main() -> int:
         params = init_lm_params(jax.random.PRNGKey(args.param_seed), cfg)
     metrics = ServingMetrics(args.capacity, jsonl_path=args.jsonl,
                              replica=args.replica_id)
-    tracer = SpanTracer(args.spans) if args.spans else NULL_TRACER
+    # a ring-only tracer (--obs-ring, no --spans) touches no files at
+    # all: the controller's obs_pull drain is its only consumer
+    if args.spans or args.obs_ring:
+        tracer = SpanTracer(args.spans, ring_len=args.obs_ring,
+                            rotate_bytes=args.span_rotate_bytes)
+    else:
+        tracer = NULL_TRACER
     engine_kw = {}
+    if args.compile_watchdog:
+        from mamba_distributed_tpu.obs import CompileWatchdog
+        from mamba_distributed_tpu.serving import engine as engine_mod
+
+        watchdog = CompileWatchdog(
+            thrash_threshold=args.compile_thrash_threshold,
+            thrash_window_s=args.compile_thrash_window_s,
+            tracer=tracer,
+        )
+        if not watchdog.install():
+            # no jax.monitoring on this build: poll the shared jit
+            # entry points' trace counters instead (coarser — no
+            # durations, but the thrash sentinel still works)
+            watchdog.attach_trace_counts(engine_mod.TRACE_COUNTS)
+        engine_kw["compile_watchdog"] = watchdog
+    if args.tick_regression_factor:
+        from mamba_distributed_tpu.obs import TickRegressionDetector
+
+        engine_kw["tick_regression"] = TickRegressionDetector(
+            factor=args.tick_regression_factor, tracer=tracer)
     if args.adapter:
         from mamba_distributed_tpu.serving.adapters import (
             AdapterRegistry,
@@ -141,11 +242,17 @@ def main() -> int:
         tokens_per_tick=args.tokens_per_tick, **engine_kw,
     )
     worker = WorkerServer(replica, args.host, args.port)
+    metrics_port = ""
+    if args.metrics_port is not None:
+        port = _start_metrics_server(
+            args.host, args.metrics_port, metrics, worker,
+            replica_id=args.replica_id, role=args.role)
+        metrics_port = f" metrics_port={port}"
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: worker.request_term())
     print(
         f"SERVE_WORKER_READY replica={args.replica_id} role={args.role} "
-        f"port={worker.port} pid={os.getpid()}",
+        f"port={worker.port} pid={os.getpid()}{metrics_port}",
         flush=True,
     )
     worker.serve_forever()

@@ -630,3 +630,34 @@ def test_bench_serving_park_smoke(tmp_path):
     )
     assert g.returncode == 0, g.stdout + g.stderr
     assert "park_resume_cpu" in g.stdout
+
+
+@pytest.mark.obs
+@pytest.mark.metrics
+@pytest.mark.fast
+def test_metrics_schema_gate(tmp_path):
+    """The /metrics schema drift gate (ISSUE 17 satellite): every
+    family obs/prom.py can emit is documented in the OBSERVABILITY.md
+    metric table and vice versa — and the gate actually fails loud in
+    BOTH drift directions."""
+    gate = os.path.join(REPO, "scripts", "check_metrics_schema.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, gate], capture_output=True,
+                       text=True, cwd=REPO, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "metrics schema ok" in r.stdout
+
+    # rename one documented family: now one STALE doc row AND one
+    # UNDOCUMENTED emitted family
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
+        doc = f.read()
+    assert "`mamba_ticks_total`" in doc
+    broken = tmp_path / "broken.md"
+    broken.write_text(doc.replace("`mamba_ticks_total`",
+                                  "`mamba_ticks_renamed`"))
+    r = subprocess.run([sys.executable, gate, "--doc", str(broken)],
+                       capture_output=True, text=True, cwd=REPO, env=env,
+                       timeout=120)
+    assert r.returncode == 1
+    assert "mamba_ticks_total" in r.stdout  # UNDOCUMENTED
+    assert "mamba_ticks_renamed" in r.stdout  # STALE

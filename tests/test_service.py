@@ -97,7 +97,8 @@ class Fabric:
 
     def __init__(self, cfg, tmp_path, *, n=2, roles=None, capacity=3,
                  tokens_per_tick=2, heartbeat_ms=100.0, miss_threshold=2,
-                 spans=False):
+                 spans=False, obs_ring=0, obs_pull_s=0.0,
+                 worker_args=None):
         self.tmp = tmp_path
         roles = roles or ["mixed"] * n
         self.cfg_path = str(tmp_path / "cfg.json")
@@ -116,6 +117,10 @@ class Fabric:
                 span_path = str(tmp_path / f"worker{i}.jsonl")
                 self.worker_spans.append(span_path)
                 cmd += ["--spans", span_path]
+            if obs_ring:
+                cmd += ["--obs-ring", str(obs_ring)]
+            if worker_args:
+                cmd += list(worker_args)
             self.procs.append(subprocess.Popen(
                 cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, cwd=REPO, env=env,
@@ -138,6 +143,13 @@ class Fabric:
         self.server_spans = str(tmp_path / "server.jsonl") if spans else None
         self.health_jsonl = str(tmp_path / "health.jsonl")
         open(self.health_jsonl, "w").close()
+        # live telemetry plane: the controller drains worker obs rings
+        # into this merged jsonl when obs_pull_s is on
+        self._obs_pull_s = obs_pull_s
+        self.obs_stream = (
+            str(tmp_path / "obs_stream.jsonl") if obs_pull_s else None)
+        if self.obs_stream:
+            open(self.obs_stream, "w").close()
         self._start_front_end(spans=spans)
 
     def _start_front_end(self, spans=False):
@@ -159,7 +171,12 @@ class Fabric:
             miss_threshold=self._miss,
             emit=lambda rec: append_jsonl(self.health_jsonl, rec),
         )
-        self.controller = FabricController(self.router, health=self.health)
+        obs_sink = None
+        if self.obs_stream:
+            obs_sink = lambda rec: append_jsonl(self.obs_stream, rec)
+        self.controller = FabricController(
+            self.router, health=self.health,
+            obs_pull_s=self._obs_pull_s, obs_sink=obs_sink)
         self.controller.start()
         self.http = FabricHTTPServer(self.controller)
         self.port = self.http.start_background()
@@ -187,6 +204,25 @@ class Fabric:
 
     def get(self, path):
         return svc_client.http_json("127.0.0.1", self.port, "GET", path)
+
+    def get_raw(self, path):
+        """(status, content_type, body_text) — for non-JSON endpoints
+        like the Prometheus /metrics exposition."""
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                          timeout=60)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return (resp.status, resp.getheader("Content-Type"),
+                    resp.read().decode("utf-8"))
+        finally:
+            conn.close()
+
+    def obs_records(self):
+        with open(self.obs_stream) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
 
     def post(self, path, body=None):
         return svc_client.http_json("127.0.0.1", self.port, "POST", path,
