@@ -359,6 +359,33 @@ class ModelConfig:
     # default engine/router path carries no store and is byte-stable.
     session_ttl_s: float = 0.0
     session_host_bytes: int = 0
+    # --- elastic serving fabric (serving/autoscale/; docs/SERVING.md
+    # "Elastic fabric") ---
+    # Admission control: fabric-wide queued-request cap above which the
+    # router sheds new submits (the named AdmissionRejected -> HTTP 429
+    # + Retry-After), and the default per-request queue deadline in
+    # milliseconds (requests carrying queue_deadline_ms=None inherit
+    # it; shed when the estimated wait exceeds it).  Both 0 (default)
+    # = admission control off, the byte-stable status quo.
+    admission_queue_cap: int = 0
+    admission_deadline_ms: float = 0.0
+    # Autoscaling: per-tier fleet ceiling for the AutoscaleController
+    # (0 = autoscaling off — the fleet stays operator-sized) and floor,
+    # cooldowns after scale-up / any scaling action before the next
+    # up / down, consecutive pressured (breached-or-deep-queue) and
+    # healthy evaluations before acting (flap absorption), and the
+    # mean-queued-per-accepting-replica thresholds that count as
+    # pressure / health (the band between them is hysteresis dead
+    # zone).  serving/autoscale/controller.AutoscalePolicy validates
+    # the cross-field constraints; these knobs only feed it.
+    autoscale_max_replicas: int = 0
+    autoscale_min_replicas: int = 1
+    autoscale_up_cooldown_s: float = 5.0
+    autoscale_down_cooldown_s: float = 30.0
+    autoscale_breach_evals: int = 3
+    autoscale_clear_evals: int = 10
+    autoscale_queue_high: float = 2.0
+    autoscale_queue_low: float = 0.5
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
@@ -525,6 +552,32 @@ class ModelConfig:
                 f"session_host_bytes must be >= 0 (0 = write-through to "
                 f"the disk tier), got {self.session_host_bytes}"
             )
+        if self.admission_queue_cap < 0:
+            raise ValueError(
+                f"admission_queue_cap must be >= 0 (0 = no cap), got "
+                f"{self.admission_queue_cap}"
+            )
+        if self.admission_deadline_ms < 0:
+            raise ValueError(
+                f"admission_deadline_ms must be >= 0 (0 = no default "
+                f"deadline), got {self.admission_deadline_ms}"
+            )
+        if self.autoscale_max_replicas < 0:
+            raise ValueError(
+                f"autoscale_max_replicas must be >= 0 (0 = autoscaling "
+                f"off), got {self.autoscale_max_replicas}"
+            )
+        if self.autoscale_min_replicas < 1:
+            raise ValueError(
+                f"autoscale_min_replicas must be >= 1, got "
+                f"{self.autoscale_min_replicas}"
+            )
+        if self.autoscale_max_replicas:
+            # the cross-field policy constraints (min <= max, low <=
+            # high, positive eval counts, non-negative cooldowns) live
+            # with AutoscalePolicy — build one so a bad config fails
+            # HERE at validation, not at the first controller tick
+            self.autoscale_policy()
         if self.attn_impl not in ("auto", "xla", "pallas"):
             raise ValueError(
                 f"attn_impl must be 'auto', 'xla' or 'pallas', got "
@@ -543,6 +596,28 @@ class ModelConfig:
                     f"moe_top_k={self.moe_top_k} must be in "
                     f"[1, {self.moe_num_experts}]"
                 )
+
+    def autoscale_policy(self):
+        """The ``serving.autoscale.AutoscalePolicy`` these knobs
+        describe (its ``__post_init__`` validates the cross-field
+        constraints).  Only meaningful with ``autoscale_max_replicas``
+        > 0 — callers gate on that, this just packages the fields.
+        Lazy import: config must stay importable without the serving
+        stack."""
+        from mamba_distributed_tpu.serving.autoscale.controller import (
+            AutoscalePolicy,
+        )
+
+        return AutoscalePolicy(
+            min_replicas=self.autoscale_min_replicas,
+            max_replicas=self.autoscale_max_replicas,
+            scale_up_cooldown_s=self.autoscale_up_cooldown_s,
+            scale_down_cooldown_s=self.autoscale_down_cooldown_s,
+            breach_evals_up=self.autoscale_breach_evals,
+            clear_evals_down=self.autoscale_clear_evals,
+            queue_depth_high=self.autoscale_queue_high,
+            queue_depth_low=self.autoscale_queue_low,
+        )
 
     @property
     def vocab_size_padded(self) -> int:

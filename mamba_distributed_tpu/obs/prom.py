@@ -273,9 +273,15 @@ def replica_families(snapshots: list[dict]) -> list[MetricFamily]:
 
 def fabric_families(*, replicas: int, accepting: int, ready: bool,
                     obs_records_pulled: int | None = None,
-                    obs_records_dropped: int | None = None
+                    obs_records_dropped: int | None = None,
+                    queue_depth: int | None = None,
+                    sheds: dict | None = None,
+                    autoscale: dict | None = None
                     ) -> list[MetricFamily]:
-    """The controller's own fabric-level gauges (no replica label)."""
+    """The controller's own fabric-level gauges (no replica label).
+    ``queue_depth``/``sheds``/``autoscale`` are None-gated like the obs
+    counters: a fabric without admission control or an autoscaler
+    renders byte-identically to the pre-elastic exposition."""
     fams = [
         _fam("fabric_replicas", "gauge",
              "Replicas registered with the router.").add(replicas),
@@ -293,6 +299,27 @@ def fabric_families(*, replicas: int, accepting: int, ready: bool,
         fams.append(_fam("fabric_obs_records_dropped_total", "counter",
                          "Ring records that aged out before a pull "
                          "(cursor gaps).").add(obs_records_dropped))
+    if queue_depth is not None:
+        fams.append(_fam("fabric_queue_depth", "gauge",
+                         "Queued-but-unstarted requests fabric-wide "
+                         "(what the admission cap bounds).")
+                    .add(queue_depth))
+    if sheds is not None:
+        fam = _fam("fabric_admission_sheds_total", "counter",
+                   "Requests shed at the front door, by reason "
+                   "(AdmissionRejected -> HTTP 429).")
+        for reason in ("queue_cap", "queue_deadline"):
+            fam.add(sheds.get(reason, 0), reason=reason)
+        fams.append(fam)
+    if autoscale is not None:
+        fams += [
+            _fam("fabric_autoscale_scale_ups_total", "counter",
+                 "Replicas live-attached by the autoscaler.")
+            .add(autoscale.get("scale_ups", 0)),
+            _fam("fabric_autoscale_scale_downs_total", "counter",
+                 "Replicas drained for retirement by the autoscaler.")
+            .add(autoscale.get("scale_downs", 0)),
+        ]
     return fams
 
 

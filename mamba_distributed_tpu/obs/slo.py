@@ -138,6 +138,13 @@ class SLOMonitor:
                 else:
                     self.tracer.event("slo_recovered", **attrs)
 
+    def any_breach(self) -> bool:
+        """True while ANY targeted metric's rolling p95 is in breach —
+        the latency half of the autoscaler's pressure signal
+        (serving/autoscale/controller.py reads it every tick; a bool
+        read, no recompute)."""
+        return any(self._in_breach.values())
+
     # ------------------------------------------------------------ roll-up
 
     def summary(self) -> dict:

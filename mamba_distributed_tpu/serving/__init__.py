@@ -41,6 +41,13 @@ docs/SERVING.md has the architecture; the short version:
                migration artifact — parked sessions cost zero device
                memory and resume bit-exactly on any replica
                (docs/SERVING.md "Durable sessions")
+  autoscale/   elastic fabric control plane: SLO/queue-driven
+               AutoscaleController sizing the fleet through a
+               ReplicaProvisioner (live-attach via router.add_replica,
+               drain-based scale-down), plus AdmissionController load
+               shedding — queue deadlines + a fabric queue cap, the
+               named AdmissionRejected -> HTTP 429
+               (docs/SERVING.md "Elastic fabric")
   service/     the deployable shape of all of the above: versioned
                wire codec, one replica per worker PROCESS, an asyncio
                HTTP/SSE front end running the UNCHANGED router, and
@@ -54,6 +61,15 @@ from mamba_distributed_tpu.serving.adapters import (
     AdapterCacheError,
     AdapterRegistry,
     UnknownAdapterError,
+)
+from mamba_distributed_tpu.serving.autoscale import (
+    AdmissionController,
+    AdmissionRejected,
+    AutoscaleController,
+    AutoscalePolicy,
+    EngineProvisioner,
+    ProcessProvisioner,
+    ReplicaProvisioner,
 )
 from mamba_distributed_tpu.serving.engine import ServingEngine
 from mamba_distributed_tpu.serving.prefix_cache import (
@@ -101,6 +117,13 @@ __all__ = [
     "AdapterCacheError",
     "AdapterRegistry",
     "UnknownAdapterError",
+    "AdmissionController",
+    "AdmissionRejected",
+    "AutoscaleController",
+    "AutoscalePolicy",
+    "EngineProvisioner",
+    "ProcessProvisioner",
+    "ReplicaProvisioner",
     "ChunkPlan",
     "DiskSessionStore",
     "Drafter",

@@ -1,0 +1,38 @@
+"""Elastic serving fabric: SLO-driven autoscaling + admission control.
+
+The control plane over the fabric's sensors (docs/SERVING.md "Elastic
+fabric"): ``AutoscaleController`` sizes the fleet from SLO breach
+transitions and queue-depth gauges through a ``ReplicaProvisioner``
+(in-process engines or spawned worker processes), and
+``AdmissionController`` sheds requests at the front door — per-request
+queue deadlines plus a fabric queue-depth cap — raising the named
+``AdmissionRejected`` (HTTP 429 + Retry-After on the service front
+end) instead of letting overload turn into timeout-collapse.
+
+Everything here is opt-in: a router with ``admission=None`` and no
+controller ticking is byte-identical to the pre-autoscale fabric.
+"""
+
+from mamba_distributed_tpu.serving.autoscale.admission import (
+    AdmissionController,
+    AdmissionRejected,
+)
+from mamba_distributed_tpu.serving.autoscale.controller import (
+    AutoscaleController,
+    AutoscalePolicy,
+)
+from mamba_distributed_tpu.serving.autoscale.provisioner import (
+    EngineProvisioner,
+    ProcessProvisioner,
+    ReplicaProvisioner,
+)
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "AutoscaleController",
+    "AutoscalePolicy",
+    "EngineProvisioner",
+    "ProcessProvisioner",
+    "ReplicaProvisioner",
+]

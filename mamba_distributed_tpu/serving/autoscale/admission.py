@@ -1,0 +1,218 @@
+"""Admission control: router-level load shedding for the serving fabric.
+
+Under sustained overload an infinite-patience queue destroys goodput
+twice: every queued request eventually blows its latency target (the
+tokens still get generated — they are just worthless by the time they
+arrive), and the work spent on those doomed requests starves the
+requests that could still have met theirs.  The fix is old queueing
+theory: reject FAST at the front door once the queue implies a wait the
+request will not tolerate, so capacity is spent only on requests that
+can still attain the SLO (the ``overload_shed_cpu`` bench row measures
+exactly this — shedding-on goodput strictly above shedding-off at 2x
+offered load).
+
+``AdmissionController`` gates ``RequestRouter.submit`` (the fabric's
+ONE front door — failover re-placement, drain requeue, migration and
+parked-session resume all bypass it by construction, so an admitted
+request is never shed mid-flight):
+
+  * **queue-depth cap**: fabric-wide queued-but-unstarted requests at
+    or above ``queue_cap`` reject immediately — the coarse valve that
+    bounds queue memory and worst-case drain time no matter what the
+    per-request deadlines say;
+  * **queue-deadline**: the request's ``queue_deadline_ms`` (or the
+    fabric default) against the estimated wait-for-a-slot; a request
+    that would blow its deadline is rejected NOW rather than timed out
+    later.
+
+Rejections raise the named ``AdmissionRejected`` carrying a
+``retry_after_s`` hint (HTTP 429 + Retry-After on the front end —
+serving/service/server.py) — never a silent drop, never a hang.
+
+The wait estimate is deliberately simple and host-only: requests ahead
+of this one admit in waves of ``capacity``, each wave holding a slot
+for ``service_ms`` (an EWMA the owner feeds via ``observe_service_ms``
+— the bench calibrates it from a closed-loop pass, the service from
+finished-request records — with a configured prior before any
+observation).  An estimator that is wrong by 2x still sheds the right
+requests under real overload, because at 2x offered load the queue
+grows without bound and every estimate crosses every deadline soon.
+"""
+
+from __future__ import annotations
+
+
+class AdmissionRejected(RuntimeError):
+    """A request the fabric refused at the front door (shed, not
+    failed): the queue-depth cap is hit or the estimated queue wait
+    blows the request's deadline.  Carries the machine-readable shed
+    ``reason`` ("queue_cap" | "queue_deadline") and a ``retry_after_s``
+    back-off hint the HTTP front end surfaces as 429 + Retry-After."""
+
+    def __init__(self, reason: str, *, retry_after_s: float,
+                 queue_depth: int, estimate_ms: float | None = None,
+                 deadline_ms: float | None = None):
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+        self.estimate_ms = estimate_ms
+        self.deadline_ms = deadline_ms
+        if reason == "queue_cap":
+            msg = (f"admission rejected: fabric queue depth {queue_depth} "
+                   f"at cap; retry after {retry_after_s:.3f}s")
+        else:
+            msg = (f"admission rejected: estimated queue wait "
+                   f"{estimate_ms:.0f}ms blows the {deadline_ms:.0f}ms "
+                   f"deadline; retry after {retry_after_s:.3f}s")
+        super().__init__(msg)
+
+
+def _load_signals(rep) -> tuple[int, int, int]:
+    """(queued, resident, capacity) for one replica, duck-typed across
+    the fabric's two replica kinds: a ``RemoteReplica`` reports its
+    last heartbeat stats (the same numbers its worker's engine would),
+    an in-process ``EngineReplica`` is read directly."""
+    stats = getattr(rep, "stats", None)
+    if stats is not None:  # RemoteReplica: heartbeat-cached signals
+        return (int(stats.get("depth", 0)), int(stats.get("resident", 0)),
+                max(1, int(stats.get("capacity", 1))))
+    eng = rep.engine
+    return eng.scheduler.depth, len(eng._slots), max(1, eng.capacity)
+
+
+class AdmissionController:
+    """Front-door load shedding over a replica set.
+
+    Args:
+      queue_cap: fabric-wide queued-request cap (0 = no cap).
+      default_deadline_ms: queue deadline applied to requests that
+        carry ``queue_deadline_ms=None`` (0 = no default: such requests
+        wait forever, the pre-admission behavior).
+      service_ms: prior for the per-request slot-hold estimate (ms)
+        until ``observe_service_ms`` has fed real observations.
+      service_alpha: EWMA weight of each new service-time observation.
+      metrics: optional ``utils.metrics.ServingMetrics`` mirror —
+        ``configure_admission()`` is called on it and every shed
+        recorded, unlocking the summary's ``admission`` section.
+
+    Both knobs at 0 never sheds (but still counts nothing and stamps
+    nothing — construct only when admission is ON; the router treats
+    ``admission=None`` as the byte-stable status quo).
+    """
+
+    def __init__(self, *, queue_cap: int = 0,
+                 default_deadline_ms: float = 0.0,
+                 service_ms: float = 100.0, service_alpha: float = 0.2,
+                 metrics=None):
+        if queue_cap < 0:
+            raise ValueError(f"queue_cap must be >= 0 (0 = no cap), "
+                             f"got {queue_cap}")
+        if default_deadline_ms < 0:
+            raise ValueError(f"default_deadline_ms must be >= 0 (0 = "
+                             f"none), got {default_deadline_ms}")
+        if service_ms <= 0:
+            raise ValueError(f"service_ms prior must be > 0, "
+                             f"got {service_ms}")
+        if not 0.0 < service_alpha <= 1.0:
+            raise ValueError(f"service_alpha must be in (0, 1], "
+                             f"got {service_alpha}")
+        self.queue_cap = queue_cap
+        self.default_deadline_ms = default_deadline_ms
+        self.service_ms = service_ms
+        self.service_alpha = service_alpha
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.configure_admission()
+        self.admitted = 0
+        self.sheds = 0
+        self.sheds_cap = 0
+        self.sheds_deadline = 0
+
+    # ------------------------------------------------------------- signals
+
+    def observe_service_ms(self, dt_ms: float) -> None:
+        """Feed one observed per-request slot-hold time (admit ->
+        finish, milliseconds) into the EWMA the wait estimate uses."""
+        if dt_ms <= 0:
+            return
+        a = self.service_alpha
+        self.service_ms = (1 - a) * self.service_ms + a * dt_ms
+
+    def queue_depth(self, replicas) -> int:
+        """Fabric-wide queued-but-unstarted requests (resident work
+        holds slots, not queue positions — the cap bounds WAITING)."""
+        return sum(_load_signals(r)[0] for r in replicas if r.accepting)
+
+    def estimate_wait_ms(self, replicas) -> float:
+        """Estimated wait for a slot on the BEST accepting replica:
+        requests ahead admit in waves of that replica's capacity, each
+        wave holding slots for ``service_ms``.  0 when a free slot and
+        an empty queue exist anywhere; +inf when nothing accepts."""
+        best = None
+        for rep in replicas:
+            if not rep.accepting:
+                continue
+            depth, resident, cap = _load_signals(rep)
+            free = max(0, cap - resident)
+            if free > 0 and depth == 0:
+                return 0.0
+            waves = max(0, depth - free + cap) // cap
+            est = waves * self.service_ms
+            if best is None or est < best:
+                best = est
+        return float("inf") if best is None else best
+
+    # ------------------------------------------------------------ the gate
+
+    def check(self, request, replicas) -> None:
+        """Admit or shed one front-door request; raises
+        ``AdmissionRejected`` on shed, returns None on admit.  Called
+        by ``RequestRouter.submit`` BEFORE placement, so a shed request
+        never touches a scheduler queue (nothing to strand)."""
+        depth = self.queue_depth(replicas)
+        if self.queue_cap and depth >= self.queue_cap:
+            self._shed("queue_cap")
+            raise AdmissionRejected(
+                "queue_cap",
+                retry_after_s=round(self.service_ms / 1000.0, 3),
+                queue_depth=depth,
+            )
+        deadline = getattr(request, "queue_deadline_ms", None)
+        if deadline is None:
+            deadline = self.default_deadline_ms
+        if deadline:
+            est = self.estimate_wait_ms(replicas)
+            if est > deadline:
+                self._shed("queue_deadline")
+                over_s = ((est - deadline) / 1000.0
+                          if est != float("inf")
+                          else self.service_ms / 1000.0)
+                raise AdmissionRejected(
+                    "queue_deadline",
+                    retry_after_s=round(max(0.001, over_s), 3),
+                    queue_depth=depth, estimate_ms=est,
+                    deadline_ms=deadline,
+                )
+        self.admitted += 1
+
+    def _shed(self, reason: str) -> None:
+        self.sheds += 1
+        if reason == "queue_cap":
+            self.sheds_cap += 1
+        else:
+            self.sheds_deadline += 1
+        if self.metrics is not None:
+            self.metrics.record_shed(reason)
+
+    # ------------------------------------------------------------- roll-up
+
+    def summary(self) -> dict:
+        return {
+            "queue_cap": self.queue_cap,
+            "default_deadline_ms": self.default_deadline_ms,
+            "service_ms": round(self.service_ms, 3),
+            "admitted": self.admitted,
+            "sheds": self.sheds,
+            "sheds_cap": self.sheds_cap,
+            "sheds_deadline": self.sheds_deadline,
+        }

@@ -1,0 +1,284 @@
+"""SLO-driven autoscaling: the policy loop that sizes the fleet.
+
+PR 17 built the fabric's sensors — ``obs.SLOMonitor`` breach/recovery
+transitions, per-replica queue/occupancy gauges, the ``/metrics``
+plane.  This controller is the first thing that ACTS on them: a
+host-side evaluate-decide loop (``tick()`` — called from the
+``FabricController`` run loop or any bench/step loop; never a thread of
+its own, so tests drive it deterministically with an injected clock)
+that scales each tier of the fleet between ``min_replicas`` and
+``max_replicas``:
+
+  * **scale UP** when the tier is pressured — the shared SLOMonitor is
+    in breach on any targeted metric, or mean queued work per accepting
+    replica crosses ``queue_depth_high`` — for ``breach_evals_up``
+    consecutive evaluations AND the up-cooldown has elapsed: one new
+    replica from the ``ReplicaProvisioner`` live-attaches via
+    ``RequestRouter.add_replica`` (in-flight streams never pause; the
+    next placement simply sees one more candidate);
+  * **scale DOWN** when the tier has been healthy — no breach and mean
+    queue depth under ``queue_depth_low`` — for ``clear_evals_down``
+    consecutive evaluations AND the down-cooldown has elapsed since the
+    last scaling action in either direction: the least-loaded accepting
+    replica drains through the router's existing path
+    (``drain(requeue_queued=True)`` — queued work re-places on the
+    survivors, or drain-parks into the session store; PR-16 means no
+    stream is ever lost), then retires once its pending count reaches
+    zero.
+
+Hysteresis is deliberate and layered: consecutive-evaluation counts
+absorb breach FLAPPING (a single noisy p95 window must not buy a
+replica), cooldowns absorb oscillation (capacity added needs time to
+drain the queue before the signal is trusted again), and the
+down-cooldown keys off the last action in EITHER direction so a
+scale-up is never immediately clawed back.
+
+Tiers size independently (the PR-10 disaggregation contract): each role
+present among the managed replicas gets its own counters, cooldowns and
+min/max, so a prefill brownout buys prefill capacity without touching
+the decode tier.
+
+Every decision is one ``autoscale_*`` event record through the tracer
+(docs/OBSERVABILITY.md) — transitions, never a per-tick flood.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from mamba_distributed_tpu.obs import NULL_TRACER
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Sizing policy for ONE tier (applied per role by the controller).
+
+    Args:
+      min_replicas / max_replicas: fleet bounds per tier (scale-down
+        never drops below min; scale-up never exceeds max).
+      scale_up_cooldown_s: wall seconds after any scale-up before the
+        next one — new capacity needs time to drain the queue before
+        the pressure signal means anything.
+      scale_down_cooldown_s: wall seconds after the last scaling action
+        in EITHER direction before a scale-down — an up must never be
+        immediately clawed back.
+      breach_evals_up: consecutive pressured evaluations before a
+        scale-up (flap absorption: one noisy p95 window buys nothing).
+      clear_evals_down: consecutive healthy evaluations before a
+        scale-down (asymmetric on purpose — adding capacity late costs
+        goodput, removing it early costs a re-spawn).
+      queue_depth_high / queue_depth_low: mean queued-but-unstarted
+        requests per accepting replica that count as pressure /
+        health; the band between them is dead zone (neither counter
+        advances) so depth jitter never oscillates the fleet.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_cooldown_s: float = 5.0
+    scale_down_cooldown_s: float = 30.0
+    breach_evals_up: int = 3
+    clear_evals_down: int = 10
+    queue_depth_high: float = 2.0
+    queue_depth_low: float = 0.5
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if self.scale_up_cooldown_s < 0 or self.scale_down_cooldown_s < 0:
+            raise ValueError("cooldowns must be >= 0")
+        if self.breach_evals_up < 1 or self.clear_evals_down < 1:
+            raise ValueError(
+                "breach_evals_up and clear_evals_down must be >= 1 "
+                "(1 = act on the first evaluation)"
+            )
+        if self.queue_depth_low > self.queue_depth_high:
+            raise ValueError(
+                f"queue_depth_low ({self.queue_depth_low}) must be <= "
+                f"queue_depth_high ({self.queue_depth_high}) — the band "
+                f"between them is the hysteresis dead zone"
+            )
+
+
+@dataclasses.dataclass
+class _TierState:
+    """Per-role policy-loop state."""
+
+    pressure_evals: int = 0
+    clear_evals: int = 0
+    last_up: float = float("-inf")
+    last_down: float = float("-inf")
+
+
+def _queued(rep) -> int:
+    """Queued-but-unstarted requests on one replica (duck-typed like
+    admission's signals: RemoteReplica stats vs in-process engine)."""
+    stats = getattr(rep, "stats", None)
+    if stats is not None:
+        return int(stats.get("depth", 0))
+    return rep.engine.scheduler.depth
+
+
+class AutoscaleController:
+    """The evaluate-decide loop over one router + one provisioner.
+
+    Args:
+      router: the ``RequestRouter`` whose fleet this sizes.
+      provisioner: where new replicas come from / retired ones go
+        (serving/autoscale/provisioner.py).
+      policy: ``AutoscalePolicy`` applied to every managed tier.
+      slo: optional shared ``obs.SLOMonitor`` — its ``any_breach()``
+        is the latency half of the pressure signal (queue depth alone
+        drives scaling when None).
+      roles: tiers to manage; None = the roles present on the router's
+        replicas at construction.
+      tracer: ``autoscale_*`` event records land here.
+      clock: injected monotonic-seconds source (tests pin cooldowns
+        without sleeping; ``tick(now=...)`` overrides per call).
+    """
+
+    def __init__(self, router, provisioner, policy: AutoscalePolicy
+                 | None = None, *, slo=None, roles=None,
+                 tracer=NULL_TRACER, clock=time.monotonic):
+        self.router = router
+        self.provisioner = provisioner
+        self.policy = policy or AutoscalePolicy()
+        self.slo = slo
+        self.tracer = tracer
+        self.clock = clock
+        if roles is None:
+            roles = []
+            for rep in router.replicas:
+                if rep.role not in roles:
+                    roles.append(rep.role)
+        self.roles = tuple(roles)
+        self._tiers = {role: _TierState() for role in self.roles}
+        # replicas drained by a scale-down, awaiting pending == 0
+        self._retiring: list = []
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.ticks = 0
+
+    # ------------------------------------------------------------- signals
+
+    def _tier_replicas(self, role: str) -> list:
+        retiring = set(id(r) for r in self._retiring)
+        return [r for r in self.router.replicas
+                if r.role == role and r.accepting
+                and id(r) not in retiring]
+
+    def _mean_depth(self, reps) -> float:
+        if not reps:
+            return float("inf")  # an empty accepting tier is pressure
+        return sum(_queued(r) for r in reps) / len(reps)
+
+    # ---------------------------------------------------------- the loop
+
+    def tick(self, now: float | None = None) -> None:
+        """One policy evaluation: sweep retiring replicas, then judge
+        each tier's pressure/health counters against the cooldowns.
+        Cheap enough for every fabric iteration (a few int reads per
+        replica; no device work, no syncs)."""
+        if now is None:
+            now = self.clock()
+        self.ticks += 1
+        self._sweep_retiring()
+        breach = self.slo is not None and self.slo.any_breach()
+        for role, st in self._tiers.items():
+            reps = self._tier_replicas(role)
+            depth = self._mean_depth(reps)
+            pressured = breach or depth >= self.policy.queue_depth_high
+            healthy = not breach and depth <= self.policy.queue_depth_low
+            if pressured:
+                st.pressure_evals += 1
+                st.clear_evals = 0
+                if (st.pressure_evals >= self.policy.breach_evals_up
+                        and len(reps) < self.policy.max_replicas
+                        and now - st.last_up
+                        >= self.policy.scale_up_cooldown_s):
+                    self._scale_up(role, st, now,
+                                   reason=("slo_breach" if breach
+                                           else "queue_depth"),
+                                   depth=depth)
+            elif healthy:
+                st.clear_evals += 1
+                st.pressure_evals = 0
+                if (st.clear_evals >= self.policy.clear_evals_down
+                        and len(reps) > self.policy.min_replicas
+                        and now - max(st.last_up, st.last_down)
+                        >= self.policy.scale_down_cooldown_s):
+                    self._scale_down(role, st, now, reps, depth=depth)
+            # in the dead zone between the depth thresholds (and not in
+            # breach) neither counter advances: jitter around one
+            # threshold can't walk the other counter toward an action
+
+    def _scale_up(self, role: str, st: _TierState, now: float, *,
+                  reason: str, depth: float) -> None:
+        new_id = len(self.router.replicas)
+        rep = self.provisioner.provision(new_id, role)
+        self.router.add_replica(rep)
+        st.last_up = now
+        st.pressure_evals = 0
+        self.scale_ups += 1
+        self.tracer.event(
+            "autoscale_scale_up", role=role, replica=new_id,
+            replicas=len(self._tier_replicas(role)), reason=reason,
+            mean_queue_depth=round(depth, 3),
+        )
+
+    def _scale_down(self, role: str, st: _TierState, now: float,
+                    reps: list, *, depth: float) -> None:
+        victim = min(reps, key=lambda r: (r.place_cost(), -r.replica_id))
+        self.router.drain(victim.replica_id, requeue_queued=True)
+        self._retiring.append(victim)
+        st.last_down = now
+        st.clear_evals = 0
+        self.scale_downs += 1
+        self.tracer.event(
+            "autoscale_scale_down", role=role,
+            replica=victim.replica_id,
+            replicas=len(self._tier_replicas(role)),
+            mean_queue_depth=round(depth, 3),
+        )
+
+    def _sweep_retiring(self) -> None:
+        """Retire drained replicas once they hold nothing: the drain
+        already re-placed (or drain-parked) their queue, so pending
+        hitting zero means every stream finished or moved — only THEN
+        does the provisioner release the backing resources."""
+        still = []
+        for rep in self._retiring:
+            if rep.alive and rep.pending > 0:
+                still.append(rep)
+                continue
+            self.provisioner.retire(rep)
+            rep.mark_dead()
+            self.tracer.event("autoscale_retire", role=rep.role,
+                              replica=rep.replica_id)
+        self._retiring = still
+
+    # ------------------------------------------------------------- roll-up
+
+    def summary(self) -> dict:
+        return {
+            "ticks": self.ticks,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "retiring": len(self._retiring),
+            "tiers": {
+                role: {
+                    "replicas": len(self._tier_replicas(role)),
+                    "pressure_evals": st.pressure_evals,
+                    "clear_evals": st.clear_evals,
+                }
+                for role, st in self._tiers.items()
+            },
+        }

@@ -133,6 +133,14 @@ class RequestRouter:
         request routes to the prefill tier; None (default) takes
         ``cfg.disagg_prompt_threshold`` (0 = role-blind routing even
         if roles were assigned).
+      admission: an ``serving.autoscale.AdmissionController`` gating
+        the front door: ``submit()`` runs its queue-deadline/queue-cap
+        check BEFORE placement and raises the named
+        ``AdmissionRejected`` on shed (HTTP 429 + Retry-After on the
+        service front end).  Only ``submit`` is gated — failover
+        re-placement, drain requeue, migration and parked-session
+        resume bypass it, so an admitted request is never shed
+        mid-flight.  None (default) is the byte-stable status quo.
       session_store: a ``serving.sessions.SessionStore`` backing the
         durable-session surface (docs/SERVING.md "Durable sessions"):
         ``park()``/``resume_parked()`` move whole streams between the
@@ -150,7 +158,8 @@ class RequestRouter:
                  tracer=NULL_TRACER, replica_tracers=None,
                  retain_results: bool = True, roles=None,
                  disagg_prompt_threshold: int | None = None,
-                 replicas=None, session_store=None, **engine_kw):
+                 replicas=None, admission=None, session_store=None,
+                 **engine_kw):
         if replicas is not None:
             # pre-built placement units — the cross-host service path
             # (serving/service/remote.RemoteReplica duck-types
@@ -190,6 +199,7 @@ class RequestRouter:
         self.cfg = cfg
         self.tracer = tracer
         self.retain_results = retain_results
+        self.admission = admission
         self.session_store = session_store
         self.disagg_prompt_threshold = (
             cfg.disagg_prompt_threshold if disagg_prompt_threshold is None
@@ -251,7 +261,11 @@ class RequestRouter:
         """Admit a request: place it on the least-loaded accepting
         replica.  Returns the ROUTER-global request id (TokenEvents and
         ``results`` use it).  Raises if the request is invalid (any
-        replica would reject it) or no replica is accepting."""
+        replica would reject it), no replica is accepting, or — with an
+        admission controller installed — the fabric sheds it
+        (``AdmissionRejected``, BEFORE any queue is touched)."""
+        if self.admission is not None:
+            self.admission.check(request, self.replicas)
         # the trace context is minted HERE, at the fabric's front door,
         # and lives on the _Routed entry — NOT written back onto the
         # caller's object — so a failover re-placement (same entry)
@@ -653,6 +667,36 @@ class RequestRouter:
         )
 
     # ------------------------------------------------------------ lifecycle
+
+    def add_replica(self, replica) -> None:
+        """Live-attach one pre-built replica to a RUNNING fabric — the
+        autoscale scale-up path (serving/autoscale/controller.py), and
+        the first way the replica set has ever grown after construction
+        (``drain``/``fail`` only shrink it).  Nothing pauses: in-flight
+        streams keep stepping exactly as before (their routing entries
+        are untouched, so live-attach parity is structural — pinned by
+        tests/test_autoscale.py), and the next ``submit`` simply sees
+        one more placement candidate.
+
+        The replica's id must be ``len(self.replicas)`` — ids stay
+        0..n-1 in order because the router indexes replicas by id
+        (``attach_resumed``, ``drain``, ``fail``); retired replicas
+        keep their slot in the list as DEAD entries, they are never
+        popped.  A prefill-role replica on a disaggregated fabric gets
+        the same ``migrate_hook`` construction installs, so a scaled-up
+        prefill tier hands carries off exactly like a seed one."""
+        if replica.replica_id != len(self.replicas):
+            raise ValueError(
+                f"live-attached replica id must be {len(self.replicas)} "
+                f"(ids are the router's list index, 0..n-1 in order), "
+                f"got {replica.replica_id}"
+            )
+        self.replicas.append(replica)
+        if self.disagg_prompt_threshold > 0 and replica.role == "prefill":
+            replica.engine.migrate_hook = (
+                lambda tracked, package, _src=replica:
+                self._migrate_from(_src, tracked, package)
+            )
 
     def drain(self, replica_id: int, *,
               requeue_queued: bool = False) -> list[int]:

@@ -73,6 +73,14 @@ class GenerationRequest:
     # service wire, failover replay, SSE resume and tier migration
     # (the target engine re-pins the factors from its own cache).
     adapter: str | None = None
+    # admission deadline (serving/autoscale/admission.py): the longest
+    # queue wait this request tolerates, in milliseconds — a fabric
+    # with an AdmissionController sheds the request FAST (the named
+    # AdmissionRejected; HTTP 429 on the service) when the estimated
+    # wait exceeds it.  None defers to the fabric's default deadline
+    # (which may itself be off); the plain engine path never reads it,
+    # so carrying one is byte-stable without admission control.
+    queue_deadline_ms: float | None = None
 
     def resolve_key(self) -> jax.Array:
         key = self.key if self.key is not None else jax.random.PRNGKey(self.seed)

@@ -80,6 +80,7 @@ import time
 import numpy as np
 
 from mamba_distributed_tpu.obs import jsonable, prom
+from mamba_distributed_tpu.serving.autoscale import AdmissionRejected
 from mamba_distributed_tpu.serving.scheduler import GenerationRequest
 from mamba_distributed_tpu.serving.service import wire
 
@@ -96,11 +97,18 @@ class FabricController(threading.Thread):
                  adapters: dict | None = None,
                  session_sweep_s: float = 5.0, emit=None,
                  obs_pull_s: float = 0.0, obs_sink=None,
-                 obs_limit: int = 4096, obs_keep: int = 65536):
+                 obs_limit: int = 4096, obs_keep: int = 65536,
+                 autoscale=None):
         super().__init__(daemon=True, name="fabric-controller")
         self.router = router
         self.health = health
         self.poll_s = poll_s
+        # elastic fabric (serving/autoscale/): an AutoscaleController
+        # evaluated once per loop iteration — on the controller thread,
+        # like everything that touches the router, so scale-ups
+        # live-attach and scale-downs drain with no lock anywhere.
+        # None = fixed fleet, the byte-stable status quo.
+        self.autoscale = autoscale
         # durable sessions: the background TTL sweeper's cadence over
         # the router's session store (when one is attached) and the
         # jsonl emitter its ``sessions_gc`` records land on (the same
@@ -267,6 +275,23 @@ class FabricController(threading.Thread):
             worked = self._drain_commands()
             self._sweep_sessions()
             self._drain_obs()
+            if self.autoscale is not None:
+                # one policy evaluation per fabric iteration: pressure
+                # counters advance here, scale-ups live-attach through
+                # router.add_replica, scale-downs drain + retire —
+                # all on this thread, interleaved with stepping
+                try:
+                    self.autoscale.tick()
+                except Exception as e:  # noqa: BLE001
+                    # a failed provision (spawn error, resource limit)
+                    # must not kill serving: the fixed fleet keeps
+                    # stepping and the next pressured tick retries
+                    if self.emit is not None:
+                        self.emit({
+                            "kind": "serving_health", "t": time.time(),
+                            "event": "autoscale_error",
+                            "error": f"{type(e).__name__}: {e}",
+                        })
             if self.health is not None:
                 try:
                     self.health.tick()
@@ -455,17 +480,38 @@ class FabricController(threading.Thread):
 # ----------------------------------------------------------------- HTTP/SSE
 
 
+def _fabric_queue_depth(router) -> int:
+    """Queued-but-unstarted requests fabric-wide, duck-typed over the
+    two replica kinds (RemoteReplica heartbeat stats vs in-process
+    engine reads) — the /healthz field and the admission cap's gauge."""
+    depth = 0
+    for r in router.replicas:
+        if not r.alive:
+            continue
+        stats = getattr(r, "stats", None)
+        if stats is not None:
+            depth += int(stats.get("depth", 0))
+        else:
+            depth += int(r.engine.scheduler.depth)
+    return depth
+
+
 def _http_response(status: str, body: bytes,
-                   content_type: str = "application/json") -> bytes:
+                   content_type: str = "application/json",
+                   extra_headers: dict | None = None) -> bytes:
+    headers = "".join(f"{k}: {v}\r\n"
+                      for k, v in (extra_headers or {}).items())
     return (
         f"HTTP/1.1 {status}\r\nContent-Type: {content_type}\r\n"
-        f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+        f"Content-Length: {len(body)}\r\n{headers}Connection: close\r\n\r\n"
     ).encode("ascii") + body
 
 
-def _json_response(status: str, obj) -> bytes:
+def _json_response(status: str, obj,
+                   extra_headers: dict | None = None) -> bytes:
     return _http_response(
-        status, (json.dumps(obj) + "\n").encode("utf-8")
+        status, (json.dumps(obj) + "\n").encode("utf-8"),
+        extra_headers=extra_headers,
     )
 
 
@@ -653,6 +699,16 @@ class FabricHTTPServer:
         if self.controller.health is not None:
             for rid, h in self.controller.health.snapshot().items():
                 payload["replicas"][str(rid)].update(h)
+        # the elastic-fabric signals an EXTERNAL orchestrator needs to
+        # make the same decisions the autoscaler does (the ISSUE-18
+        # satellite): accepting-replica count and fabric-wide queued
+        # work.  Always present — additive keys next to the pinned
+        # "ok"/"ready" bools, computed from the same replica reads the
+        # payload already does.
+        payload["accepting"] = sum(
+            1 for r in router.replicas if r.accepting
+        )
+        payload["queue_depth"] = _fabric_queue_depth(router)
         payload["ok"] = any(
             r.accepting for r in router.replicas
         )
@@ -700,6 +756,10 @@ class FabricHTTPServer:
                 })
         reps = router.replicas
         plane_on = bool(ctrl.obs_pull_s)
+        # elastic-fabric families are None-gated exactly like the obs
+        # counters: no admission controller / no autoscaler => the
+        # exposition is byte-identical to the pre-elastic fabric's
+        admission = getattr(router, "admission", None)
         return prom.render_fabric(
             snapshots,
             replicas=len(reps),
@@ -709,6 +769,18 @@ class FabricHTTPServer:
                 ctrl.obs_records_pulled if plane_on else None),
             obs_records_dropped=(
                 ctrl.obs_records_dropped if plane_on else None),
+            queue_depth=(
+                _fabric_queue_depth(router)
+                if admission is not None or ctrl.autoscale is not None
+                else None),
+            sheds=(None if admission is None else {
+                "queue_cap": admission.sheds_cap,
+                "queue_deadline": admission.sheds_deadline,
+            }),
+            autoscale=(None if ctrl.autoscale is None else {
+                "scale_ups": ctrl.autoscale.scale_ups,
+                "scale_downs": ctrl.autoscale.scale_downs,
+            }),
         )
 
     async def _generate(self, body: bytes,
@@ -724,6 +796,9 @@ class FabricHTTPServer:
                 seed=int(spec.get("seed", 0)),
                 priority=spec.get("priority"),
                 adapter=spec.get("adapter"),
+                queue_deadline_ms=(
+                    None if spec.get("queue_deadline_ms") is None
+                    else float(spec["queue_deadline_ms"])),
             )
         except (ValueError, KeyError, TypeError,
                 json.JSONDecodeError) as e:
@@ -753,6 +828,19 @@ class FabricHTTPServer:
             gid, sink = await asyncio.wrap_future(
                 self.controller.submit_request(request)
             )
+        except AdmissionRejected as e:
+            # shed at the front door (queue cap / deadline estimate):
+            # 429 with a whole-second Retry-After hint — reject-fast
+            # beats timeout for goodput, and the client learns when the
+            # queue should have drained enough to try again
+            retry_s = max(1, int(-(-e.retry_after_s // 1)))
+            writer.write(_json_response(
+                "429 Too Many Requests",
+                {"error": str(e), "error_type": "AdmissionRejected",
+                 "reason": e.reason, "retry_after_s": e.retry_after_s},
+                extra_headers={"Retry-After": str(retry_s)},
+            ))
+            return
         except (ValueError, RuntimeError) as e:
             # invalid request, or nothing accepting (all draining/dead)
             if "UnknownAdapterError" in f"{type(e).__name__}: {e}":

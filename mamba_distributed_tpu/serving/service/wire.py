@@ -183,6 +183,10 @@ def encode_request(request) -> dict:
         "priority": request.priority,
         "adapter": getattr(request, "adapter", None),
     }
+    if getattr(request, "queue_deadline_ms", None) is not None:
+        # stamped only when set: deadline-less requests serialize
+        # byte-identically to the pre-admission wire
+        d["queue_deadline_ms"] = float(request.queue_deadline_ms)
     if request.key is not None:
         d["key"] = encode_array(np.asarray(request.resolve_key()))
     return d
@@ -203,6 +207,7 @@ def decode_request(d: dict):
         trace_id=d.get("trace_id"),
         priority=d.get("priority"),
         adapter=d.get("adapter"),
+        queue_deadline_ms=d.get("queue_deadline_ms"),
     )
 
 
@@ -223,6 +228,10 @@ def encode_request_tree(request) -> dict:
         "priority": request.priority,
         "adapter": getattr(request, "adapter", None),
     }
+    if getattr(request, "queue_deadline_ms", None) is not None:
+        # same conditional stamp as encode_request: park frames of
+        # deadline-less requests stay byte-identical
+        d["queue_deadline_ms"] = float(request.queue_deadline_ms)
     if request.key is not None:
         d["key"] = np.asarray(request.resolve_key())
     return d
@@ -246,6 +255,7 @@ def decode_request_tree(d: dict):
         trace_id=d.get("trace_id"),
         priority=d.get("priority"),
         adapter=d.get("adapter"),
+        queue_deadline_ms=d.get("queue_deadline_ms"),
     )
 
 

@@ -267,6 +267,15 @@ class ServingMetrics:
         self.sessions_parked_disk: int | None = None
         self.sessions_bytes_host: int | None = None
         self.sessions_bytes_disk: int | None = None
+        # admission-control load shedding (serving/autoscale/
+        # admission.py): the owner calls configure_admission() when an
+        # AdmissionController is installed, unlocking
+        # summary()["admission"] — total sheds split by reason.  Off by
+        # default so admission-less summaries stay byte-stable.
+        self._admission_on = False
+        self.sheds = 0
+        self.sheds_cap = 0
+        self.sheds_deadline = 0
         # disaggregated prefill/decode handoffs (docs/SERVING.md
         # "Disaggregated tiers"): migrations OUT of this engine (a
         # prefill replica exporting its finished carry) vs IN (a
@@ -456,6 +465,23 @@ class ServingMetrics:
     def record_session_expire(self, n: int = 1) -> None:
         """``n`` parked sessions reaped by the TTL sweeper."""
         self.session_expires += n
+
+    # ------------------------------------------------- admission shedding
+
+    def configure_admission(self) -> None:
+        """Mark admission control live (AdmissionController
+        construction): ``summary()`` gains its ``admission`` section
+        (docs/SERVING.md "Elastic fabric")."""
+        self._admission_on = True
+
+    def record_shed(self, reason: str) -> None:
+        """One request shed at the front door; ``reason`` is the
+        ``AdmissionRejected`` reason ("queue_cap" | "queue_deadline")."""
+        self.sheds += 1
+        if reason == "queue_cap":
+            self.sheds_cap += 1
+        else:
+            self.sheds_deadline += 1
 
     # ------------------------------------------------- per-request latency
 
@@ -860,6 +886,11 @@ class ServingMetrics:
                           / self._adapter_ticks, 2)
                     if self._adapter_ticks else None
                 ),
+            }),
+            "admission": (None if not self._admission_on else {
+                "sheds": self.sheds,
+                "sheds_cap": self.sheds_cap,
+                "sheds_deadline": self.sheds_deadline,
             }),
             "sessions": (None if not self._sessions_on else {
                 "parked_host": self.sessions_parked_host,

@@ -72,6 +72,28 @@ p95 for both fabrics, the TTFT speedup, and the migration count +
 latency — the BENCH_SERVING.json ``disagg_cpu`` row, gated via
 ``scripts/bench_gate.py --case disagg_cpu``.
 
+``--open-loop`` is the overload headline (docs/SERVING.md "Elastic
+fabric"): arrivals come from a wall-clock schedule — Poisson or
+diurnal-ramp (``--arrival``), heavy-tail prompt mix — at
+SERVE_OVERLOAD_FACTOR (2.0) x the fleet's calibrated closed-loop
+capacity, submitted whether or not the fabric has room (the open-loop
+property closed-loop benches hide).  The identical schedule runs twice
+through the same SERVE_OPEN_LOOP_REPLICAS (2) fabric: load shedding
+OFF (every arrival queues; the queue — and every later TTFT — grows
+without bound for the duration) vs ON (queue-deadline + queue-cap
+admission control sheds what cannot meet the SLO).  The record reports
+goodput (tokens of requests whose TTFT met SERVE_SLO_TTFT_MS, default
+auto-calibrated, per second of wall time), shed rate and TTFT p50/p99
+for both passes — the BENCH_SERVING.json ``overload_shed_cpu`` row,
+gated via ``scripts/bench_gate.py --case overload_shed_cpu``.
+``--autoscale`` is the load-step variant: calm arrivals at
+SERVE_CALM_FACTOR (0.4) x ONE replica's capacity then a step to the
+overload factor, served by a
+1-replica fleet under the AutoscaleController (queue-depth trigger,
+in-process EngineProvisioner, SERVE_AUTOSCALE_MAX=3) vs the same
+fleet pinned at 1 replica — the ``autoscale_step_cpu`` row reports the
+goodput ratio and the scale-up timeline.
+
 ``--long-prompt`` switches to the head-of-line-blocking workload: a few
 LONG prompts (SERVE_LONG_COUNT=2 x SERVE_LONG_LEN=8192 tokens) are
 submitted AHEAD of the usual short mix, and the same workload runs
@@ -633,6 +655,172 @@ def _lora_bench(cfg, params, n_adapters, rank, capacity, tokens_per_tick,
     return out, eng.metrics.summary()
 
 
+def _heavy_tail_specs(rng, n, pmin, pmax, max_new, tail_frac, tail_max):
+    """Heavy-tail prompt-length mix as (plen, budget, seed) specs: a
+    uniform short body with a ``tail_frac`` slice of Pareto-stretched
+    longs up to ``tail_max`` — the shape open-loop queues choke on,
+    because one long prefill holds slots while arrivals keep coming.
+    Specs (not request objects) so each pass materializes fresh
+    requests; streams are pure functions of (prompt, seed)."""
+    specs = []
+    for i in range(n):
+        if rng.random() < tail_frac:
+            plen = min(tail_max,
+                       int(pmax * (1.0 + rng.pareto(2.0))))
+        else:
+            plen = int(rng.integers(pmin, pmax + 1))
+        budget = int(rng.integers(max(1, max_new // 4), max_new + 1))
+        specs.append((plen, budget, 3000 + i))
+    return specs
+
+
+def _arrival_schedule(rng, rate_s, duration_s, process):
+    """Arrival offsets (seconds from t0) for an open-loop client.
+    ``poisson``: homogeneous, exponential inter-arrivals at ``rate_s``.
+    ``ramp``: piecewise Poisson over three equal phases at 0.5x / 1.0x
+    / 1.5x the nominal rate — the diurnal shape, same mean load."""
+    mults = [1.0] if process == "poisson" else [0.5, 1.0, 1.5]
+    phase_s = duration_s / len(mults)
+    out, t0 = [], 0.0
+    for m in mults:
+        t = 0.0
+        while True:
+            t += rng.exponential(1.0 / (rate_s * m))
+            if t >= phase_s:
+                break
+            out.append(t0 + t)
+        t0 += phase_s
+    return out
+
+
+def _open_loop_pass(router, specs, arrivals, vocab, slo_ttft_ms,
+                    deadline_ms=None, tick=None):
+    """Drive ONE open-loop pass: submit each request at its wall-clock
+    arrival time (never waiting for capacity — that is the point),
+    step the fabric between arrivals, stamp client-side TTFT per
+    stream, and drain.  Sheds (AdmissionRejected) are counted, not
+    retried.  ``tick`` (if given) runs once per loop iteration — the
+    autoscale controller's hook.  Returns per-pass stats."""
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.serving import (
+        AdmissionRejected,
+        GenerationRequest,
+    )
+
+    # per-pass request objects; prompt content is a pure function of the
+    # per-request seed, so passes see identical workloads
+    def make(i):
+        plen, budget, seed = specs[i]
+        prng = np.random.default_rng(seed)
+        return GenerationRequest(
+            prompt_ids=prng.integers(0, vocab, size=plen).astype(np.int32),
+            max_new_tokens=budget, seed=seed,
+            queue_deadline_ms=deadline_ms,
+        )
+
+    live = {}     # global id -> {"t_sub", "ttft_ms", "tokens"}
+    done = []
+    sheds = {"queue_cap": 0, "queue_deadline": 0}
+    i = 0
+    t0 = _time.perf_counter()
+    while i < len(arrivals) or router.pending:
+        now = _time.perf_counter() - t0
+        while i < len(arrivals) and arrivals[i] <= now:
+            try:
+                gid = router.submit(make(i))
+                live[gid] = {"t_sub": _time.perf_counter(),
+                             "ttft_ms": None, "tokens": 0}
+            except AdmissionRejected as e:
+                sheds[e.reason] += 1
+            i += 1
+        if tick is not None:
+            tick()
+        if router.pending:
+            t_now = _time.perf_counter()
+            for ev in router.step():
+                st = live.get(ev.request_id)
+                if st is None:
+                    continue
+                if st["ttft_ms"] is None:
+                    st["ttft_ms"] = (t_now - st["t_sub"]) * 1000.0
+                st["tokens"] += 1
+                if ev.done:
+                    done.append(live.pop(ev.request_id))
+        elif i < len(arrivals):
+            _time.sleep(min(0.002, max(0.0, arrivals[i] - (
+                _time.perf_counter() - t0))))
+    wall = _time.perf_counter() - t0
+    good = sum(d["tokens"] for d in done
+               if d["ttft_ms"] is not None
+               and d["ttft_ms"] <= slo_ttft_ms)
+    total = sum(d["tokens"] for d in done)
+    ttfts = sorted(d["ttft_ms"] for d in done
+                   if d["ttft_ms"] is not None)
+    n_shed = sum(sheds.values())
+    return {
+        "offered": len(arrivals),
+        "completed": len(done),
+        "shed": n_shed,
+        "shed_rate": round(n_shed / max(1, len(arrivals)), 4),
+        "sheds_by_reason": sheds,
+        "wall_s": round(wall, 3),
+        "tokens": total,
+        "tokens_per_sec": round(total / wall, 1),
+        "goodput_tokens_per_sec": round(good / wall, 1),
+        "slo_attaining": sum(
+            1 for d in done
+            if d["ttft_ms"] is not None and d["ttft_ms"] <= slo_ttft_ms),
+        "ttft_p50_ms": (round(ttfts[len(ttfts) // 2], 1)
+                        if ttfts else None),
+        "ttft_p99_ms": (round(ttfts[min(len(ttfts) - 1,
+                                        int(len(ttfts) * 0.99))], 1)
+                        if ttfts else None),
+    }
+
+
+def _open_loop_calibrate(params, cfg, capacity, tokens_per_tick,
+                         n_replicas, specs, vocab):
+    """Closed-loop calibration: the same heavy-tail mix through the
+    same fleet at full occupancy.  Returns (sustainable request rate
+    /s, per-wave service ms — the admission estimator's prior, the
+    unloaded SLO target: 8x the mean tick)."""
+    import time as _time
+
+    import numpy as np
+
+    from mamba_distributed_tpu.serving import (
+        GenerationRequest,
+        RequestRouter,
+    )
+
+    def fresh():
+        out = []
+        for plen, budget, seed in specs:
+            prng = np.random.default_rng(seed)
+            out.append(GenerationRequest(
+                prompt_ids=prng.integers(0, vocab, size=plen)
+                .astype(np.int32),
+                max_new_tokens=budget, seed=seed,
+            ))
+        return out
+
+    kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+    RequestRouter(params, cfg, num_replicas=n_replicas, **kw).run(fresh())
+    router = RequestRouter(params, cfg, num_replicas=n_replicas, **kw)
+    t0 = _time.perf_counter()
+    router.run(fresh())
+    wall = _time.perf_counter() - t0
+    rate = len(specs) / wall
+    ticks = sum(s["ticks"] for s in router.summary().values())
+    tick_ms = sum(s["mean_tick_ms"] * s["ticks"]
+                  for s in router.summary().values()) / max(1, ticks)
+    service_ms = 1000.0 * capacity * n_replicas * wall / len(specs)
+    return rate, service_ms, 8.0 * tick_ms
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jsonl", default=None, metavar="PATH",
@@ -752,6 +940,38 @@ def main() -> None:
                          "pool sustained) — the BENCH_SERVING.json "
                          "park_resume row, gated via bench_gate.py "
                          "--case park_resume_cpu")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="open-loop overload headline (docs/SERVING.md "
+                         "'Elastic fabric'): a wall-clock arrival "
+                         "schedule at SERVE_OVERLOAD_FACTOR (2.0) x the "
+                         "fleet's calibrated closed-loop capacity — "
+                         "Poisson or diurnal-ramp (--arrival) arrivals, "
+                         "heavy-tail prompt mix — driven twice through "
+                         "the same SERVE_OPEN_LOOP_REPLICAS (2) fabric: "
+                         "load shedding OFF vs ON (queue-deadline + "
+                         "queue-cap admission control).  Reports goodput "
+                         "(SLO-attaining tokens/s; SERVE_SLO_TTFT_MS, "
+                         "default auto-calibrated), shed rate and TTFT "
+                         "p99 for both — the BENCH_SERVING.json "
+                         "overload_shed row, gated via bench_gate.py "
+                         "--case overload_shed_cpu")
+    ap.add_argument("--arrival", default=None,
+                    choices=["poisson", "ramp"],
+                    help="arrival process for --open-loop: 'poisson' "
+                         "(homogeneous) or 'ramp' (diurnal piecewise "
+                         "0.5x/1.0x/1.5x phases, same mean load); "
+                         "default SERVE_ARRIVAL or poisson")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="the --open-loop load-step variant: a calm "
+                         "phase at SERVE_CALM_FACTOR (0.4) x one "
+                         "replica's capacity, then a "
+                         "step to SERVE_OVERLOAD_FACTOR x, served by a "
+                         "1-replica fleet under the AutoscaleController "
+                         "(queue-depth trigger, SERVE_AUTOSCALE_MAX=3) "
+                         "vs the same fleet pinned at 1 replica; "
+                         "reports the goodput ratio and scale-up "
+                         "timeline — the BENCH_SERVING.json "
+                         "autoscale_step row")
     ap.add_argument("--spec-drafter", default="ngram",
                     choices=["ngram", "model"],
                     help="drafter for --spec-tokens: 'ngram' (prompt-"
@@ -769,10 +989,17 @@ def main() -> None:
                              ("--lora-adapters", bool(args.lora_adapters)),
                              ("--service", args.service),
                              ("--park", args.park),
+                             ("--open-loop", args.open_loop),
                              ("--replicas", bool(args.replicas))] if on]
     if len(modes) > 1:
         ap.error(f"{' and '.join(modes)} are separate bench modes; "
                  f"pick one")
+    if args.autoscale and not args.open_loop:
+        ap.error("--autoscale is the --open-loop load-step variant; "
+                 "pass --open-loop too")
+    if args.arrival and not args.open_loop:
+        ap.error("--arrival picks the --open-loop arrival process; "
+                 "pass --open-loop too")
     if args.occupancy and modes:
         ap.error("--occupancy sweeps the default engine-vs-sequential "
                  "mode; it does not combine with "
@@ -1488,6 +1715,206 @@ def main() -> None:
         }
         if args.jsonl:
             record["jsonl"] = args.jsonl
+        emit_bench_record(record, args.json)
+        return
+
+    if args.open_loop:
+        from mamba_distributed_tpu.serving import (
+            AdmissionController,
+            AutoscaleController,
+            AutoscalePolicy,
+            EngineProvisioner,
+            RequestRouter,
+        )
+
+        duration = float(os.environ.get("SERVE_OPEN_LOOP_S", "5"))
+        factor = float(os.environ.get("SERVE_OVERLOAD_FACTOR", "2.0"))
+        n_fleet = int(os.environ.get("SERVE_OPEN_LOOP_REPLICAS", "2"))
+        tail_frac = float(os.environ.get("SERVE_TAIL_FRAC", "0.15"))
+        tail_max = int(os.environ.get("SERVE_TAIL_MAX", str(4 * pmax)))
+        process = (args.arrival
+                   or os.environ.get("SERVE_ARRIVAL", "poisson"))
+        slo_env = float(os.environ.get("SERVE_SLO_TTFT_MS", "0"))
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+
+        # calibration: the SAME heavy-tail mix closed-loop through the
+        # SAME fleet (the autoscale variant calibrates the 1-replica
+        # floor its load step is sized against).  Also warms every jit
+        # signature the open-loop passes — and any scaled-up replica,
+        # which shares the module-level jit cache — will hit.
+        cal_n = 1 if args.autoscale else n_fleet
+        cal_specs = _heavy_tail_specs(
+            np.random.default_rng(seed), 2 * cal_n * capacity,
+            pmin, pmax, max_new, tail_frac, tail_max)
+        rate_cap, service_ms, slo_auto = _open_loop_calibrate(
+            params, cfg, capacity, tokens_per_tick, cal_n, cal_specs,
+            cfg.vocab_size)
+        slo_ttft = slo_env or round(slo_auto, 1)
+        _progress(f"calibrated: {cal_n} replica(s) sustain "
+                  f"{rate_cap:.2f} req/s closed-loop; SLO TTFT "
+                  f"{slo_ttft} ms; wave service {service_ms:.0f} ms")
+
+        if args.autoscale:
+            # load step: calm at 0.4x one replica's capacity (low
+            # enough that Poisson bursts alone don't cross the depth
+            # trigger), then a step to the overload factor — the
+            # recovery story
+            rate_calm = float(os.environ.get(
+                "SERVE_CALM_FACTOR", "0.4")) * rate_cap
+            rate_burst = factor * rate_cap
+            sched_rng = np.random.default_rng(seed + 1)
+            arrivals = _arrival_schedule(
+                sched_rng, rate_calm, duration / 2, "poisson")
+            arrivals += [duration / 2 + t for t in _arrival_schedule(
+                sched_rng, rate_burst, duration / 2, "poisson")]
+            specs = _heavy_tail_specs(
+                np.random.default_rng(seed + 2), len(arrivals),
+                pmin, pmax, max_new, tail_frac, tail_max)
+            _progress(f"load step: {len(arrivals)} arrivals — "
+                      f"{rate_calm:.2f} req/s then {rate_burst:.2f} "
+                      f"req/s at t={duration / 2:.1f}s")
+
+            policy = AutoscalePolicy(
+                min_replicas=1,
+                max_replicas=int(os.environ.get(
+                    "SERVE_AUTOSCALE_MAX", "3")),
+                scale_up_cooldown_s=0.5,
+                scale_down_cooldown_s=3600.0,  # no scale-down mid-bench
+                breach_evals_up=3,
+                clear_evals_down=10_000,
+                queue_depth_high=2.0,
+                queue_depth_low=0.0,
+            )
+
+            # fixed fleet: 1 replica rides out the step alone
+            router = RequestRouter(params, cfg, num_replicas=1, **kw)
+            res_fixed = _open_loop_pass(
+                router, specs, arrivals, cfg.vocab_size, slo_ttft)
+            _progress(f"fixed fleet: goodput "
+                      f"{res_fixed['goodput_tokens_per_sec']} tok/s, "
+                      f"ttft p99 {res_fixed['ttft_p99_ms']} ms")
+
+            # elastic fleet: same schedule, controller on the loop
+            router = RequestRouter(params, cfg, num_replicas=1, **kw)
+            prov = EngineProvisioner(params, cfg, capacity=capacity,
+                                     tokens_per_tick=tokens_per_tick)
+            ctl = AutoscaleController(router, prov, policy)
+            scale_up_at = []
+            t_pass0 = time.perf_counter()
+
+            def _tick():
+                before = len(router.replicas)
+                ctl.tick()
+                if len(router.replicas) > before:
+                    scale_up_at.append(
+                        round(time.perf_counter() - t_pass0, 2))
+
+            res_auto = _open_loop_pass(
+                router, specs, arrivals, cfg.vocab_size, slo_ttft,
+                tick=_tick)
+            _progress(f"elastic fleet: goodput "
+                      f"{res_auto['goodput_tokens_per_sec']} tok/s, "
+                      f"scale-ups at {scale_up_at}s, final "
+                      f"{len([r for r in router.replicas if r.accepting])}"
+                      f" replicas")
+
+            base = max(res_fixed["goodput_tokens_per_sec"], 0.1)
+            record = {
+                "metric": "serving_autoscale_step_goodput_"
+                          f"{preset.replace('-', '_')}",
+                "value": round(
+                    res_auto["goodput_tokens_per_sec"] / base, 2),
+                "unit": "x goodput (SLO-attaining tokens/s), elastic "
+                        "vs fixed 1-replica fleet on the identical "
+                        "load-step schedule",
+                "slo_ttft_ms": slo_ttft,
+                "rate_calm_per_s": round(rate_calm, 2),
+                "rate_burst_per_s": round(rate_burst, 2),
+                "step_at_s": round(duration / 2, 2),
+                "duration_s": duration,
+                "scale_up_at_s": scale_up_at,
+                "replicas_final": len(
+                    [r for r in router.replicas if r.accepting]),
+                "autoscale_summary": ctl.summary(),
+                "fixed": res_fixed,
+                "elastic": res_auto,
+                "policy": {
+                    "max_replicas": policy.max_replicas,
+                    "breach_evals_up": policy.breach_evals_up,
+                    "queue_depth_high": policy.queue_depth_high,
+                    "scale_up_cooldown_s": policy.scale_up_cooldown_s,
+                },
+                "capacity_per_replica": capacity,
+                "tokens_per_tick": tokens_per_tick,
+                "device": dev.device_kind,
+            }
+            emit_bench_record(record, args.json)
+            return
+
+        # overload comparison: the same schedule at factor x the
+        # calibrated capacity, shedding OFF vs ON
+        rate = factor * rate_cap
+        arrivals = _arrival_schedule(
+            np.random.default_rng(seed + 1), rate, duration, process)
+        specs = _heavy_tail_specs(
+            np.random.default_rng(seed + 2), len(arrivals),
+            pmin, pmax, max_new, tail_frac, tail_max)
+        _progress(f"open loop: {len(arrivals)} arrivals over "
+                  f"{duration}s at {rate:.2f} req/s ({process})")
+
+        router = RequestRouter(params, cfg, num_replicas=n_fleet, **kw)
+        res_off = _open_loop_pass(
+            router, specs, arrivals, cfg.vocab_size, slo_ttft)
+        _progress(f"shed OFF: goodput "
+                  f"{res_off['goodput_tokens_per_sec']} tok/s "
+                  f"({res_off['slo_attaining']}/{res_off['offered']} "
+                  f"in SLO), ttft p99 {res_off['ttft_p99_ms']} ms, "
+                  f"drained in {res_off['wall_s']}s")
+
+        queue_cap = int(os.environ.get(
+            "SERVE_QUEUE_CAP", str(2 * n_fleet * capacity)))
+        adm = AdmissionController(queue_cap=queue_cap,
+                                  default_deadline_ms=slo_ttft,
+                                  service_ms=service_ms)
+        router = RequestRouter(params, cfg, num_replicas=n_fleet,
+                               admission=adm, **kw)
+        res_on = _open_loop_pass(
+            router, specs, arrivals, cfg.vocab_size, slo_ttft,
+            deadline_ms=slo_ttft)
+        _progress(f"shed ON: goodput "
+                  f"{res_on['goodput_tokens_per_sec']} tok/s "
+                  f"({res_on['slo_attaining']}/{res_on['offered']} in "
+                  f"SLO, {res_on['shed']} shed), ttft p99 "
+                  f"{res_on['ttft_p99_ms']} ms")
+
+        base = max(res_off["goodput_tokens_per_sec"], 0.1)
+        record = {
+            "metric": "serving_overload_goodput_ratio_"
+                      f"{preset.replace('-', '_')}",
+            "value": round(
+                res_on["goodput_tokens_per_sec"] / base, 2),
+            "unit": "x goodput (SLO-attaining tokens/s) at "
+                    f"{factor}x capacity, shedding on vs off on the "
+                    "identical arrival schedule",
+            "arrival_process": process,
+            "slo_ttft_ms": slo_ttft,
+            "offered_rate_per_s": round(rate, 2),
+            "calibrated_rate_per_s": round(rate_cap, 2),
+            "overload_factor": factor,
+            "duration_s": duration,
+            "queue_cap": queue_cap,
+            "queue_deadline_ms": slo_ttft,
+            "shed_off": res_off,
+            "shed_on": res_on,
+            "admission": adm.summary(),
+            "replicas": n_fleet,
+            "capacity_per_replica": capacity,
+            "tokens_per_tick": tokens_per_tick,
+            "prompt_len_range": [pmin, pmax],
+            "tail_frac": tail_frac,
+            "tail_max": tail_max,
+            "device": dev.device_kind,
+        }
         emit_bench_record(record, args.json)
         return
 

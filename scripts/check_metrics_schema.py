@@ -70,6 +70,9 @@ def emitted_families() -> set[str]:
     text = prom.render_fabric(
         [snapshot], replicas=1, accepting=1, ready=True,
         obs_records_pulled=10, obs_records_dropped=1,
+        queue_depth=3,
+        sheds={"queue_cap": 2, "queue_deadline": 5},
+        autoscale={"scale_ups": 1, "scale_downs": 1},
     )
     return set(prom.parse_exposition(text))
 

@@ -161,6 +161,26 @@ def main() -> int:
                     help="span-ring length passed to SPAWNED workers "
                          "when --obs-stream is set (externally-started "
                          "workers set their own --obs-ring)")
+    ap.add_argument("--queue-cap", type=int, default=None, metavar="N",
+                    help="admission control: shed new requests (HTTP "
+                         "429 + Retry-After) once the fabric holds N "
+                         "queued-but-unstarted requests (default: "
+                         "cfg.admission_queue_cap; 0 = no cap)")
+    ap.add_argument("--queue-deadline-ms", type=float, default=None,
+                    metavar="MS",
+                    help="admission control: default per-request queue "
+                         "deadline — requests whose estimated wait "
+                         "exceeds it are shed (default: "
+                         "cfg.admission_deadline_ms; 0 = none; "
+                         "requests may carry their own "
+                         "queue_deadline_ms)")
+    ap.add_argument("--autoscale-max", type=int, default=None,
+                    metavar="N",
+                    help="elastic fabric: let the AutoscaleController "
+                         "grow each tier up to N workers (spawn mode "
+                         "only — new replicas are spawned like the "
+                         "seed ones; default: "
+                         "cfg.autoscale_max_replicas; 0 = fixed fleet)")
     ap.add_argument("--state-dir", default=None, metavar="DIR",
                     help="durable session store for the fabric "
                          "(docs/SERVING.md 'Durable sessions'): "
@@ -240,9 +260,61 @@ def main() -> int:
             host_bytes=int(cfg.session_host_bytes),
             disk=DiskSessionStore(args.state_dir),
         )
+    # admission control (serving/autoscale/admission.py): CLI flags
+    # override the config knobs; both 0/unset = off, the byte-stable
+    # status quo (no controller constructed at all)
+    queue_cap = (args.queue_cap if args.queue_cap is not None
+                 else cfg.admission_queue_cap)
+    deadline_ms = (args.queue_deadline_ms
+                   if args.queue_deadline_ms is not None
+                   else cfg.admission_deadline_ms)
+    admission = None
+    if queue_cap or deadline_ms:
+        from mamba_distributed_tpu.serving.autoscale import (
+            AdmissionController,
+        )
+
+        admission = AdmissionController(queue_cap=queue_cap,
+                                        default_deadline_ms=deadline_ms)
     router = RequestRouter(None, cfg, replicas=replicas, tracer=tracer,
-                           retain_results=False,
+                           retain_results=False, admission=admission,
                            session_store=session_store)
+    # elastic fleet (serving/autoscale/controller.py): scale-ups spawn
+    # workers exactly like the seed ones (same config/capacity/flags)
+    # through a ProcessProvisioner; scale-downs drain + shut down.
+    # Spawn mode only — externally-started workers are the operator's.
+    autoscale_max = (args.autoscale_max if args.autoscale_max is not None
+                     else cfg.autoscale_max_replicas)
+    autoscale = None
+    if autoscale_max:
+        if not args.spawn:
+            ap.error("--autoscale-max needs --spawn (the provisioner "
+                     "spawns new workers like the seed ones; connected "
+                     "workers are externally managed)")
+        import dataclasses as _dc
+
+        from mamba_distributed_tpu.serving.autoscale import (
+            AutoscaleController,
+            ProcessProvisioner,
+        )
+
+        def _spawn_replica(replica_id: int, role: str):
+            proc, port = spawn_worker(
+                args.config, replica_id, role, capacity=args.capacity,
+                tokens_per_tick=args.tokens_per_tick,
+                param_seed=args.param_seed, adapters=args.adapter,
+                obs_ring=(args.obs_ring if args.obs_stream else 0),
+            )
+            procs.append(proc)  # the rolling shutdown reaps these too
+            return proc, RemoteReplica(replica_id, ("127.0.0.1", port),
+                                       role=role)
+
+        policy = _dc.replace(cfg.autoscale_policy(),
+                             max_replicas=autoscale_max)
+        autoscale = AutoscaleController(
+            router, ProcessProvisioner(_spawn_replica), policy,
+            tracer=tracer,
+        )
     health = HeartbeatMonitor(router, interval_ms=args.heartbeat_ms,
                               miss_threshold=args.miss_threshold, emit=emit)
     obs_sink = None
@@ -252,7 +324,7 @@ def main() -> int:
     controller = FabricController(
         router, health=health, adapters=adapter_store, emit=emit,
         obs_pull_s=(args.obs_pull_s if args.obs_stream else 0.0),
-        obs_sink=obs_sink,
+        obs_sink=obs_sink, autoscale=autoscale,
     )
     controller.start()
     http = FabricHTTPServer(controller, args.http_host, args.http_port)
@@ -266,8 +338,12 @@ def main() -> int:
     stop.wait()
 
     # rolling shutdown: drain everyone (queued work requeues while any
-    # survivor accepts), wait for in-flight streams, then retire
-    for rep in replicas:
+    # survivor accepts), wait for in-flight streams, then retire.
+    # router.replicas, not the seed list: autoscaled-up workers drain
+    # and retire exactly like the ones this process started with
+    for rep in list(router.replicas):
+        if not rep.alive:
+            continue
         try:
             controller.call(
                 lambda rid=rep.replica_id:
@@ -281,8 +357,9 @@ def main() -> int:
     if procs:
         # spawn mode owns its workers; externally-started workers are
         # the operator's to retire (they are drained, not shut down)
-        for rep in replicas:
-            rep.shutdown()
+        for rep in router.replicas:
+            if rep.alive:
+                rep.shutdown()
     for proc in procs:
         try:
             proc.wait(timeout=30)

@@ -632,6 +632,107 @@ def test_bench_serving_park_smoke(tmp_path):
     assert "park_resume_cpu" in g.stdout
 
 
+@pytest.mark.serving
+@pytest.mark.autoscale
+def test_bench_serving_open_loop_smoke(tmp_path):
+    """CI smoke for the open-loop overload bench (ISSUE 18): the
+    ``--open-loop`` mode must calibrate closed-loop, replay the same
+    Poisson arrival schedule shed-off then shed-on, actually shed under
+    2x overload, and gate against the committed overload_shed_cpu row."""
+    import json
+
+    json_out = str(tmp_path / "ov.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_OPEN_LOOP_S="2",
+               SERVE_OPEN_LOOP_REPLICAS="1", SERVE_CAPACITY="4",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="8",
+               SERVE_MAX_NEW="8", SERVE_TOKENS_PER_TICK="4")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--open-loop", "--json", json_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("serving_overload_goodput_ratio")
+    assert rec["arrival_process"] == "poisson"
+    assert rec["offered_rate_per_s"] > rec["calibrated_rate_per_s"]
+    # both passes saw the IDENTICAL schedule; only admission differs
+    off, on = rec["shed_off"], rec["shed_on"]
+    assert off["offered"] == on["offered"]
+    assert off["shed"] == 0 and off["completed"] == off["offered"]
+    assert on["shed"] > 0
+    assert on["completed"] + on["shed"] == on["offered"]
+    assert sum(on["sheds_by_reason"].values()) == on["shed"]
+    assert rec["admission"]["sheds"] == on["shed"]
+    assert rec["admission"]["admitted"] == on["completed"]
+    # --autoscale / --arrival outside --open-loop are usage errors
+    p2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--autoscale"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert p2.returncode == 2
+    assert "--open-loop" in p2.stderr
+    # the registered gate path (huge band: the smoke's tiny workload is
+    # a different operating point than the committed default run)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "overload_shed_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "overload_shed_cpu" in g.stdout
+
+
+@pytest.mark.serving
+@pytest.mark.autoscale
+def test_bench_serving_autoscale_smoke(tmp_path):
+    """CI smoke for the autoscale recovery bench (ISSUE 18): the
+    ``--open-loop --autoscale`` mode must drive a load step through a
+    fixed and an elastic fleet, actually scale up AFTER the step, lose
+    no stream on either pass, and gate against the committed
+    autoscale_step_cpu row."""
+    import json
+
+    json_out = str(tmp_path / "as.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_OPEN_LOOP_S="2",
+               SERVE_AUTOSCALE_MAX="2", SERVE_CAPACITY="4",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="8",
+               SERVE_MAX_NEW="8", SERVE_TOKENS_PER_TICK="4")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--open-loop", "--autoscale", "--json", json_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("serving_autoscale_step_goodput")
+    summary = rec["autoscale_summary"]
+    assert summary["scale_ups"] >= 1
+    assert rec["replicas_final"] >= 2
+    # every scale-up is stamped inside the pass (burst attribution is a
+    # noise-sensitive claim — the committed default-scale row pins it)
+    assert len(rec["scale_up_at_s"]) == summary["scale_ups"]
+    assert all(0.0 <= t <= rec["elastic"]["wall_s"] + 1.0
+               for t in rec["scale_up_at_s"])
+    # elastic admission stays open: every offered stream completes on
+    # BOTH passes (the autoscale variant sheds nothing)
+    for side in (rec["fixed"], rec["elastic"]):
+        assert side["shed"] == 0
+        assert side["completed"] == side["offered"]
+    assert rec["fixed"]["tokens"] == rec["elastic"]["tokens"]
+    # the registered gate path (huge band, as above)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "autoscale_step_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "autoscale_step_cpu" in g.stdout
+
+
 @pytest.mark.obs
 @pytest.mark.metrics
 @pytest.mark.fast
