@@ -348,6 +348,23 @@ class ModelConfig:
     # (hybrid) head counts must divide evenly — checked with a clear
     # error at engine construction.
     serving_model_shards: int = 1
+    # Pipeline-parallel shards of the serving LAYER STACK over
+    # `mesh.stage` (the 3-D serving mesh's middle axis,
+    # parallel/mesh.serving_mesh): the scan-over-layers parameter
+    # stacks AND the slot pool's per-layer conv/SSM carries + KV page
+    # pools shard their leading layer axis across stages
+    # (parallel/sharding.serving_param_specs / slot_pool_specs), so
+    # each stage holds only its own layers' weights and state — the
+    # second way (after serving_model_shards) one engine serves a
+    # model bigger than a single device, composable with both other
+    # axes.  Pure-SSM single-data-shard engines additionally run the
+    # decode tick as a GPipe-microbatched schedule over the lane
+    # bucket (parallel/pipeline.pipelined_decode_layers).  1 => the
+    # exact 2-D status quo: serving_mesh stays ("data", "model") and
+    # no spec ever names a stage axis (same shardings, same traces).
+    # n_layer (and each hybrid stack family) must divide evenly —
+    # checked with a clear error at engine construction.
+    serving_stage_shards: int = 1
     # Durable session store (docs/SERVING.md "Durable sessions"):
     # parked sessions' time-to-live in seconds — the background sweeper
     # reaps older ones (0 = park forever; explicit parks may override
@@ -453,6 +470,11 @@ class ModelConfig:
             raise ValueError(
                 f"serving_model_shards must be >= 1, got "
                 f"{self.serving_model_shards}"
+            )
+        if self.serving_stage_shards < 1:
+            raise ValueError(
+                f"serving_stage_shards must be >= 1, got "
+                f"{self.serving_stage_shards}"
             )
         if self.compaction_hysteresis_ticks < 0:
             raise ValueError(

@@ -321,10 +321,13 @@ def generate(
     b, t = prompt_ids.shape
     hybrid = bool(cfg.attn_layer_idx)
     chunk = cfg.effective_prefill_chunk_tokens
-    if mesh is not None and dict(mesh.shape).get("model", 1) <= 1:
+    if (mesh is not None and dict(mesh.shape).get("model", 1) <= 1
+            and dict(mesh.shape).get("stage", 1) <= 1):
         # a data-only serving mesh shards slots, not weights — nothing
         # for generate() to constrain; dropping it keeps the TP-off jit
-        # signatures (and pinned trace counts) identical to pre-TP
+        # signatures (and pinned trace counts) identical to pre-TP.
+        # A model OR stage axis > 1 partitions the weights (TP columns
+        # / pipeline layer groups), so those meshes must be kept.
         mesh = None
     if cfg.spec_tokens > 0 and top_k == 1 and b == 1 and length_bucketing:
         # deferred import: serving imports this module at package-load

@@ -1059,7 +1059,7 @@ def _block_step(bp, cfg: ModelConfig, hidden, residual, st, attn: bool,
 
 
 def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array,
-            write_mask: jax.Array | None = None):
+            write_mask: jax.Array | None = None, pipeline=None):
     """One decode step.  token (b,) int32 -> (logits (b, V), new state).
 
     ``write_mask`` (b,) bool (hybrid stacks only) marks rows whose paged
@@ -1068,6 +1068,17 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array,
     keeps dead/empty/prefilling slots from touching live pages while
     still computing the whole batch in one trace.  ``None`` (generate's
     decode loop) writes every row.
+
+    ``pipeline`` (pure-SSM stacks only) is ``(mesh, n_micro)``: the
+    layer scan runs as a GPipe-microbatched schedule over the 3-D
+    serving mesh's ``stage`` axis instead of a local ``lax.scan`` —
+    ``n_micro`` contiguous lane blocks of the batch flow through the
+    stage-resident layer groups with ppermute handoffs
+    (parallel/pipeline.pipelined_decode_layers; the serving tick's
+    microbatched launch).  Bitwise identical to ``pipeline=None``:
+    each lane's per-layer op sequence is unchanged, only the
+    (layer-group, lane-block) execution order moves.  ``None`` (every
+    non-pipelined caller) is the exact status quo.
     """
     compute_dtype = jnp.dtype(cfg.compute_dtype)
     hidden = _embed(params, token, compute_dtype)
@@ -1154,9 +1165,26 @@ def lm_step(params: dict, cfg: ModelConfig, state, token: jax.Array,
         }
     else:
         residual = jnp.zeros_like(hidden, dtype=jnp.float32)
-        (hidden, residual), new_blocks = jax.lax.scan(
-            mbody, (hidden, residual), (params["blocks"], state["blocks"])
-        )
+        if pipeline is not None:
+            from mamba_distributed_tpu.parallel.pipeline import (
+                pipelined_decode_layers,
+            )
+
+            mesh, n_micro = pipeline
+
+            def pbody(act, bp, st):
+                h, rs = act
+                h, rs, st = _block_step(bp, cfg, h, rs, st, False)
+                return (h, rs), st
+
+            (hidden, residual), new_blocks = pipelined_decode_layers(
+                pbody, params["blocks"], state["blocks"],
+                (hidden, residual), mesh, n_micro=n_micro,
+            )
+        else:
+            (hidden, residual), new_blocks = jax.lax.scan(
+                mbody, (hidden, residual), (params["blocks"], state["blocks"])
+            )
         new_state = {"blocks": new_blocks}
 
     normed, _ = add_rms_norm(hidden, residual, params["norm_f"]["weight"], cfg.norm_eps)

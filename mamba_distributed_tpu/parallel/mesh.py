@@ -42,21 +42,32 @@ def single_device_mesh() -> Mesh:
     return build_mesh(MeshConfig(), devices=jax.devices()[:1])
 
 
-def serving_mesh(num_shards: int, devices=None, model_shards: int = 1) -> Mesh:
-    """2-D ``("data", "model")`` mesh for the serving fabric.
+def serving_mesh(num_shards: int, devices=None, model_shards: int = 1,
+                 stage_shards: int = 1) -> Mesh:
+    """Serving-fabric mesh: ``("data", "model")``, growing a middle
+    ``stage`` axis — ``("data", "stage", "model")`` — when
+    ``stage_shards > 1``.
 
-    The slot pool's batch axis (and the paged-KV page axis) partition
-    over ``data`` (parallel/sharding.slot_pool_shardings); the WEIGHTS
-    partition over ``model`` (parallel/sharding.serving_param_shardings
-    — Mamba d_inner channels, attention heads, the vocab axis of the
-    embedding/head).  Decode is weight-bandwidth-bound, so the model
-    axis splits the binding resource — per-device weight traffic —
-    and is also what lets one engine serve a model bigger than a
-    single device.  ``model_shards=1`` (the default) keeps the exact
-    pre-TP behavior: every param spec is ``P()`` and the data axis is
-    all that partitions anything, so shardings and trace counts match
-    the one-axis mesh byte for byte.  On a CPU host, force a
-    multi-device platform first
+    The three axes shard different things.  The slot pool's batch axis
+    (and the paged-KV page axis) partition over ``data``
+    (parallel/sharding.slot_pool_shardings); the WEIGHTS partition over
+    ``model`` (parallel/sharding.serving_param_shardings — Mamba
+    d_inner channels, attention heads, the vocab axis of the
+    embedding/head); the scan-over-layers parameter stacks AND the
+    per-layer slot-state stacks partition their leading LAYER axis over
+    ``stage`` (GPipe-style pipeline residency: each stage holds only
+    its own layers' weights, conv/SSM carries and KV page pools).
+    Decode is weight-bandwidth-bound, so the model axis splits the
+    binding resource — per-device weight traffic — while the stage
+    axis splits total resident bytes a second way, so the two compose
+    into serving models bigger than one TP group.  ``model_shards=1``
+    keeps the exact pre-TP behavior: every param spec is ``P()`` and
+    the data axis is all that partitions anything, so shardings and
+    trace counts match the one-axis mesh byte for byte.
+    ``stage_shards=1`` (the default) returns the 2-D mesh UNCHANGED —
+    no size-1 stage axis is ever materialized, so ``mesh.shape`` pins,
+    jit signatures and trace counts from the 2-D fabric hold byte for
+    byte.  On a CPU host, force a multi-device platform first
     (``XLA_FLAGS=--xla_force_host_platform_device_count=N``, as the
     test harness does) to exercise the same GSPMD path as a pod slice.
     """
@@ -64,15 +75,26 @@ def serving_mesh(num_shards: int, devices=None, model_shards: int = 1) -> Mesh:
         raise ValueError(f"num_shards must be >= 1, got {num_shards}")
     if model_shards < 1:
         raise ValueError(f"model_shards must be >= 1, got {model_shards}")
+    if stage_shards < 1:
+        raise ValueError(f"stage_shards must be >= 1, got {stage_shards}")
     if devices is None:
         devices = jax.devices()
-    want = num_shards * model_shards
+    want = num_shards * stage_shards * model_shards
     if want > len(devices):
         raise ValueError(
-            f"serving mesh wants {num_shards} x {model_shards} = {want} "
-            f"devices, have {len(devices)}"
+            f"serving mesh wants {num_shards} x {stage_shards} x "
+            f"{model_shards} = {want} devices, have {len(devices)}"
         )
     # model innermost: a slot's weight-shard all-reduces ride the
-    # fastest (most adjacent) links, like `tensor` in the training mesh
-    dev_array = np.asarray(devices[:want]).reshape(num_shards, model_shards)
-    return Mesh(dev_array, ("data", "model"))
+    # fastest (most adjacent) links, like `tensor` in the training
+    # mesh; stage sits between — its ppermute neighbour hops are
+    # next-most-frequent (once per layer-group per tick)
+    if stage_shards == 1:
+        dev_array = np.asarray(devices[:want]).reshape(
+            num_shards, model_shards
+        )
+        return Mesh(dev_array, ("data", "model"))
+    dev_array = np.asarray(devices[:want]).reshape(
+        num_shards, stage_shards, model_shards
+    )
+    return Mesh(dev_array, ("data", "stage", "model"))

@@ -146,3 +146,166 @@ def pipelined_layers(
         check_vma=False,
     )
     return fn(stacked_params, xs)
+
+
+def pipelined_decode_layers(
+    body_fn: Callable,
+    stacked_params,
+    stacked_state,
+    act,
+    mesh: Mesh,
+    axis: str = "stage",
+    n_micro: int | None = None,
+):
+    """One STATEFUL decode sub-step over the layer stack, GPipe-
+    pipelined over ``axis`` with per-stage state residency — the
+    serving tick's microbatched launch (docs/SERVING.md "3-D serving
+    mesh").
+
+    Where :func:`pipelined_layers` pipelines a stateless layer body
+    over a microbatch axis the caller supplies, this variant owns the
+    serving decode shape: the batch is a LANE axis (slots of the
+    serving pool — independent streams, so lanes are the legal
+    microbatch unit; consecutive tokens of one lane are sequentially
+    dependent and can never pipeline), and every layer carries per-lane
+    recurrent state that must stay resident on the stage that owns the
+    layer.  ``stacked_state`` leaves are (L, S, ...) — layer-stacked,
+    lane-indexed on axis 1 — sharded over ``axis`` on the layer axis
+    exactly like ``stacked_params`` (parallel/sharding.slot_pool_specs
+    at ``stage_shards > 1``), so state never crosses stages: at tick
+    ``t`` stage ``s`` dynamic-slices the lane block of microbatch
+    ``m = t - s`` out of its OWN state rows, runs its local layers, and
+    writes the advanced rows back in place (bubble ticks — ``m``
+    outside [0, n_micro) — write the old rows back unchanged, the
+    tree-where masking of ``pipelined_layers`` applied to state).
+
+    Args:
+      body_fn: ``(act, layer_params, layer_state) -> (act, new_state)``
+        — one decode-step layer on one lane block.  ``act`` may be any
+        pytree (e.g. the block pipeline's (hidden, residual) pair);
+        leaves carry a leading lane axis.
+      stacked_params: pytree, leaves (L, ...); L % n_stages == 0.
+      stacked_state: pytree, leaves (L, S, ...) — same L, lane axis 1.
+      act: activation pytree, leaves (S, ...) — ALL lanes (the caller's
+        post-embedding activations); split into ``n_micro`` contiguous
+        lane blocks of width S / n_micro here.
+      mesh: mesh containing ``axis``.
+      n_micro: microbatch count (default ``n_stages``); S % n_micro
+        must be 0.  The schedule runs ``n_micro + n_stages - 1`` clock
+        ticks — bubble fraction ``(n_stages - 1) / n_ticks`` exactly as
+        in the GPipe paper, so more microbatches amortize the fill/
+        drain cost while n_micro = 1 degenerates to sequential stages.
+
+    Returns ``(act_out, new_stacked_state)`` — bitwise identical to an
+    unpipelined ``lax.scan`` of ``body_fn`` over all layers (each
+    lane's op sequence is unchanged; the schedule only reorders WHICH
+    (layer, lane-block) cell runs when, and float ops are oblivious to
+    that) — pinned by tests/test_pipeline_serving.py with the real
+    Mamba decode-step body.
+    """
+    n_stages = mesh.shape[axis]
+    n_layer = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layer % n_stages != 0:
+        raise ValueError(
+            f"pipelined_decode_layers: n_layer ({n_layer}) must divide "
+            f"evenly over the {n_stages} pipeline stages of mesh axis "
+            f"{axis!r}"
+        )
+    n_lanes = jax.tree.leaves(act)[0].shape[0]
+    if n_micro is None:
+        n_micro = n_stages
+    if n_lanes % n_micro != 0:
+        raise ValueError(
+            f"pipelined_decode_layers: lane count ({n_lanes}) must "
+            f"divide over n_micro ({n_micro}) microbatches"
+        )
+    mw = n_lanes // n_micro
+    n_ticks = n_micro + n_stages - 1
+
+    def local(params_local, state_local, act_in):
+        s = jax.lax.axis_index(axis)
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+        xs = jax.tree.map(
+            lambda x: x.reshape((n_micro, mw) + x.shape[1:]), act_in
+        )
+        buf = jax.tree.map(lambda x: jnp.zeros_like(x[0]), xs)
+        outs = jax.tree.map(jnp.zeros_like, xs)
+
+        def layer(carry, xs_):
+            bp, st = xs_
+            return body_fn(carry, bp, st)
+
+        def tick(carry, t):
+            buf, outs, state_local = carry
+            # stage 0 ingests microbatch t while t < n_micro
+            inject = jax.tree.map(
+                lambda x: x[jnp.clip(t, 0, n_micro - 1)], xs
+            )
+            take_inject = jnp.logical_and(s == 0, t < n_micro)
+            buf = _tree_where(take_inject, inject, buf)
+            # this stage works microbatch m = t - s (clipped: bubble
+            # ticks compute on garbage lanes, masked below)
+            m = t - s
+            midx = jnp.clip(m, 0, n_micro - 1)
+            st_m = jax.tree.map(
+                lambda v: jax.lax.dynamic_slice_in_dim(
+                    v, midx * mw, mw, axis=1
+                ),
+                state_local,
+            )
+            y, new_st = jax.lax.scan(layer, buf, (params_local, st_m))
+            # state residency: the advanced rows write back into this
+            # stage's own slice; bubble ticks re-write the OLD rows
+            # (read-modify-write of identical values — a masked no-op)
+            valid = jnp.logical_and(m >= 0, m < n_micro)
+            write_st = _tree_where(valid, new_st, st_m)
+            state_local = jax.tree.map(
+                lambda v, w: jax.lax.dynamic_update_slice_in_dim(
+                    v, w, midx * mw, axis=1
+                ),
+                state_local,
+                write_st,
+            )
+            # the last stage finished microbatch m this tick
+            write = jnp.logical_and(s == n_stages - 1, m >= 0)
+            outs = jax.tree.map(
+                lambda o, y_leaf: jax.lax.dynamic_update_index_in_dim(
+                    o, jnp.where(write, y_leaf, o[midx]), midx, axis=0
+                ),
+                outs,
+                y,
+            )
+            buf = jax.lax.ppermute(y, axis, perm) if perm else y
+            return (buf, outs, state_local), None
+
+        (buf, outs, state_local), _ = jax.lax.scan(
+            tick, (buf, outs, state_local), jnp.arange(n_ticks)
+        )
+        # only the last stage holds real outputs; share them with
+        # everyone (state stays put — each stage returns its own rows)
+        outs = jax.tree.map(
+            lambda o: jax.lax.psum(
+                jnp.where(s == n_stages - 1, o, jnp.zeros_like(o)), axis
+            ),
+            outs,
+        )
+        act_out = jax.tree.map(
+            lambda o: o.reshape((n_lanes,) + o.shape[2:]), outs
+        )
+        return act_out, state_local
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *(None,) * (jnp.ndim(p) - 1)), stacked_params
+    )
+    state_specs = jax.tree.map(
+        lambda v: P(axis, *(None,) * (jnp.ndim(v) - 1)), stacked_state
+    )
+    act_specs = jax.tree.map(lambda x: P(*(None,) * jnp.ndim(x)), act)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, state_specs, act_specs),
+        out_specs=(act_specs, state_specs),
+        check_vma=False,
+    )
+    return fn(stacked_params, stacked_state, act)

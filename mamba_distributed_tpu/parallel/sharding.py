@@ -189,9 +189,17 @@ def opt_state_shardings(opt_shapes, params, param_sharding_tree, mesh: Mesh):
 # ------------------------------------------- serving tensor parallelism
 
 
-def serving_param_specs(params, model_shards: int):
+def serving_param_specs(params, model_shards: int, stage_shards: int = 1):
     """Per-parameter PartitionSpec pytree for SERVING weights over the
-    2-D serving mesh's ``model`` axis (parallel/mesh.serving_mesh).
+    serving mesh's ``model`` axis — and, at ``stage_shards > 1``, the
+    leading LAYER axis of every layer-stacked leaf (``blocks``/
+    ``attn_blocks`` subtrees, LoRA factor pools included) over the 3-D
+    mesh's ``stage`` axis (parallel/mesh.serving_mesh).  Stage and
+    model compose per leaf: axis 0 carries ``stage``, the TP rule axis
+    carries ``model``; non-stacked leaves (embedding, head, final
+    norm) stay stage-replicated.  A layer axis that doesn't divide by
+    ``stage_shards`` replicates (``validate_serving_stage_shards``
+    rejects that loudly at engine construction).
 
     The rules are the training ``_TP_RULES`` (every mixer weight's
     d_inner/head axis: Mamba in/out projections column/row-parallel,
@@ -223,6 +231,12 @@ def serving_param_specs(params, model_shards: int):
         names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
         shape = np.shape(leaf)
         spec: list = [None] * len(shape)
+        if (stage_shards > 1 and shape
+                and ("blocks" in names or "attn_blocks" in names)
+                and shape[0] % stage_shards == 0):
+            # layer-stacked leaf: stage owns whole layers (axis 0),
+            # composing with whatever model-axis rule applies below
+            spec[0] = "stage"
         if model_shards > 1 and len(names) >= 2 and names[-2] == "lora":
             # multi-tenant LoRA factor pools (serving/adapters.py):
             # "A" (L, slots+1, d_in, r) shards d_in with a ROW-parallel
@@ -243,8 +257,9 @@ def serving_param_specs(params, model_shards: int):
                 ax = len(shape) - 1  # d_out
             if ax is not None and shape[ax] % model_shards == 0:
                 spec[ax] = "model"
-                return P(*spec)
-            return P()
+            if all(s is None for s in spec):
+                return P()
+            return P(*spec)
         if model_shards > 1 and shape:
             lookup = names
             if names and names[-1] == "scale":
@@ -273,7 +288,10 @@ def serving_param_shardings(params, mesh: Mesh):
     (device_put at engine init / ``generate(mesh=)``; the compiled tick
     and chunk step re-assert it via sharding constraints so the layout
     can never decay mid-flight)."""
-    specs = serving_param_specs(params, dict(mesh.shape).get("model", 1))
+    specs = serving_param_specs(
+        params, dict(mesh.shape).get("model", 1),
+        dict(mesh.shape).get("stage", 1),
+    )
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 
@@ -347,12 +365,56 @@ def validate_serving_model_shards(cfg, model_shards: int) -> None:
         )
 
 
+def validate_serving_stage_shards(cfg, stage_shards: int) -> None:
+    """Reject a ``serving_stage_shards`` the model's LAYER STACKS
+    cannot tile — at ENGINE CONSTRUCTION, with the offending stack
+    named, instead of an opaque GSPMD error (or a silently replicated
+    stack) mid-flight.  The stage axis shards the leading layer axis of
+    every stacked family, so EACH family must divide: pure-SSM stacks
+    need ``n_layer % stage_shards == 0``; hybrid stacks need both the
+    mamba stack (``n_layer - n_attn``) and the attention stack
+    (``n_attn``) to divide — a stage owns whole layers of each family.
+    Tick compaction is NOT required: the microbatched schedule
+    (parallel/pipeline.pipelined_decode_layers) buckets whatever lane
+    width the launch runs at, compacted or full-capacity, and launches
+    the schedule cannot microbatch fall back to the stage-sharded
+    GSPMD scan.  ``cfg`` is a ModelConfig."""
+    if stage_shards <= 1:
+        return
+    problems = []
+    n_attn = len(cfg.attn_layer_idx)
+    n_mamba = cfg.n_layer - n_attn
+    if cfg.n_layer % stage_shards:
+        problems.append(f"n_layer={cfg.n_layer} (the layer stack)")
+    if n_attn:
+        if n_mamba % stage_shards:
+            problems.append(
+                f"mamba stack={n_mamba} (n_layer - the "
+                f"{n_attn} attention layers — the hybrid 'blocks' "
+                f"family shards separately)"
+            )
+        if n_attn % stage_shards:
+            problems.append(
+                f"attention stack={n_attn} (the hybrid 'attn_blocks' "
+                f"family — per-layer KV page pools shard with it)"
+            )
+    if problems:
+        raise ValueError(
+            f"serving_stage_shards={stage_shards} does not divide "
+            + "; ".join(problems)
+            + " — pick a divisor of every listed stack (or 1 to keep "
+              "the layer stacks unsharded)"
+        )
+
+
 # --------------------------------------------------- serving slot pool
 
 
-def slot_pool_specs(pool, num_shards: int):
+def slot_pool_specs(pool, num_shards: int, stage_shards: int = 1):
     """PartitionSpec pytree for a serving slot pool (serving/state_cache
-    .init_pool) sharded over a ``serving_mesh``'s data axis.
+    .init_pool) sharded over a ``serving_mesh``'s data axis — and, at
+    ``stage_shards > 1``, its per-LAYER leaves over the 3-D mesh's
+    stage axis.
 
     The SLOT axis partitions: ``blocks`` leaves are (L, S, ...) and
     ``attn_blocks`` page-pool leaves (A, P+1, nkv, page, hd) shard the
@@ -374,14 +436,30 @@ def slot_pool_specs(pool, num_shards: int):
     so a compact lane tree tiles over ``data`` exactly like the full
     pool it was gathered from (docs/SERVING.md "Occupancy-adaptive
     ticks").
+
+    STAGE tiling (``stage_shards > 1``, the 3-D mesh): the per-layer
+    leaves — ``blocks`` conv/SSM carry stacks (L, S, ...) and the
+    ``attn_blocks`` per-layer page pools (A, P+1, ...) — additionally
+    shard their leading LAYER axis over ``stage``, so each stage owns
+    exactly its own layers' decode state alongside its weight shard
+    (pipeline residency; a layer axis that doesn't divide replicates,
+    rejected loudly by ``validate_serving_stage_shards``).  The
+    data-axis rules above are stage-blind and unchanged — ``logits``/
+    ``meta`` have no layer axis and never name ``stage`` — and the
+    host ``PagePool`` bookkeeping stays data-only: the stage axis
+    tiles the LAYER axis of the page pools, never the page ranges.
     """
     def leaf_spec(path, leaf):
         names = [str(getattr(k, "key", getattr(k, "idx", None))) for k in path]
         shape = np.shape(leaf)
-        ax = 1 if ("blocks" in names or "attn_blocks" in names) else 0
+        stacked = "blocks" in names or "attn_blocks" in names
+        ax = 1 if stacked else 0
         spec: list = [None] * len(shape)
         if len(shape) > ax and shape[ax] % num_shards == 0:
             spec[ax] = "data"
+        if (stage_shards > 1 and stacked and shape
+                and shape[0] % stage_shards == 0):
+            spec[0] = "stage"
         return P(*spec)
 
     return jax.tree_util.tree_map_with_path(leaf_spec, pool)
@@ -389,10 +467,11 @@ def slot_pool_specs(pool, num_shards: int):
 
 def slot_pool_shardings(pool, mesh: Mesh):
     """NamedSharding pytree for the slot pool over ``mesh``'s data axis
-    (device_put at engine init; re-asserted by the tick's sharding
-    constraints every step so insert/evict propagation can never decay
-    the layout)."""
-    specs = slot_pool_specs(pool, mesh.shape["data"])
+    (and its layer stacks over a 3-D mesh's stage axis — device_put at
+    engine init; re-asserted by the tick's sharding constraints every
+    step so insert/evict propagation can never decay the layout)."""
+    specs = slot_pool_specs(pool, mesh.shape["data"],
+                            dict(mesh.shape).get("stage", 1))
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 

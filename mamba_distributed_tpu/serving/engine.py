@@ -126,11 +126,12 @@ def _prefill(params: dict, ids: jax.Array, mask: jax.Array, cfg: ModelConfig,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "k_max", "steps", "mesh"),
+    jax.jit, static_argnames=("cfg", "k_max", "steps", "mesh", "n_micro"),
     donate_argnums=(1,),
 )
 def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
-          cfg: ModelConfig, k_max: int, steps: int, mesh=None):
+          cfg: ModelConfig, k_max: int, steps: int, mesh=None,
+          n_micro=None):
     """Advance every slot ``steps`` tokens.  Returns (pool', tokens
     (steps, S), emitted (steps, S), done (steps, S)) — ``emitted[j, s]``
     marks a real token (slot live at sub-step j), ``done[j, s]`` the
@@ -155,6 +156,16 @@ def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
     slots that are empty or budget-done still compute — that waste is
     the price of a single static-shape trace, and it is reclaimed by
     admitting new requests into those slots between ticks.
+
+    ``n_micro`` (static; only ever set when ``mesh`` has a ``stage``
+    axis > 1 and the stack is pure-SSM) engages the explicit GPipe
+    schedule inside ``lm_step``: the slot lanes split into ``n_micro``
+    microbatches that flow through the stage-resident layer groups
+    (parallel/pipeline.pipelined_decode_layers) — bitwise identical to
+    the sequential layer scan, only the placement of work changes.
+    ``n_micro=None`` at ``stage > 1`` still runs correctly: GSPMD
+    executes the stage-sharded layer scan without the explicit
+    microbatch clock.
     """
     TRACE_COUNTS["tick"] += 1
     pad_mask = vocab_pad_mask(cfg)
@@ -177,7 +188,8 @@ def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
             slot_pool_shardings,
         )
 
-        if dict(mesh.shape).get("model", 1) > 1:
+        if (dict(mesh.shape).get("model", 1) > 1
+                or dict(mesh.shape).get("stage", 1) > 1):
             params = constrain_serving_params(params, mesh)
         pool = jax.lax.with_sharding_constraint(
             pool, slot_pool_shardings(pool, mesh)
@@ -221,7 +233,10 @@ def _tick(params: dict, pool: dict, tbl=None, lengths=None, *,
             lengths = state["attn_meta"][1]
             state = {k: v for k, v in state.items() if k != "attn_meta"}
         else:
-            logits, state = lm_step(params, cfg, pool["state"], tok)
+            logits, state = lm_step(
+                params, cfg, pool["state"], tok,
+                pipeline=((mesh, n_micro) if n_micro else None),
+            )
         # empty/done slots may compute garbage freely (masked, overwritten
         # by the next insert), but a prefilling slot's rows hold a REAL
         # carry — keep them (select per (L, S, ...) leaf on the S axis).
@@ -307,22 +322,32 @@ class ServingEngine:
         wall ms (EWMA baseline; transition-only ``tick_regression``
         events when ticks run a factor slower than steady state).
         None (default) off.
-      mesh: a ``parallel/mesh.serving_mesh`` — the 2-D sharded path.
-        Slot/page state and the tick's batch axis partition over the
-        mesh's DATA axis; the weights partition over its MODEL axis
-        (tensor parallel: Mamba d_inner channels, attention heads,
-        embedding/head vocab — parallel/sharding.serving_param_specs;
-        ``model=1`` replicates them, the exact pre-TP layout).  One
-        engine's pool and weights span every device in the mesh;
-        ``capacity`` must divide over the data shards and d_inner/
-        heads/vocab over the model shards (checked here, loudly).
-        None (default) builds a mesh from ``cfg.serving_data_shards``
-        x ``cfg.serving_model_shards`` when either knob is > 1, else
-        everything stays single-device.  Host bookkeeping follows the
-        device layout: a slot resident in data-shard d draws KV pages
-        only from shard d's contiguous page range
-        (state_cache.PagePool); the model axis never touches page
-        accounting — pages tile over data only.
+      mesh: a ``parallel/mesh.serving_mesh`` — the sharded path (2-D
+        ``(data, model)``, or 3-D ``(data, stage, model)`` when the
+        pipeline axis is on).  Slot/page state and the tick's batch
+        axis partition over the mesh's DATA axis; the weights
+        partition over its MODEL axis (tensor parallel: Mamba d_inner
+        channels, attention heads, embedding/head vocab —
+        parallel/sharding.serving_param_specs; ``model=1`` replicates
+        them, the exact pre-TP layout); the scan-over-layers parameter
+        stacks AND the per-layer slot-state stacks partition their
+        leading LAYER axis over the STAGE axis (GPipe residency: each
+        stage holds only its own layers' weights, conv/SSM carries
+        and KV page pools).  Pure-SSM decode ticks at ``stage > 1``
+        additionally run the explicit microbatched clock
+        (parallel/pipeline.pipelined_decode_layers) when the live
+        width tiles over the stages — bitwise identical either way.
+        One engine's pool and weights span every device in the mesh;
+        ``capacity`` must divide over the data shards, d_inner/heads/
+        vocab over the model shards, and every stacked layer family
+        over the stage shards (checked here, loudly).  None (default)
+        builds a mesh from ``cfg.serving_data_shards`` x
+        ``cfg.serving_stage_shards`` x ``cfg.serving_model_shards``
+        when any knob is > 1, else everything stays single-device.
+        Host bookkeeping follows the device layout: a slot resident
+        in data-shard d draws KV pages only from shard d's contiguous
+        page range (state_cache.PagePool); the model and stage axes
+        never touch page accounting — pages tile over data only.
       prefix_cache: a serving/prefix_cache.PrefixCache, or None to
         build one from ``cfg.prefix_cache_entries`` (> 0 enables; the
         default 0 keeps the cache off).  Admission matches the longest
@@ -431,15 +456,20 @@ class ServingEngine:
             raise ValueError("prefill_tokens_per_tick must be >= 0 "
                              "(0 => unbounded)")
         if mesh is None and (cfg.serving_data_shards > 1
-                             or cfg.serving_model_shards > 1):
+                             or cfg.serving_model_shards > 1
+                             or cfg.serving_stage_shards > 1):
             from mamba_distributed_tpu.parallel.mesh import serving_mesh
 
             mesh = serving_mesh(cfg.serving_data_shards,
-                                model_shards=cfg.serving_model_shards)
+                                model_shards=cfg.serving_model_shards,
+                                stage_shards=cfg.serving_stage_shards)
         self.mesh = mesh
         self.num_shards = 1 if mesh is None else int(mesh.shape["data"])
         self.model_shards = (
             1 if mesh is None else int(dict(mesh.shape).get("model", 1))
+        )
+        self.stage_shards = (
+            1 if mesh is None else int(dict(mesh.shape).get("stage", 1))
         )
         if capacity % self.num_shards:
             raise ValueError(
@@ -455,6 +485,14 @@ class ServingEngine:
             )
 
             validate_serving_model_shards(cfg, self.model_shards)
+        if self.stage_shards > 1:
+            # same construction-time loudness for the pipeline axis:
+            # every stacked layer family must tile over the stages
+            from mamba_distributed_tpu.parallel.sharding import (
+                validate_serving_stage_shards,
+            )
+
+            validate_serving_stage_shards(cfg, self.stage_shards)
         self.cfg = cfg
         self.capacity = capacity
         self.max_top_k = max_top_k
@@ -483,9 +521,13 @@ class ServingEngine:
                 self.pool, slot_pool_shardings(self.pool, mesh)
             )
         # the mesh the chunk step / one-shot prefill need for weight
-        # constraints — None below model=2 so the TP-off jit signatures
+        # constraints — None when neither the model nor the stage axis
+        # partitions the weights, so the sharding-off jit signatures
         # (and trace counts) are byte-identical to the pre-TP engine
-        self._tp_mesh = mesh if self.model_shards > 1 else None
+        self._tp_mesh = (
+            mesh if (self.model_shards > 1 or self.stage_shards > 1)
+            else None
+        )
         self.scheduler = FCFSScheduler(
             default_priority=cfg.serving_default_priority
         )
@@ -527,8 +569,10 @@ class ServingEngine:
             flops_per_prefill_token=flops_per_token(
                 cfg, prefill_seq, training=False, convention="model"),
             peak_flops=peak_flops_per_chip() * self.num_shards
-            * self.model_shards,
+            * self.model_shards * self.stage_shards,
         )
+        if self.stage_shards > 1:
+            self.metrics.configure_pipeline(self.stage_shards)
         self._free: list[int] = list(range(capacity))
         self._slots: dict[int, _Tracked] = {}
         # slots holding a partial chunked prefill, in admission order;
@@ -2182,7 +2226,34 @@ class ServingEngine:
         self.pool = {"state": state, "logits": res["logits"],
                      "meta": res["meta"]}
 
-    def _compact_tick(self, live_slots, width: int):
+    def _pipeline_micro(self, width: int | None) -> int | None:
+        """Microbatch count for the explicit GPipe decode schedule, or
+        None for the GSPMD layer scan.
+
+        The explicit clock (parallel/pipeline.pipelined_decode_layers)
+        engages only where it is defined and profitable: a 3-D mesh
+        with ``stage > 1`` whose other axes are size 1, a pure-SSM
+        stack (hybrid attention needs the paged-KV metadata plumbing
+        the schedule doesn't thread), no multi-tenant LoRA (bound
+        factor pools carry a per-slot axis the schedule doesn't
+        slice), and the non-speculative tick (spec_verify launches are
+        chunk-shaped, not lane-shaped).  Everywhere else the
+        stage-sharded layer axis still partitions residency and GSPMD
+        executes the sequential scan — the bitwise-identical fallback.
+
+        ``n_micro = stage_shards`` when the launch width tiles over
+        the stages (the pow2 compaction buckets make this the common
+        case), else 1 (a sequential flush — still one trace per
+        bucket, so TRACE_COUNTS stay flat across repeated ticks)."""
+        if (self.stage_shards <= 1 or self.hybrid or self.spec
+                or self.lora or self.model_shards > 1
+                or self.num_shards > 1):
+            return None
+        w = self.capacity if width is None else width
+        return (self.stage_shards if w % self.stage_shards == 0
+                else 1)
+
+    def _compact_tick(self, live_slots, width: int, n_micro=None):
         """One COMPACTED decode tick: gather the live slots' rows into
         ``width`` lanes, run the identical ``_tick`` jit at lane width
         (one trace per pow2 bucket), scatter the advanced rows back,
@@ -2210,7 +2281,7 @@ class ServingEngine:
         new_cpool, tokens, emitted, done = _tick(
             self._params, cpool, *tick_kv, cfg=self.cfg,
             k_max=self.max_top_k, steps=self.tokens_per_tick,
-            mesh=self.mesh,
+            mesh=self.mesh, n_micro=n_micro,
         )
         self._scatter_pool(
             new_cpool["state"],
@@ -2456,6 +2527,20 @@ class ServingEngine:
         # their parked carries are simply never gathered — so the tick
         # is priced by decodable slots, not residency.
         width = self._compaction_width(live_slots)
+        # explicit GPipe microbatch count for this tick's launch (None
+        # => the GSPMD layer scan; _pipeline_micro documents the gate)
+        # and the schedule's honest bubble bill: the warmup/drain ramp
+        # idles (stage_shards - 1) stage-ticks per lm_step call, worth
+        # (stage_shards - 1) * microbatch_width full-depth lane
+        # equivalents x tokens_per_tick sub-steps
+        n_micro = self._pipeline_micro(width)
+        bubble_lanes = 0
+        if n_micro:
+            bubble_lanes = (
+                (self.stage_shards - 1)
+                * ((self.capacity if width is None else width) // n_micro)
+                * self.tokens_per_tick
+            )
         # live trace-id set: the requests this tick actually advances
         # (mid-prefill residents are masked out of sampling) — stamped
         # on the span AND the jsonl record so host-side attribution can
@@ -2476,7 +2561,7 @@ class ServingEngine:
                 tokens, emitted, done = self._spec_tick(width)
             elif width is not None:
                 tokens, emitted, done = self._compact_tick(
-                    live_slots, width
+                    live_slots, width, n_micro
                 )
             else:
                 tick_kv = ()
@@ -2498,7 +2583,7 @@ class ServingEngine:
                 self.pool, tokens, emitted, done = _tick(
                     self._params, self.pool, *tick_kv, cfg=self.cfg,
                     k_max=self.max_top_k, steps=self.tokens_per_tick,
-                    mesh=self.mesh,
+                    mesh=self.mesh, n_micro=n_micro,
                 )
                 tokens = np.asarray(tokens)  # (steps, S) — the host sync
                 emitted = np.asarray(emitted)
@@ -2746,6 +2831,13 @@ class ServingEngine:
             ),
             traces=live_traces,
             model_shards=(self.model_shards if self.model_shards > 1
+                          else None),
+            # pipeline stamps only when the stage axis is live, so 2-D
+            # engines' records stay byte-stable; bubble_lanes is 0 on
+            # GSPMD-fallback ticks (no explicit clock, no ramp waste)
+            stage_shards=(self.stage_shards if self.stage_shards > 1
+                          else None),
+            bubble_lanes=(bubble_lanes if self.stage_shards > 1
                           else None),
             preemptions=self._preemptions,
             migrations_out=self._migrations_out,

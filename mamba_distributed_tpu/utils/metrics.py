@@ -233,6 +233,19 @@ class ServingMetrics:
         self.compaction_ticks = 0  # ticks that ran NARROWER than capacity
         self.compaction_hist: dict[int, int] = {}  # lane width -> ticks
         self.compaction_lanes_saved = 0
+        # 3-D serving mesh pipeline axis (parallel/mesh.serving_mesh;
+        # docs/SERVING.md "3-D serving mesh"): the engine calls
+        # configure_pipeline() when serving_stage_shards > 1,
+        # unlocking summary()["pipeline"] — stage width, how many
+        # ticks ran the explicit microbatched clock, and the
+        # warmup/drain bubble lanes those schedules idled (billed
+        # into goodput's wasted_token_lanes).  Off by default so
+        # stage=1 records/summaries stay byte-stable.
+        self._pipeline_on = False
+        self.stage_shards_cfg: int | None = None
+        self.pipeline_ticks = 0  # ticks that ran the explicit clock
+        self.pipeline_bubble_lanes = 0
+        self._pipeline_slot_lanes = 0  # Σ slot_lanes on those ticks
         # multi-tenant LoRA serving (serving/adapters.py): the engine
         # calls configure_adapters() when cfg.lora_max_adapters > 0,
         # unlocking summary()["adapters"] — registry/cache shape,
@@ -417,6 +430,16 @@ class ServingMetrics:
         self.weight_dtype = weight_dtype
         self.kv_dtype = kv_dtype
 
+    # ------------------------------------------- pipeline (3-D mesh)
+
+    def configure_pipeline(self, stage_shards: int) -> None:
+        """Mark the serving mesh's pipeline ``stage`` axis live (engine
+        construction, only at ``serving_stage_shards > 1``):
+        ``summary()`` gains its ``pipeline`` section and tick records
+        their ``stage_shards``/``bubble_lanes`` stamps."""
+        self._pipeline_on = True
+        self.stage_shards_cfg = int(stage_shards)
+
     # ----------------------------------------------- compile watchdog
 
     def configure_compile(self) -> None:
@@ -518,6 +541,8 @@ class ServingMetrics:
         slot_lanes: int = 0,
         traces: list | None = None,
         model_shards: int | None = None,
+        stage_shards: int | None = None,
+        bubble_lanes: int | None = None,
         preemptions: int = 0,
         migrations_out: int = 0,
         migrations_in: int = 0,
@@ -578,6 +603,13 @@ class ServingMetrics:
         stamps the mesh's model-axis width on the record so per-tick
         rates are attributable to their weight layout; None (the
         replicated default) leaves the record unchanged.
+        ``stage_shards`` (3-D pipelined serving engines, i.e. > 1)
+        stamps the mesh's stage-axis width the same way, and
+        ``bubble_lanes`` bills the explicit microbatched schedule's
+        warmup/drain ramp — full-depth lane equivalents the pipeline
+        idled this tick, 0 on GSPMD-fallback ticks — into the goodput
+        lane count, so ``wasted_token_lanes`` is honest about the
+        bubble; None (stage=1) leaves records byte-stable.
         ``prefix_hits``/``prefix_misses``/``prefix_saved_tokens`` are
         the prefix-state cache's window counters and
         ``prefix_cache_entries``/``prefix_cache_bytes`` its occupancy
@@ -608,7 +640,8 @@ class ServingMetrics:
         window_s = dt_s + prefill_stall_ms / 1000.0
         useful = (tokens_emitted + prefill_real_tokens
                   + prefill_oneshot_tokens)
-        lanes = slot_lanes + prefill_chunk_tokens + prefill_oneshot_lanes
+        lanes = (slot_lanes + prefill_chunk_tokens
+                 + prefill_oneshot_lanes + (bubble_lanes or 0))
         self.useful_tokens += useful
         self.computed_token_lanes += lanes
         self._goodput_window_s += window_s
@@ -641,6 +674,17 @@ class ServingMetrics:
             record["traces"] = list(traces)
         if model_shards is not None:
             record["model_shards"] = model_shards
+        if stage_shards is not None:
+            # pipeline-axis stamps (only at stage > 1 — 2-D engines'
+            # records stay byte-stable): the stage width and this
+            # tick's bubble bill (0 when GSPMD ran the layer scan
+            # without the explicit microbatch clock)
+            record["stage_shards"] = stage_shards
+            record["bubble_lanes"] = bubble_lanes or 0
+            if bubble_lanes:
+                self.pipeline_ticks += 1
+                self.pipeline_bubble_lanes += bubble_lanes
+                self._pipeline_slot_lanes += slot_lanes
         if preemptions:
             record["preemptions"] = preemptions
         if migrations_out:
@@ -851,6 +895,21 @@ class ServingMetrics:
                     for w, n in sorted(self.compaction_hist.items())
                 },
                 "lanes_saved": self.compaction_lanes_saved,
+            }),
+            "pipeline": (None if not self._pipeline_on else {
+                "stage_shards": self.stage_shards_cfg,
+                # ticks that ran the explicit microbatched clock (the
+                # rest fell back to the GSPMD layer scan — same bits,
+                # no ramp) and the ramp's cumulative idle lanes
+                "pipelined_ticks": self.pipeline_ticks,
+                "bubble_lanes": self.pipeline_bubble_lanes,
+                "bubble_fraction": (
+                    round(self.pipeline_bubble_lanes
+                          / (self.pipeline_bubble_lanes
+                             + self._pipeline_slot_lanes), 4)
+                    if (self.pipeline_bubble_lanes
+                        + self._pipeline_slot_lanes) else None
+                ),
             }),
             "speculation": (None if not self._spec_on else {
                 "spec_tokens": self.spec_tokens_cfg,

@@ -884,6 +884,16 @@ def main() -> None:
                          "count=K).  In the default mode this also times "
                          "a replicated-weights engine on the identical "
                          "workload and reports tp_vs_replicated_speedup")
+    ap.add_argument("--stage-shards", type=int, default=0, metavar="N",
+                    help="pipeline-parallel the serving layer stack N-way "
+                         "over the 3-D serving mesh's stage axis "
+                         "(cfg.serving_stage_shards; on CPU combine with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=K).  In the default mode this also times "
+                         "a pure-TP engine at the SAME device count "
+                         "(model_shards = N x model) on the identical "
+                         "workload and reports pipeline_vs_tp_speedup — "
+                         "the BENCH_SERVING.json pipeline_vs_tp_cpu row")
     ap.add_argument("--weight-dtype", default=None,
                     choices=["bf16", "int8"],
                     help="serving weight dtype (cfg.serving_weight_dtype; "
@@ -1052,6 +1062,13 @@ def main() -> None:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, serving_model_shards=model_shards)
+    stage_shards = args.stage_shards or int(
+        os.environ.get("SERVE_STAGE_SHARDS", "0")
+    )
+    if stage_shards:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, serving_stage_shards=stage_shards)
     from mamba_distributed_tpu.ops.quant import apply_dtype_overrides
 
     kv_dtype = args.kv_dtype or os.environ.get("SERVE_KV_DTYPE")
@@ -2176,6 +2193,43 @@ def main() -> None:
                   f"{dt_rep:.2f}s "
                   f"({tp_fields['tp_vs_replicated_speedup']}x tp speedup)")
 
+    pipe_fields = {}
+    if cfg.serving_stage_shards > 1:
+        # pipelined vs pure-TP at EQUAL device count: the SAME
+        # workload through an engine whose stage axis collapses into
+        # the model axis (model = stage x model, stage = 1) — isolates
+        # what trading TP all-reduces for pipeline ppermute hops buys
+        # at fixed silicon (on a shared-core CPU host both collectives
+        # are memcpy, the row is a trajectory marker like
+        # tp_vs_replicated)
+        import dataclasses
+
+        tp_cfg = dataclasses.replace(
+            cfg, serving_stage_shards=1,
+            serving_model_shards=(cfg.serving_stage_shards
+                                  * cfg.serving_model_shards),
+        )
+        kw = dict(capacity=capacity, tokens_per_tick=tokens_per_tick)
+        ServingEngine(params, tp_cfg, **kw).run(requests)  # warm
+        t0 = time.perf_counter()
+        tp_results = ServingEngine(params, tp_cfg, **kw).run(requests)
+        dt_tp = time.perf_counter() - t0
+        tp_tokens = sum(len(r.new_tokens) for r in tp_results)
+        # the row is only meaningful if both layouts did the same work
+        assert tp_tokens == served_tokens, (tp_tokens, served_tokens)
+        pipe_summary = summary.get("pipeline") or {}
+        pipe_fields = {
+            "serving_stage_shards": cfg.serving_stage_shards,
+            "pure_tp_tokens_per_sec": round(tp_tokens / dt_tp, 1),
+            "pipeline_vs_tp_speedup": round(dt_tp / dt_serve, 2),
+            "pipelined_ticks": pipe_summary.get("pipelined_ticks"),
+            "bubble_lanes": pipe_summary.get("bubble_lanes"),
+        }
+        _progress(f"pure TP ({tp_cfg.serving_model_shards}-way): "
+                  f"{tp_tokens} tokens in {dt_tp:.2f}s "
+                  f"({pipe_fields['pipeline_vs_tp_speedup']}x pipeline "
+                  f"speedup)")
+
     record = {
         "metric": f"serving_tokens_per_sec_per_chip_{preset.replace('-', '_')}",
         "value": round(served_tokens / dt_serve, 1),
@@ -2196,6 +2250,7 @@ def main() -> None:
         "latency": summary["latency"],
         "device": dev.device_kind,
         **tp_fields,
+        **pipe_fields,
     }
     if summary.get("kv_pages"):
         record["kv_pages"] = summary["kv_pages"]

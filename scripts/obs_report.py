@@ -272,6 +272,23 @@ def build_report(events: list[dict]) -> dict:
                 "mean_width": round(sum(widths) / len(widths), 2),
                 "min_width": min(widths),
             }
+        # 3-D serving-mesh pipeline gauges (absent unless a stage>1
+        # engine wrote the stream): stage width, ticks that ran the
+        # explicit microbatched clock, and the warmup/drain bubble
+        # lanes those schedules idled (docs/SERVING.md "3-D serving
+        # mesh")
+        pticks = [e for e in ticks
+                  if e.get("stage_shards") is not None]
+        pipeline = None
+        if pticks:
+            bubble = sum(e.get("bubble_lanes", 0) for e in pticks)
+            pipeline = {
+                "stage_shards": pticks[-1]["stage_shards"],
+                "ticks": len(pticks),
+                "pipelined_ticks": sum(
+                    1 for e in pticks if e.get("bubble_lanes")),
+                "bubble_lanes": bubble,
+            }
         # quantized-serving gauges (absent unless an int8 engine wrote
         # the stream): the dtype stamp + resident-bytes from the last
         # stamped tick (docs/SERVING.md "Quantized serving")
@@ -350,6 +367,7 @@ def build_report(events: list[dict]) -> dict:
             "goodput": goodput,
             "prefix_cache": prefix,
             "compaction": compaction,
+            "pipeline": pipeline,
             "speculation": speculation,
             "adapters": adapters,
             "sessions": sessions,
@@ -684,6 +702,13 @@ def format_report(report: dict) -> str:
                 f"\ncompaction: {c['ticks_compacted']}/{c['ticks']} "
                 f"ticks compacted   mean lane width: {c['mean_width']}"
                 f"   min: {c['min_width']}"
+            )
+        if s.get("pipeline"):
+            p = s["pipeline"]
+            head += (
+                f"\npipeline: {p['stage_shards']} stages   "
+                f"{p['pipelined_ticks']}/{p['ticks']} ticks microbatched"
+                f"   bubble lanes: {_fmt(p['bubble_lanes'])}"
             )
         if s.get("speculation"):
             sp = s["speculation"]

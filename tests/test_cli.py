@@ -393,6 +393,63 @@ def test_bench_serving_compaction_smoke(tmp_path):
 
 
 @pytest.mark.serving
+@pytest.mark.pipe_serve
+@pytest.mark.slow
+def test_bench_serving_pipeline_smoke(tmp_path):
+    """CI smoke for the 3-D serving-mesh pipeline bench:
+    ``--stage-shards 2`` must build the pipelined engine AND the
+    equal-device pure-TP comparator on the identical workload (token
+    counts asserted equal inside the bench), stamp the pipeline
+    fields on the record, leave a tick stream whose pipeline line
+    obs_report.py renders, and gate against the committed
+    pipeline_vs_tp_cpu row.  Marked slow like the serve_fabric smoke:
+    it compiles TWO engines in a subprocess — the same surfaces run
+    un-marked in tests/test_pipeline_serving.py through the library
+    entrypoints."""
+    import json
+
+    json_out = str(tmp_path / "pipe.json")
+    jsonl = str(tmp_path / "pipe.jsonl")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               SERVE_REQUESTS="4", SERVE_CAPACITY="4",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="6",
+               SERVE_MAX_NEW="4", SERVE_TOKENS_PER_TICK="2")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--stage-shards", "2", "--json", json_out, "--jsonl", jsonl],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(open(json_out).read().strip())
+    assert rec["serving_stage_shards"] == 2
+    assert rec["pure_tp_tokens_per_sec"] > 0
+    assert rec["pipeline_vs_tp_speedup"] > 0
+    # capacity 4 tiles over 2 stages -> the explicit microbatched
+    # clock engaged and billed its warmup/drain ramp
+    assert rec["pipelined_ticks"] >= 1
+    assert rec["bubble_lanes"] > 0
+    # the tick stream renders the report's pipeline line
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         jsonl],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "pipeline:" in r.stdout
+    # gates against the committed row (huge band: the smoke's tiny
+    # workload is a different operating point than the committed run)
+    g = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         json_out, "--case", "pipeline_vs_tp_cpu", "--band", "0.99"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert g.returncode == 0, g.stdout + g.stderr
+    assert "pipeline_vs_tp_cpu" in g.stdout
+
+
+@pytest.mark.serving
 def test_bench_gate_smoke(tmp_path, monkeypatch):
     """CI smoke for the bench regression gate (ISSUE 7 satellite): a
     fresh tiny ``bench_serving --json`` run passes against a baseline
