@@ -403,6 +403,34 @@ class ModelConfig:
     autoscale_clear_evals: int = 10
     autoscale_queue_high: float = 2.0
     autoscale_queue_low: float = 0.5
+    # --- online per-tenant LoRA tuning (serving/tuning/; docs/
+    # SERVING.md "Online adapter tuning") ---
+    # Per-tenant fairness quota: max concurrent resident slots one
+    # adapter BASE name (any version) may hold on an engine.  0
+    # (default) = no quota, the byte-stable status quo.  > 0 makes
+    # admission REQUEUE (never shed) a request whose tenant already
+    # holds this many slots — the named
+    # serving.scheduler.TenantQuotaExceeded deferral, so one hot
+    # tenant cannot starve the rest of the slot pool.
+    tenant_max_slots: int = 0
+    # A/B routing for freshly tuned adapter versions: the fraction of
+    # BARE-name requests routed to the tenant's LATEST version; the
+    # rest pin the previous one (a deterministic per-request hash of
+    # the sampling seed picks the arm, so retries land on the same
+    # version).  1.0 (default) routes everyone to the latest — with a
+    # single version that is the exact PR-15 status quo.  Explicit
+    # ``name@vN`` requests always bypass the split.
+    lora_ab_fraction: float = 1.0
+    # Online tune-job train-step knobs (serving/tuning/trainer.py):
+    # optimizer steps per job (one batch per step, examples cycled),
+    # Adam learning rate over the factor leaves, examples per batch,
+    # and the fixed sequence length examples are right-padded /
+    # truncated to (static shapes keep ONE compiled masked step per
+    # fabric).  Inert until a trainer-role replica exists.
+    tune_steps: int = 20
+    tune_lr: float = 1e-3
+    tune_batch_size: int = 4
+    tune_seq_len: int = 64
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "dots", "mixer"):
@@ -564,6 +592,34 @@ class ModelConfig:
                     f"lora_cache_slots must be >= 0 (0 => auto: "
                     f"lora_max_adapters), got {self.lora_cache_slots}"
                 )
+        if self.tenant_max_slots < 0:
+            raise ValueError(
+                f"tenant_max_slots must be >= 0 (0 = no per-tenant "
+                f"quota), got {self.tenant_max_slots}"
+            )
+        if not 0.0 <= self.lora_ab_fraction <= 1.0:
+            raise ValueError(
+                f"lora_ab_fraction must be in [0, 1] (the share of "
+                f"bare-name requests routed to the latest adapter "
+                f"version), got {self.lora_ab_fraction}"
+            )
+        if self.tune_steps < 1:
+            raise ValueError(
+                f"tune_steps must be >= 1, got {self.tune_steps}"
+            )
+        if self.tune_lr <= 0:
+            raise ValueError(
+                f"tune_lr must be > 0, got {self.tune_lr}"
+            )
+        if self.tune_batch_size < 1:
+            raise ValueError(
+                f"tune_batch_size must be >= 1, got "
+                f"{self.tune_batch_size}"
+            )
+        if self.tune_seq_len < 1:
+            raise ValueError(
+                f"tune_seq_len must be >= 1, got {self.tune_seq_len}"
+            )
         if self.session_ttl_s < 0:
             raise ValueError(
                 f"session_ttl_s must be >= 0 (0 = parked sessions never "

@@ -206,6 +206,28 @@ def replica_families(snapshots: list[dict]) -> list[MetricFamily]:
                     "XLA backend compiles observed by the watchdog.")
     compile_ms = _fam("compile_ms_total", "counter",
                       "Wall ms spent in XLA backend compiles.")
+    # online adapter tuning (serving/tuning/): None-gated on
+    # summary()["tuning"] exactly like the kv/compile blocks — a fabric
+    # with no tuning plane renders byte-identically to before
+    quota_stalls = _fam("tenant_quota_stalls_total", "counter",
+                        "Admissions deferred by the per-tenant "
+                        "fairness quota (requeued, not shed).")
+    hot_swaps = _fam("adapter_hot_swaps_total", "counter",
+                     "Live streams switched adapter versions "
+                     "mid-flight (carry invalidated once).")
+    tune_jobs = _fam("tune_jobs_total", "counter",
+                     "Tune-job lifecycle transitions, by state "
+                     "(submitted/completed/failed).")
+    tune_steps = _fam("tune_train_steps_total", "counter",
+                      "Masked LoRA train steps run on trainer lanes.")
+    tune_deploys = _fam("tune_deploys_total", "counter",
+                        "Converged adapter versions hot-registered "
+                        "fabric-wide.")
+    tune_yields = _fam("tune_yields_total", "counter",
+                       "Training slices yielded to serving pressure "
+                       "(SLO breach).")
+    tune_loss = _fam("tune_last_loss", "gauge",
+                     "Most recent tune step's mean loss.")
     hists = {
         "queue_wait_ms": _fam("queue_wait_ms", "histogram",
                               "Per-request queue wait (admission to "
@@ -214,6 +236,9 @@ def replica_families(snapshots: list[dict]) -> list[MetricFamily]:
                         "Per-request time to first token, ms."),
         "itl_ms": _fam("itl_ms", "histogram",
                        "Per-request inter-token latency, ms."),
+        "tune_step_ms": _fam("tune_step_ms", "histogram",
+                             "Per-step LoRA train wall time, ms "
+                             "(shipped only when tuning is live)."),
     }
     for snap in snapshots:
         if not snap:
@@ -261,6 +286,18 @@ def replica_families(snapshots: list[dict]) -> list[MetricFamily]:
         if comp:
             compiles.add(comp.get("compiles", 0), **labels)
             compile_ms.add(comp.get("compile_ms", 0.0), **labels)
+        tun = s.get("tuning")
+        if tun:
+            quota_stalls.add(tun.get("quota_stalls", 0), **labels)
+            hot_swaps.add(tun.get("hot_swaps", 0), **labels)
+            for state in ("submitted", "completed", "failed"):
+                tune_jobs.add(tun.get(f"jobs_{state}", 0),
+                              **labels, state=state)
+            tune_steps.add(tun.get("train_steps", 0), **labels)
+            tune_deploys.add(tun.get("deploys", 0), **labels)
+            tune_yields.add(tun.get("yields", 0), **labels)
+            if tun.get("last_loss") is not None:
+                tune_loss.add(tun["last_loss"], **labels)
         for key, fam in hists.items():
             h = (snap.get("histograms") or {}).get(key)
             if h:
@@ -268,7 +305,8 @@ def replica_families(snapshots: list[dict]) -> list[MetricFamily]:
     return [ticks, dtok, tps, tickms, occ, qdepth, resident, cap, fin,
             preempt, mig_out, mig_in, kv_used, kv_cap, kv_peak, kv_allocs,
             kv_frees, useful, gtps, mfu, compiles, compile_ms,
-            *hists.values()]
+            quota_stalls, hot_swaps, tune_jobs, tune_steps, tune_deploys,
+            tune_yields, tune_loss, *hists.values()]
 
 
 def fabric_families(*, replicas: int, accepting: int, ready: bool,
@@ -276,7 +314,8 @@ def fabric_families(*, replicas: int, accepting: int, ready: bool,
                     obs_records_dropped: int | None = None,
                     queue_depth: int | None = None,
                     sheds: dict | None = None,
-                    autoscale: dict | None = None
+                    autoscale: dict | None = None,
+                    tune_queue_depth: int | None = None
                     ) -> list[MetricFamily]:
     """The controller's own fabric-level gauges (no replica label).
     ``queue_depth``/``sheds``/``autoscale`` are None-gated like the obs
@@ -320,6 +359,11 @@ def fabric_families(*, replicas: int, accepting: int, ready: bool,
                  "Replicas drained for retirement by the autoscaler.")
             .add(autoscale.get("scale_downs", 0)),
         ]
+    if tune_queue_depth is not None:
+        fams.append(_fam("fabric_tune_queue_depth", "gauge",
+                         "Unfinished tune jobs (active + queued) on "
+                         "the fabric's tuning plane.")
+                    .add(tune_queue_depth))
     return fams
 
 

@@ -48,6 +48,13 @@ docs/SERVING.md has the architecture; the short version:
                shedding — queue deadlines + a fabric queue cap, the
                named AdmissionRejected -> HTTP 429
                (docs/SERVING.md "Elastic fabric")
+  tuning/      online per-tenant LoRA training ON the fabric: a
+               trainer-role replica runs a frozen-base masked train
+               step over the tenant's {A, B} factor pools, yields to
+               serving on SLO breach, and hot-registers the trained
+               ``name@v(N+1)`` fabric-wide — new submits A/B-route
+               across the last two versions, zero offline steps
+               (docs/SERVING.md "Online adapter tuning")
   service/     the deployable shape of all of the above: versioned
                wire codec, one replica per worker PROCESS, an asyncio
                HTTP/SSE front end running the UNCHANGED router, and
@@ -60,6 +67,7 @@ from mamba_distributed_tpu.serving.adapters import (
     AdapterCache,
     AdapterCacheError,
     AdapterRegistry,
+    AdapterVersionError,
     UnknownAdapterError,
 )
 from mamba_distributed_tpu.serving.autoscale import (
@@ -102,7 +110,17 @@ from mamba_distributed_tpu.serving.scheduler import (
     GenerationRequest,
     GenerationResult,
     RequestStatus,
+    TenantQuotaExceeded,
     TokenEvent,
+)
+from mamba_distributed_tpu.serving.tuning import (
+    LoraTrainer,
+    TrainerProvisioner,
+    TrainerReplica,
+    TuneError,
+    TuneJob,
+    TuneJobQueue,
+    TuningService,
 )
 from mamba_distributed_tpu.serving.state_cache import (
     PagePool,
@@ -116,6 +134,7 @@ __all__ = [
     "AdapterCache",
     "AdapterCacheError",
     "AdapterRegistry",
+    "AdapterVersionError",
     "UnknownAdapterError",
     "AdmissionController",
     "AdmissionRejected",
@@ -144,7 +163,15 @@ __all__ = [
     "ServingEngine",
     "SessionStore",
     "SessionStoreError",
+    "LoraTrainer",
+    "TenantQuotaExceeded",
     "TokenEvent",
+    "TrainerProvisioner",
+    "TrainerReplica",
+    "TuneError",
+    "TuneJob",
+    "TuneJobQueue",
+    "TuningService",
     "chunked_prefill",
     "evict",
     "init_pool",

@@ -85,6 +85,31 @@ class AdapterCacheError(AdapterError):
     the PagePoolError contract, re-applied to factor slots."""
 
 
+class AdapterVersionError(AdapterError, ValueError):
+    """A version-ordering violation on registration: re-registering an
+    existing ``name@vN`` or registering a version at or below the
+    current latest (a rollback).  Versions are monotone per base name —
+    online tuning deploys ``name@v(N+1)``, never rewrites history.
+    ValueError too, so the service wire marks it retriable."""
+
+
+def split_adapter_version(name: str) -> tuple[str, int | None]:
+    """``"tenant@v3"`` -> ``("tenant", 3)``; a bare name -> ``(name,
+    None)``.  Only a trailing ``@v<digits>`` is version syntax — any
+    other ``@`` is part of the tenant identity."""
+    base, sep, tail = name.rpartition("@v")
+    if sep and base and tail.isdigit():
+        return base, int(tail)
+    return name, None
+
+
+def versioned_name(base: str, version: int) -> str:
+    """Canonical registry key: v1 is the BARE name (the PR-15
+    single-version fast path — byte-identical salts/records/wire when
+    only one version ever exists), v2+ are ``base@vN``."""
+    return base if version == 1 else f"{base}@v{version}"
+
+
 # (path-suffix pattern) of the linear()-routed projection dicts that
 # accept LoRA factors — the same projections _TP_RULES shards, which is
 # what makes the A/B sharding rules compose with tensor parallelism.
@@ -175,11 +200,14 @@ class AdapterRegistry:
         self.max_adapters = cfg.lora_max_adapters
         self.targets = lora_targets(params)
         self._adapters: "OrderedDict[str, dict]" = OrderedDict()
+        # base name -> highest registered version (monotone; rollbacks
+        # raise AdapterVersionError).  v1 is stored under the BARE name.
+        self._versions: dict[str, int] = {}
 
     # ------------------------------------------------------------ lookup
 
     def __contains__(self, name: str) -> bool:
-        return name in self._adapters
+        return self.resolve(name) in self._adapters
 
     def __len__(self) -> int:
         return len(self._adapters)
@@ -187,11 +215,48 @@ class AdapterRegistry:
     def names(self) -> list[str]:
         return list(self._adapters.keys())
 
+    def resolve(self, name: str) -> str:
+        """Canonical registry key for ``name``: a bare name resolves to
+        its LATEST version's key, an explicit ``@v1`` to the bare fast
+        path, any other ``@vN`` to itself.  Pure — never raises; an
+        unresolvable name passes through and misses in :meth:`factors`
+        with the named :class:`UnknownAdapterError`."""
+        base, ver = split_adapter_version(name)
+        if ver is None:
+            cur = self._versions.get(name)
+            return name if cur is None else versioned_name(name, cur)
+        if ver == 1 and base in self._adapters:
+            return base
+        return name
+
+    def latest(self, name: str) -> str:
+        """The newest registered version of ``name``'s base (version
+        syntax on the input is ignored): the deploy target A/B routing
+        steers new traffic toward.  Raises the named
+        :class:`UnknownAdapterError` on an unknown base."""
+        base, _ = split_adapter_version(name)
+        cur = self._versions.get(base)
+        if cur is None:
+            raise UnknownAdapterError(
+                f"unknown adapter base {base!r}: this registry holds "
+                f"{self.names()}"
+            )
+        return versioned_name(base, cur)
+
+    def version_of(self, name: str) -> int:
+        """The version an adapter name denotes: explicit ``@vN`` -> N,
+        bare -> the current latest (1 if only one ever registered)."""
+        base, ver = split_adapter_version(name)
+        if ver is not None:
+            return ver
+        return self._versions.get(base, 1)
+
     def factors(self, name: str) -> dict:
         """The adapter's stored (scaled) factors, keyed by target path.
-        Raises the named :class:`UnknownAdapterError` on a miss."""
+        Bare names resolve to their latest version.  Raises the named
+        :class:`UnknownAdapterError` on a miss."""
         try:
-            return self._adapters[name]
+            return self._adapters[self.resolve(name)]
         except KeyError:
             raise UnknownAdapterError(
                 f"unknown adapter {name!r}: this registry holds "
@@ -202,17 +267,35 @@ class AdapterRegistry:
     # ------------------------------------------------------ registration
 
     def register(self, name: str, factors: dict,
-                 alpha: float | None = None) -> None:
+                 alpha: float | None = None) -> str:
         """Register ``factors`` (target path -> {"A", "B"} of UNscaled
         arrays) under ``name``.  Shapes are validated against the
         target table; ``alpha`` (default ``cfg.lora_alpha``) over
-        ``rank`` is folded into the stored B once.  Idempotent on an
-        exact re-register of the same name is NOT supported — names
-        are identities; re-registering raises."""
-        if name in self._adapters:
-            raise ValueError(f"adapter {name!r} is already registered")
+        ``rank`` is folded into the stored B once.
+
+        Versioning: a BARE name registers the next version of its base
+        (v1 on first sight — stored under the bare key, the PR-15
+        single-version fast path; v(N+1) on re-register).  An explicit
+        ``name@vN`` pins the version: N at or below the current latest
+        raises the named :class:`AdapterVersionError` (history is
+        immutable — no overwrites, no rollbacks); forward jumps are
+        allowed so a late-joining replica can receive ``@v3`` without
+        ever holding v1/v2.  Returns the canonical registered name."""
         if not name:
             raise ValueError("adapter name must be non-empty")
+        base, ver = split_adapter_version(name)
+        cur = self._versions.get(base, 0)
+        if ver is None:
+            ver = cur + 1
+        elif ver <= cur:
+            raise AdapterVersionError(
+                f"adapter {base!r} is at v{cur}; registering "
+                f"{base}@v{ver} would "
+                + ("overwrite it" if ver == cur else "roll it back")
+                + " — versions are monotone (register the bare name "
+                "for the next version)"
+            )
+        key = versioned_name(base, ver)
         if len(self._adapters) >= self.max_adapters:
             raise ValueError(
                 f"registry full: cfg.lora_max_adapters="
@@ -245,11 +328,13 @@ class AdapterRegistry:
             raise ValueError(
                 f"adapter {name!r} covers no targets (empty factors)"
             )
-        self._adapters[name] = stored
+        self._adapters[key] = stored
+        self._versions[base] = ver
+        return key
 
     def register_random(self, name: str, seed: int = 0,
                         scale: float = 0.05,
-                        targets: list[str] | None = None) -> None:
+                        targets: list[str] | None = None) -> str:
         """Register a random adapter (tests/bench): A ~ N(0, scale/r)
         per target, B ~ N(0, scale) — BOTH nonzero so the delta is
         live from the first token (the conventional B=0 init would
@@ -269,7 +354,7 @@ class AdapterRegistry:
                                 (n, d_in, self.rank)),
                 "B": rng.normal(0.0, scale, (n, self.rank, d_out)),
             }
-        self.register(name, fac)
+        return self.register(name, fac)
 
     # ----------------------------------------------------- merged weights
 

@@ -195,8 +195,15 @@ class AutoscaleController:
         for role, st in self._tiers.items():
             reps = self._tier_replicas(role)
             depth = self._mean_depth(reps)
-            pressured = breach or depth >= self.policy.queue_depth_high
-            healthy = not breach and depth <= self.policy.queue_depth_low
+            # the trainer tier sizes on its OWN queue (tune jobs) only:
+            # a serving-latency breach must not buy training capacity
+            # (wrong-direction scaling) nor pin existing lanes up —
+            # serving pressure is handled at tick granularity instead
+            # (TuningService yields the lane; serving/tuning/service.py)
+            tier_breach = breach and role != "trainer"
+            pressured = tier_breach or depth >= self.policy.queue_depth_high
+            healthy = (not tier_breach
+                       and depth <= self.policy.queue_depth_low)
             if pressured:
                 st.pressure_evals += 1
                 st.clear_evals = 0
@@ -205,7 +212,7 @@ class AutoscaleController:
                         and now - st.last_up
                         >= self.policy.scale_up_cooldown_s):
                     self._scale_up(role, st, now,
-                                   reason=("slo_breach" if breach
+                                   reason=("slo_breach" if tier_breach
                                            else "queue_depth"),
                                    depth=depth)
             elif healthy:

@@ -53,6 +53,7 @@ noise stream than a solo ``generate(top_k=k)`` call would use.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 
@@ -89,8 +90,10 @@ from mamba_distributed_tpu.serving.scheduler import (
     GenerationRequest,
     GenerationResult,
     RequestStatus,
+    TenantQuotaExceeded,
     TokenEvent,
     _Tracked,
+    check_tenant_quota,
 )
 from mamba_distributed_tpu.utils.metrics import ServingMetrics
 
@@ -738,6 +741,17 @@ class ServingEngine:
         else:
             self.adapters = None
             self.adapter_cache = None
+        # --- per-tenant fairness quota + online-tuning hot swaps
+        # (docs/SERVING.md "Online adapter tuning"): cfg.tenant_max_slots
+        # caps the concurrent resident slots one tenant (adapter BASE
+        # name — versions share the cap) may hold; an over-quota
+        # admission requeues with the named TenantQuotaExceeded counted,
+        # never shed.  0 (default) is the byte-stable status quo.
+        self.tenant_max_slots = getattr(cfg, "tenant_max_slots", 0)
+        self._quota_stalls = 0  # window counter -> tick records
+        self._hot_swaps = 0  # mid-stream adapter version swaps, ditto
+        if self.tenant_max_slots:
+            self.metrics.configure_tuning()
         # --- durable session fabric (serving/sessions/; docs/SERVING.md
         # "Durable sessions"): an attached SessionStore lets streams
         # PARK — slot, KV pages and adapter ref all released, the
@@ -826,6 +840,15 @@ class ServingEngine:
                     f"unknown adapter {adapter!r}: this engine's "
                     f"registry holds {self.adapters.names()}"
                 )
+            # pin the VERSION at submit: a bare name canonicalizes to
+            # its latest registered version (the identity for a single-
+            # version adapter — bytes unchanged vs PR-15), so a v(N+1)
+            # registered mid-flight never silently retargets an
+            # already-queued stream (prefix salt, cache slot, records
+            # and failover replay all carry the pinned name).  With
+            # cfg.lora_ab_fraction < 1 the pin A/B-routes across the
+            # last two versions (_ab_resolve)
+            request.adapter = self._ab_resolve(request, adapter)
         if self.hybrid:
             need = len(request.prompt_ids) + request.max_new_tokens
             if need > self.cfg.kv_slot_tokens:
@@ -882,6 +905,10 @@ class ServingEngine:
         prior = snapshot.get("new_tokens")
         if prior:
             tracked.new_tokens.extend(int(t) for t in prior)
+        # a hot-swapped stream's artifact carries its step re-base (the
+        # request arriving here is already the continuation, so future
+        # preempt/park stamps keep subtracting it); absent = 0
+        tracked.swap_base = int(snapshot.get("swap_base", 0))
         now = time.perf_counter()
         if snapshot.get("t_submit_age_s") is not None:
             # cross-host-safe: reconstruct the original stamps on THIS
@@ -986,6 +1013,42 @@ class ServingEngine:
         term reads it (serving/replica.place_cost)."""
         return (self.lora and self.adapter_cache.resident(name))
 
+    def _ab_resolve(self, request, adapter: str) -> str:
+        """Submit-time version pin with A/B routing.
+
+        Identity with ``cfg.lora_ab_fraction >= 1`` (default — the
+        plain ``resolve`` pin, bytes unchanged vs PR-15).  Below 1, a
+        BARE name on a tenant with >= 2 registered versions routes
+        only that fraction of new submits to the latest version; the
+        rest pin the PREVIOUS one — the control arm of an online-tune
+        deploy.  The arm choice hashes the request's identity (adapter
+        base, sampling seed, prompt bytes — crc32, not ``hash()``,
+        which is per-process randomized), so a resubmitted request
+        lands on the same arm on every replica.  Explicit ``@vN``
+        names bypass: a pinned version is an explicit routing decision.
+        """
+        frac = getattr(self.cfg, "lora_ab_fraction", 1.0)
+        base, ver = adapters_mod.split_adapter_version(adapter)
+        if frac >= 1.0 or ver is not None:
+            return self.adapters.resolve(adapter)
+        latest = self.adapters.version_of(base)
+        if latest < 2:
+            return self.adapters.resolve(adapter)
+        prev_key = adapters_mod.versioned_name(base, latest - 1)
+        if prev_key not in self.adapters:
+            # forward version jump (e.g. a late-joining replica got
+            # @v3 but never held v2): no control arm to route to
+            return self.adapters.resolve(adapter)
+        import zlib
+
+        h = zlib.crc32(
+            np.asarray(request.prompt_ids, np.int32).tobytes(),
+            zlib.crc32(f"{base}:{request.seed}".encode("utf-8")),
+        )
+        if (h % 10_000) < int(frac * 10_000):
+            return adapters_mod.versioned_name(base, latest)
+        return prev_key
+
     def _adapter_salt(self, request) -> bytes:
         """Prefix-cache key salt for one request's adapter identity —
         carry snapshots depend on the adapter delta that shaped them,
@@ -1087,6 +1150,24 @@ class ServingEngine:
         if tracked.snapshot is not None:
             return self._resume(tracked)
         r = tracked.request
+        # per-tenant fairness quota (cfg.tenant_max_slots): a tenant at
+        # its concurrent-slot cap WAITS in the queue — the page-stall
+        # idiom (requeue + retry next step), named and counted, never
+        # shedding.  Resumes bypass this check (they held a slot
+        # before; blocking a snapshot-holder could strand its state).
+        if self.tenant_max_slots:
+            try:
+                check_tenant_quota(
+                    getattr(r, "adapter", None),
+                    (getattr(t.request, "adapter", None)
+                     for t in self._slots.values()),
+                    self.tenant_max_slots,
+                )
+            except TenantQuotaExceeded:
+                self._quota_stalls += 1
+                self.metrics.record_quota_stall()
+                self.scheduler.requeue(tracked)
+                return False
         # multi-tenant LoRA: reserve the adapter's factor slot FIRST
         # (the page-reservation discipline) — when every cache slot is
         # pinned by other resident streams the request waits in the
@@ -1634,7 +1715,10 @@ class ServingEngine:
             snap = {
                 "blocks": jax.device_get(state["blocks"]),
                 "logits": jax.device_get(self.pool["logits"][slot][None]),
-                "step": len(tracked.new_tokens),
+                # device step counter, relative to the CURRENT request
+                # (a hot-swapped continuation restarted it at 0 —
+                # swap_base re-bases the emitted-token count)
+                "step": len(tracked.new_tokens) - tracked.swap_base,
             }
             if self.hybrid:
                 snap["kv_len"] = int(self._kv_len[slot])
@@ -1748,6 +1832,107 @@ class ServingEngine:
                 self._session_parks += 1
                 self.metrics.record_session_park()
         return tracked.request, snap
+
+    def hot_swap_adapter(self, request_id: int,
+                         adapter: str | None = None) -> str:
+        """Switch a live DECODING stream to another adapter version
+        mid-flight — the PR-15 residual online tuning needs: when a
+        tenant's ``name@v(N+1)`` deploys, an opted-in stream moves to
+        it WITHOUT losing a token.  ``adapter`` pins the target
+        (default: the latest version of the stream's current base).
+
+        The recurrent carry was shaped by the OLD factors, so it is
+        invalidated — exactly once — by evicting the slot and releasing
+        its KV pages + adapter ref; the stream is then requeued as a
+        CONTINUATION request whose prompt is the original prompt plus
+        every token already emitted, decoding under the new version.
+        ``tracked.new_tokens`` (and thus TokenEvent indices, SSE
+        replay, and the finish record's token count) continue across
+        the swap; ``tracked.orig_request`` preserves what the USER
+        submitted for the finish record, and ``tracked.swap_base``
+        re-bases the device step counter the continuation restarts
+        (preempt/park/migration stamps subtract it).
+
+        Returns the adapter name now in effect (a no-op when already
+        there).  Raises retriable ``ValueError`` for streams not in a
+        swappable state — queued/prefilling streams have no carry to
+        invalidate yet, and in-flight speculative drafts drain on the
+        next verify tick first (the ``park`` preconditions)."""
+        if not self.lora:
+            raise ValueError(
+                "hot_swap_adapter needs multi-tenant LoRA serving "
+                "(cfg.lora_max_adapters > 0)"
+            )
+        tracked = next((t for t in self._slots.values()
+                        if t.request_id == request_id), None)
+        if tracked is None or tracked.status is not RequestStatus.DECODE:
+            raise ValueError(
+                f"request {request_id} is not swappable: only a "
+                f"resident DECODING stream holds the carry a swap "
+                f"invalidates (queued/prefilling streams finish "
+                f"prefill first; retry shortly)"
+            )
+        if self.spec and tracked.spec_pending:
+            raise ValueError(
+                f"request {request_id} has {len(tracked.spec_pending)} "
+                f"speculative draft token(s) in flight; retry after "
+                f"the next verify tick drains them"
+            )
+        r = tracked.request
+        old = getattr(r, "adapter", None)
+        if not old:
+            raise ValueError(
+                f"request {request_id} decodes the base model — there "
+                f"is no adapter to swap"
+            )
+        new = self.adapters.resolve(
+            adapter if adapter is not None else self.adapters.latest(old)
+        )
+        self.adapters.factors(new)  # UnknownAdapterError before any state change
+        if new == old:
+            return old
+        slot = tracked.slot
+        emitted = len(tracked.new_tokens)
+        with self.tracer.span("serving_hot_swap", slot=slot,
+                              request=tracked.request_id,
+                              trace=tracked.trace_id,
+                              adapter=new):
+            # THE carry invalidation, exactly once: the old-factor
+            # state, its KV pages and the old version's factor ref all
+            # go — the release keys off tracked.request.adapter, so it
+            # runs BEFORE the request mutates to the new version
+            self.pool = state_cache.evict(self.pool, slot)
+            self._release_pages(slot, tracked)
+            self._release_adapter_ref(tracked)
+            del self._slots[slot]
+            self._free.append(slot)
+            self._free.sort()
+            if self.spec:
+                # the drafter's observed history pairs with the old
+                # stream; the continuation reseeds from its re-prefill
+                self.drafter.forget(tracked.request_id)
+            if tracked.orig_request is None:
+                tracked.orig_request = r
+            tracked.request = dataclasses.replace(
+                r,
+                prompt_ids=np.concatenate([
+                    np.asarray(r.prompt_ids, np.int32),
+                    np.asarray(tracked.new_tokens[tracked.swap_base:],
+                               np.int32),
+                ]),
+                max_new_tokens=(r.max_new_tokens
+                                - (emitted - tracked.swap_base)),
+                adapter=new,
+            )
+            tracked.swap_base = emitted
+            tracked.hot_swaps += 1
+            self._hot_swaps += 1
+            self.metrics.record_hot_swap()
+            # requeue re-admits through the normal path: the
+            # continuation re-prefills (prefix-warm under the NEW
+            # version's salt where possible) and decodes on
+            self.scheduler.requeue(tracked)
+        return new
 
     def _resume(self, tracked: _Tracked) -> bool:
         """Re-admit a request from a host snapshot with ``step``
@@ -1933,7 +2118,14 @@ class ServingEngine:
             "migrated": True,
             "blocks": jax.device_get(state["blocks"]),
             "logits": jax.device_get(self.pool["logits"][slot][None]),
-            "step": len(tracked.new_tokens),
+            # relative to the CURRENT request: a hot-swapped stream's
+            # continuation restarted the device counter at 0, and the
+            # receiver restores against the continuation's budget
+            "step": len(tracked.new_tokens) - tracked.swap_base,
+            # only swapped streams stamp the re-base (artifacts from
+            # never-swapped streams stay byte-identical to PR-19's)
+            **({"swap_base": tracked.swap_base}
+               if tracked.swap_base else {}),
             "t_submit": tracked.t_submit,
             "t_admit": tracked.t_admit,
             # clock-transportable journey stamps: raw perf_counter
@@ -2661,7 +2853,11 @@ class ServingEngine:
             self._free.append(slot)
             if self.spec:
                 self.drafter.forget(tracked.request_id)
-            r = tracked.request
+            # a hot-swapped stream finishes as the internal continuation
+            # request — the record and result must echo what the USER
+            # submitted (original prompt; the full generated suffix
+            # already lives in tracked.new_tokens)
+            r = tracked.orig_request or tracked.request
             request_record = {
                 "request_id": tracked.request_id,
                 "trace_id": tracked.trace_id,
@@ -2693,8 +2889,12 @@ class ServingEngine:
                     tracked.migration_source
             if tracked.priority != self.scheduler.default_priority:
                 request_record["priority"] = tracked.priority
-            if self.lora and getattr(r, "adapter", None):
-                request_record["adapter"] = r.adapter
+            if self.lora and getattr(tracked.request, "adapter", None):
+                # the adapter the stream FINISHED under (the swapped-to
+                # version for hot-swapped streams)
+                request_record["adapter"] = tracked.request.adapter
+            if tracked.hot_swaps:
+                request_record["hot_swaps"] = tracked.hot_swaps
             self.metrics.record_request(request_record)
             if self.slo is not None:
                 self.slo.observe_request(request_record,
@@ -2842,6 +3042,10 @@ class ServingEngine:
             preemptions=self._preemptions,
             migrations_out=self._migrations_out,
             migrations_in=self._migrations_in,
+            # stamped only when nonzero (utils/metrics.record_tick) —
+            # quota-off / swap-free engines' records stay byte-stable
+            tenant_quota_stalls=self._quota_stalls,
+            adapter_hot_swaps=self._hot_swaps,
             **pc_gauges,
             **kv_gauges,
             **quant_gauges,
@@ -2853,6 +3057,8 @@ class ServingEngine:
         self._preemptions = 0
         self._migrations_out = 0
         self._migrations_in = 0
+        self._quota_stalls = 0
+        self._hot_swaps = 0
         self._session_parks = 0
         self._session_resumes = 0
         self._session_expires = 0

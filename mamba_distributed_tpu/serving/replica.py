@@ -51,8 +51,12 @@ ADAPTER_AFFINITY = 0.5
 # "prefill" replicas take the long prompts, run the chunked prefill
 # and MIGRATE the finished carry out (the router installs
 # engine.migrate_hook); "decode" replicas take short prompts and
-# migrated-in artifacts, never a long prompt's prefill
-REPLICA_ROLES = ("mixed", "prefill", "decode")
+# migrated-in artifacts, never a long prompt's prefill.  "trainer" is
+# the online-tuning lane (serving/tuning.TrainerReplica — NOT an
+# engine replica): it takes tune jobs, never generation traffic
+# (router placement excludes the role), and the autoscaler sizes its
+# tier on tune-queue depth alone
+REPLICA_ROLES = ("mixed", "prefill", "decode", "trainer")
 
 
 class EngineReplica:
@@ -80,6 +84,11 @@ class EngineReplica:
         if role not in REPLICA_ROLES:
             raise ValueError(
                 f"role must be one of {REPLICA_ROLES}, got {role!r}"
+            )
+        if role == "trainer":
+            raise ValueError(
+                "role 'trainer' is serving/tuning.TrainerReplica's — "
+                "an engine replica serves; it cannot take tune jobs"
             )
         self.role = role
         self.replica_id = replica_id

@@ -312,8 +312,14 @@ class RequestRouter:
         missing registration must neither 404 a servable request nor
         abort a ``fail()`` replay mid-loop (the half-failed-over
         state that method's contract forbids).  Only when EVERY
-        candidate rejects does the adapter error surface."""
-        cands = [r for r in self.replicas if r.accepting]
+        candidate rejects does the adapter error surface.
+
+        Trainer-role replicas (serving/tuning — online LoRA lanes) are
+        never candidates: they hold no slot pool, and unlike the
+        disagg tiers there is no graceful-degradation fallback INTO
+        them — a generation request lands on serving roles or fails."""
+        cands = [r for r in self.replicas
+                 if r.accepting and r.role != "trainer"]
         if not cands:
             raise RuntimeError(
                 "no accepting replicas (all draining or dead); request "
@@ -629,8 +635,11 @@ class RequestRouter:
         normal cost WITH the request (a parked adapter-bound stream
         converges back on workers holding its factors), restore via
         the replica's parked-resume entry point (``resume_parked`` over
-        the wire, ``submit_migrated`` in process — same path)."""
-        cands = [r for r in self.replicas if r.accepting]
+        the wire, ``submit_migrated`` in process — same path).  Trainer
+        lanes are excluded exactly as in ``_place`` — a park artifact
+        is generation state."""
+        cands = [r for r in self.replicas
+                 if r.accepting and r.role != "trainer"]
         if not cands:
             raise RuntimeError(
                 f"no accepting replicas (all draining or dead); session "

@@ -27,6 +27,38 @@ import jax
 import numpy as np
 
 from mamba_distributed_tpu.obs.context import mint_trace_id
+from mamba_distributed_tpu.serving.adapters import split_adapter_version
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """Admitting this request would give one tenant (adapter BASE name
+    — versions share the quota) more concurrent resident slots than
+    ``cfg.tenant_max_slots`` allows.  The engine treats it exactly like
+    a KV-page stall: requeue and retry next step — fairness is
+    BACKPRESSURE, never shedding (the request stays queued until a
+    sibling stream finishes).  ``tenant_max_slots=0`` (default)
+    disables the check entirely."""
+
+
+def check_tenant_quota(adapter: str | None, resident_adapters,
+                       max_slots: int) -> None:
+    """Raise the named :class:`TenantQuotaExceeded` when ``adapter``
+    already holds ``max_slots`` resident slots.  ``resident_adapters``
+    is the engine's view of adapter names currently occupying slots
+    (None entries = base-model streams, never counted); versioned names
+    (``tenant@v2``) count against their base — a tenant cannot dodge
+    its quota by shipping a new version."""
+    if max_slots <= 0 or not adapter:
+        return
+    base, _ = split_adapter_version(adapter)
+    held = sum(1 for a in resident_adapters
+               if a and split_adapter_version(a)[0] == base)
+    if held >= max_slots:
+        raise TenantQuotaExceeded(
+            f"tenant {base!r} holds {held}/{max_slots} resident slots "
+            f"(cfg.tenant_max_slots) — request stays queued until one "
+            f"frees"
+        )
 
 
 class RequestStatus(enum.Enum):
@@ -201,6 +233,19 @@ class _Tracked:
     # released when the request migrates out (the target re-pins from
     # its own engine-local cache).
     adapter_slot: int | None = None
+    # --- mid-stream adapter hot swap (serving/engine.hot_swap_adapter,
+    # the PR-15 residual online tuning needed): the request object as
+    # the USER submitted it (None until the first swap — finish records
+    # and GenerationResult must echo the original prompt/adapter, not
+    # the internal continuation request the swap fabricates), the count
+    # of tokens already emitted at the LAST swap (``new_tokens`` keeps
+    # growing across a swap, but the re-admitted continuation's device
+    # step counter restarts at 0 — preempt/park/migration step stamps
+    # subtract this base), and how many swaps the stream took (record
+    # stamp, absent when zero).
+    orig_request: GenerationRequest | None = None
+    swap_base: int = 0
+    hot_swaps: int = 0
 
 
 class FCFSScheduler:

@@ -352,6 +352,27 @@ class RemoteReplica:
             "alpha": alpha,
         }, expect="load_adapter_ack", fatal=False)
 
+    def submit_tune(self, adapter: str, examples, steps: int | None = None
+                    ) -> dict:
+        """Ship one tenant's fine-tune job to this TRAINER-role worker
+        (wire v6; serving/tuning/).  FATAL on wire failure, like
+        ``submit``: an unacked tune job is in an unknown state, so the
+        lane fails over rather than risking a silent double-train.
+        Returns the job's status dict (``job_id`` included)."""
+        payload = self._rpc("submit_tune", {
+            "adapter": adapter,
+            "examples": [[int(t) for t in ex] for ex in examples],
+            "steps": steps,
+        }, expect="tune_ack")
+        return payload["status"]
+
+    def tune_status(self, job_id: str) -> dict:
+        """One tune job's lifecycle snapshot (wire v6).  NON-fatal,
+        like ping: a status poll must not condemn a healthy lane."""
+        payload = self._rpc("tune_status", {"job_id": job_id},
+                            expect="tune_status_result", fatal=False)
+        return payload["status"]
+
     def submit(self, request, force: bool = False) -> int:
         if not self.accepting and not force:
             raise RuntimeError(

@@ -39,6 +39,18 @@ pin, which is why remote streams can be token-identical to solo
                          {"session": "<id>"} — the resume handle.
                          Not-yet-decoding streams 409 (retriable),
                          unknown ids 404, no store configured 503
+  POST /v1/tune          {"adapter": "tenant", "examples": [[ids...],
+                         ...], "steps": 20} -> 202 with the job status
+                         dict: one ONLINE LoRA fine-tune job on the
+                         fabric's tuning plane (serving/tuning/, docs/
+                         SERVING.md "Online adapter tuning") — trained
+                         factors hot-register as the tenant's next
+                         version and new requests A/B-route to it, no
+                         offline pipeline.  Validation failures 400
+                         with the named TuneError; no tuning plane 503
+  GET  /v1/tune/<id>     one job's lifecycle snapshot (state queued/
+                         running/completed/failed, step, loss,
+                         deployed key); unknown/aged-out ids 404
   GET  /healthz          fabric + per-replica health (heartbeat ages,
                          missed beats, lifecycle states); 503 with
                          ``"ready": false`` when ZERO replicas accept
@@ -98,7 +110,7 @@ class FabricController(threading.Thread):
                  session_sweep_s: float = 5.0, emit=None,
                  obs_pull_s: float = 0.0, obs_sink=None,
                  obs_limit: int = 4096, obs_keep: int = 65536,
-                 autoscale=None):
+                 autoscale=None, tuning=None):
         super().__init__(daemon=True, name="fabric-controller")
         self.router = router
         self.health = health
@@ -109,6 +121,22 @@ class FabricController(threading.Thread):
         # live-attach and scale-downs drain with no lock anywhere.
         # None = fixed fleet, the byte-stable status quo.
         self.autoscale = autoscale
+        # online adapter tuning (serving/tuning/): an optional LOCAL
+        # TuningService, ticked once per loop iteration on this thread
+        # (like autoscale) so train steps interleave with fabric steps
+        # and the SLO yield reads fresh p95s.  When the service has no
+        # deploy callback the controller wires _deploy_tuned: freshly
+        # trained factors land in this front end's adapter store and
+        # fan out fabric-wide via ensure_adapter.  Remote trainer-role
+        # lanes are stepped by _tick_tuning instead — router.step only
+        # runs when GENERATION work is pending, and tune jobs never
+        # count there.  None + no trainer replicas = byte-stable.
+        self.tuning = tuning
+        if tuning is not None and tuning.deploy is None:
+            tuning.deploy = self._deploy_tuned
+        # job_id -> replica_id for jobs shipped to remote trainer
+        # lanes, so GET /v1/tune/<id> polls the lane holding the job
+        self._tune_routes: dict[str, int] = {}
         # durable sessions: the background TTL sweeper's cadence over
         # the router's session store (when one is attached) and the
         # jsonl emitter its ``sessions_gc`` records land on (the same
@@ -268,6 +296,116 @@ class FabricController(threading.Thread):
                         ok = ok or name in reg
         return ok
 
+    # ------------------------------------------------- online tuning
+
+    def _deploy_tuned(self, key: str) -> None:
+        """TuningService deploy callback (controller thread — ticks
+        run inside the loop): stash the freshly trained version's
+        factors in this front end's store, then fan the canonical key
+        fabric-wide through the same ``ensure_adapter`` push every
+        request-time miss uses.  The registry stores EFFECTIVE factors
+        (``alpha / rank`` already folded into B), so the store entry
+        carries ``alpha=rank`` — scale 1.0 on every downstream
+        re-registration, factors bit-exact on every worker."""
+        reg = self.tuning.trainer.registry
+        self.adapters[key] = {
+            "factors": reg.factors(key), "alpha": float(reg.rank),
+        }
+        self.ensure_adapter(key)
+
+    def submit_tune(self, adapter: str, examples,
+                    steps: int | None = None
+                    ) -> concurrent.futures.Future:
+        """Enqueue one online fine-tune job (the POST /v1/tune body);
+        Future of its status dict.  A local TuningService takes it
+        directly; with none, the job ships to the first accepting
+        trainer-role RemoteReplica (the wire-v6 ``submit_tune`` RPC)
+        and the job id pins to that lane for status polls.  No tuning
+        plane at all raises RuntimeError — the HTTP layer's 503."""
+
+        def _do():
+            if self.tuning is not None:
+                job = self.tuning.submit(adapter, examples, steps)
+                return job.status()
+            for rep in self.router.replicas:
+                if (getattr(rep, "role", None) == "trainer"
+                        and rep.accepting
+                        and hasattr(rep, "submit_tune")):
+                    st = rep.submit_tune(adapter, examples, steps)
+                    self._tune_routes[st["job_id"]] = rep.replica_id
+                    return st
+            raise RuntimeError(
+                "no tuning plane: this fabric has neither a local "
+                "TuningService nor an accepting trainer-role replica"
+            )
+
+        return self.call(_do)
+
+    def tune_status(self, job_id: str) -> concurrent.futures.Future:
+        """One tune job's lifecycle snapshot; Future of the status
+        dict.  Unknown/aged-out ids raise the named TuneError (the
+        HTTP layer's 404)."""
+
+        def _do():
+            if self.tuning is not None:
+                return self.tuning.status(job_id)
+            rid = self._tune_routes.get(job_id)
+            if rid is not None and rid < len(self.router.replicas):
+                rep = self.router.replicas[rid]
+                if rep.alive:
+                    return rep.tune_status(job_id)
+                raise RuntimeError(
+                    f"trainer lane {rid} holding tune job {job_id!r} "
+                    f"is dead — resubmit the job"
+                )
+            from mamba_distributed_tpu.serving.tuning import TuneError
+
+            raise TuneError(f"unknown tune job {job_id!r}")
+
+        return self.call(_do)
+
+    def _tick_tuning(self) -> None:
+        """One tuning pass per fabric iteration: step every accepting
+        trainer-role replica with queued work (router.step never
+        reaches them — ``router.pending`` counts generation requests
+        only), then tick a lane-less local service directly so the
+        queue keeps moving when no TrainerReplica is attached or the
+        lane died mid-job (the docs/SERVING.md failure matrix: jobs
+        and trainer state are fabric-owned, the service survives its
+        lanes)."""
+        lanes = [r for r in self.router.replicas
+                 if getattr(r, "role", None) == "trainer"
+                 and r.alive and r.accepting]
+        for rep in lanes:
+            if not rep.pending:
+                continue
+            try:
+                rep.step()
+            except Exception as e:  # noqa: BLE001 — one lane's fault
+                # must not kill serving (a wire fault already marked
+                # the lane dead; the heartbeat monitor reaps it)
+                if self.emit is not None:
+                    self.emit({
+                        "kind": "serving_health", "t": time.time(),
+                        "event": "tuning_error",
+                        "replica": rep.replica_id,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+        if self.tuning is not None and not any(
+                getattr(r, "service", None) is self.tuning
+                for r in lanes):
+            try:
+                self.tuning.tick()
+            except Exception as e:  # noqa: BLE001 — per-job failures
+                # fail the JOB inside tick(); anything escaping is a
+                # plane-level fault that must not kill serving
+                if self.emit is not None:
+                    self.emit({
+                        "kind": "serving_health", "t": time.time(),
+                        "event": "tuning_error",
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+
     # ------------------------------------------------------------ the loop
 
     def run(self) -> None:
@@ -292,6 +430,7 @@ class FabricController(threading.Thread):
                             "event": "autoscale_error",
                             "error": f"{type(e).__name__}: {e}",
                         })
+            self._tick_tuning()
             if self.health is not None:
                 try:
                     self.health.tick()
@@ -634,6 +773,10 @@ class FabricHTTPServer:
             await self._resume(body, writer)
         elif method == "POST" and path == "/v1/park":
             await self._park(body, writer)
+        elif method == "POST" and path == "/v1/tune":
+            await self._tune(body, writer)
+        elif method == "GET" and path.startswith("/v1/tune/"):
+            await self._tune_status(path[len("/v1/tune/"):], writer)
         elif method == "GET" and path == "/healthz":
             snap = await asyncio.wrap_future(ctrl.call(self._health_payload))
             # a load balancer's readiness probe reads the status line
@@ -781,6 +924,8 @@ class FabricHTTPServer:
                 "scale_ups": ctrl.autoscale.scale_ups,
                 "scale_downs": ctrl.autoscale.scale_downs,
             }),
+            tune_queue_depth=(
+                None if ctrl.tuning is None else ctrl.tuning.depth),
         )
 
     async def _generate(self, body: bytes,
@@ -899,6 +1044,75 @@ class FabricHTTPServer:
             return
         writer.write(_json_response(
             "200 OK", {"request_id": gid, "session": sid}))
+
+    async def _tune(self, body: bytes,
+                    writer: asyncio.StreamWriter) -> None:
+        """POST /v1/tune — enqueue one online LoRA fine-tune job
+        (docs/SERVING.md "Online adapter tuning").  202 with the job's
+        status dict (poll GET /v1/tune/<job_id>); malformed bodies and
+        TuneError validations 400; no tuning plane 503."""
+        from mamba_distributed_tpu.serving.tuning import TuneError
+
+        try:
+            spec = json.loads(body.decode("utf-8"))
+            adapter = str(spec["adapter"])
+            examples = spec["examples"]
+            steps = spec.get("steps")
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            writer.write(_json_response(
+                "400 Bad Request", {"error": f"bad tune body: {e}"}))
+            return
+        try:
+            status = await asyncio.wrap_future(
+                self.controller.submit_tune(
+                    adapter, examples,
+                    None if steps is None else int(steps))
+            )
+        except TuneError as e:
+            writer.write(_json_response(
+                "400 Bad Request",
+                {"error": str(e), "error_type": "TuneError"}))
+            return
+        except (ValueError, RuntimeError, wire.WireError) as e:
+            # a remote lane's rejection arrives as a wrapped
+            # RuntimeError carrying the worker-side error_type — map
+            # its TuneError back to the same 400 the local path gives
+            if "TuneError" in str(e):
+                writer.write(_json_response(
+                    "400 Bad Request",
+                    {"error": str(e), "error_type": "TuneError"}))
+                return
+            writer.write(_json_response(
+                "503 Service Unavailable", {"error": str(e)}))
+            return
+        writer.write(_json_response("202 Accepted", status))
+
+    async def _tune_status(self, job_id: str,
+                           writer: asyncio.StreamWriter) -> None:
+        """GET /v1/tune/<job_id> — one job's lifecycle snapshot.
+        Unknown/aged-out ids 404 with the named TuneError; a dead or
+        wire-faulted trainer lane 503."""
+        from mamba_distributed_tpu.serving.tuning import TuneError
+
+        try:
+            status = await asyncio.wrap_future(
+                self.controller.tune_status(job_id))
+        except TuneError as e:
+            writer.write(_json_response(
+                "404 Not Found",
+                {"error": str(e), "error_type": "TuneError"}))
+            return
+        except (ValueError, RuntimeError, wire.WireError) as e:
+            if "TuneError" in str(e):  # remote lane's unknown-id path
+                writer.write(_json_response(
+                    "404 Not Found",
+                    {"error": str(e), "error_type": "TuneError"}))
+                return
+            writer.write(_json_response(
+                "503 Service Unavailable", {"error": str(e)}))
+            return
+        writer.write(_json_response("200 OK", status))
 
     async def _resume(self, body: bytes,
                       writer: asyncio.StreamWriter) -> None:

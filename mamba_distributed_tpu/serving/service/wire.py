@@ -66,8 +66,17 @@ import numpy as np
 # ship its telemetry, so a mixed-version fabric would silently present
 # a PARTIAL observability picture — exactly the failure a telemetry
 # plane exists to prevent — and the skew fails loudly through
-# UnknownWireVersionError instead.
-WIRE_VERSION = 5
+# UnknownWireVersionError instead.  v6: online per-tenant LoRA tuning
+# (serving/tuning/) — the worker RPC surface grew ``submit_tune``
+# (ship a tenant's token-id examples to a trainer-role worker; the
+# trainer fine-tunes {A, B} against the frozen base and hot-registers
+# the next adapter version) and ``tune_status`` (poll one job's
+# lifecycle for the ``/v1/tune/<id>`` surface), and ``hello`` may
+# advertise the new ``trainer`` role; a v5 peer would accept the
+# tenant's examples and then never train — a silently dropped fine-
+# tune, the worst kind of "success" — so tune RPCs against an older
+# worker fail loudly through UnknownWireVersionError.
+WIRE_VERSION = 6
 
 # one frame's hard ceiling (a hybrid migration artifact is page-count
 # sized — MBs, not GBs; anything bigger is a corrupt length prefix)

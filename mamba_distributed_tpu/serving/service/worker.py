@@ -31,6 +31,12 @@ parity against:
                       stream with zero remote file access; a cursor
                       from a previous worker boot is detected via
                       boot_id and restarted at 0)
+  submit_tune      -> tune_ack {job_id, status} | error  (wire v6: a
+                      tenant's fine-tune job for a TRAINER-role worker
+                      — token-id examples in, online LoRA training on
+                      the frozen base; serving/tuning/)
+  tune_status      -> tune_status_result {status} | error  (wire v6:
+                      poll one tune job's lifecycle for /v1/tune/<id>)
   shutdown         -> bye (process exits)
 
 ``step`` is the one RPC with sub-messages: while the engine steps, a
@@ -70,7 +76,7 @@ from mamba_distributed_tpu.serving.service import wire
 # named error back to the peer, never a hang)
 _HANDLED = ("hello", "submit", "submit_migrated", "park", "resume_parked",
             "step", "ping", "drain", "replay", "load_adapter", "summary",
-            "obs_pull", "shutdown")
+            "obs_pull", "submit_tune", "tune_status", "shutdown")
 
 
 # ------------------------------------------------------------- config I/O
@@ -119,8 +125,13 @@ class WorkerServer:
     """
 
     def __init__(self, replica, host: str = "127.0.0.1", port: int = 0,
-                 *, poll_s: float = 0.05):
+                 *, poll_s: float = 0.05, tuning=None):
         self.replica = replica
+        # online-tuning plane (wire v6): a trainer-role worker serves
+        # submit_tune/tune_status out of its TuningService — passed
+        # explicitly or found on the replica (TrainerReplica.service)
+        self.tuning = (tuning if tuning is not None
+                       else getattr(replica, "service", None))
         self.poll_s = poll_s
         self._term = False
         self._shutdown = False
@@ -454,6 +465,53 @@ class WorkerServer:
                 "cursor": page["cursor"],
                 "dropped": page["dropped"],
                 "boot_id": self.boot_id,
+            })
+        elif mtype == "submit_tune":
+            # wire v6: one tenant's fine-tune job lands on this
+            # TRAINER-role worker (serving/tuning/) — token-id examples
+            # ride as plain JSON lists.  Validation fails loudly at
+            # this boundary (TuneError — not retriable: the payload
+            # itself is wrong), and a worker without a tuning service
+            # refuses rather than silently dropping the fine-tune.
+            try:
+                if self.tuning is None:
+                    raise ValueError(
+                        f"this worker has no tuning service (role "
+                        f"{rep.role!r}); submit tune jobs to a "
+                        f"trainer-role worker"
+                    )
+                job = self.tuning.submit(
+                    payload["adapter"], payload["examples"],
+                    payload.get("steps"),
+                )
+            except Exception as e:  # noqa: BLE001 — serialized back
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "tune_ack", {
+                "job_id": job.job_id, "status": job.status(),
+                "stats": self._stats(),
+            })
+        elif mtype == "tune_status":
+            # wire v6: one job's lifecycle snapshot (the /v1/tune/<id>
+            # poll surface).  Unknown ids are a named TuneError.
+            try:
+                if self.tuning is None:
+                    raise ValueError(
+                        f"this worker has no tuning service (role "
+                        f"{rep.role!r})"
+                    )
+                status = self.tuning.status(payload["job_id"])
+            except Exception as e:  # noqa: BLE001 — serialized back
+                wire.send_msg(conn, "error", {
+                    "error": str(e), "error_type": type(e).__name__,
+                    "retriable": isinstance(e, ValueError),
+                })
+                return
+            wire.send_msg(conn, "tune_status_result", {
+                "status": status, "stats": self._stats(),
             })
         elif mtype == "shutdown":
             wire.send_msg(conn, "bye", {})

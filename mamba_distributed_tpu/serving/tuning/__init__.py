@@ -1,0 +1,46 @@
+"""Online per-tenant LoRA tuning on the serving fabric.
+
+No offline pipeline: tenants POST token-id examples to ``/v1/tune``,
+a trainer-role replica fine-tunes their {A, B} factors against the
+frozen base with the training stack's own jitted step, and the
+converged factors hot-register as ``name@v(N+1)`` fabric-wide — new
+requests A/B-route across the last two versions
+(cfg.lora_ab_fraction) while in-flight streams keep their pinned
+version (or hot-swap mid-stream, serving/engine.hot_swap_adapter).
+
+Layout:
+  jobs.py     TuneJob / TuneJobQueue / TuneError — intake + lifecycle
+  trainer.py  LoraTrainer — masked train step over attached pools
+  service.py  TuningService (SLO-yielding tick loop), TrainerReplica
+              (the router/autoscale face), TrainerProvisioner
+"""
+
+from mamba_distributed_tpu.serving.tuning.jobs import (
+    TuneError,
+    TuneJob,
+    TuneJobQueue,
+)
+from mamba_distributed_tpu.serving.tuning.service import (
+    TrainerProvisioner,
+    TrainerReplica,
+    TuningService,
+)
+from mamba_distributed_tpu.serving.tuning.trainer import (
+    LoraTrainer,
+    lora_freeze_tree,
+    lora_optimizer,
+    pack_examples,
+)
+
+__all__ = [
+    "LoraTrainer",
+    "TrainerProvisioner",
+    "TrainerReplica",
+    "TuneError",
+    "TuneJob",
+    "TuneJobQueue",
+    "TuningService",
+    "lora_freeze_tree",
+    "lora_optimizer",
+    "pack_examples",
+]

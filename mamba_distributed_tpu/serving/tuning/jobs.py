@@ -1,0 +1,170 @@
+"""Tune-job bookkeeping for online per-tenant LoRA training.
+
+A :class:`TuneJob` is one tenant's request to fine-tune its adapter on
+the serving fabric: a batch of token-id example sequences, a step
+budget, and the lifecycle state the ``/v1/tune`` status surface
+reports.  Jobs target a BARE adapter name — versions are minted by the
+fabric at deploy time (``AdapterRegistry.register`` assigns
+``v(N+1)``), never by the tenant, so a job can neither overwrite nor
+roll back history.
+
+:class:`TuneJobQueue` is the FIFO the trainer tier drains
+(serving/tuning/service.py): submission validates the payload up
+front — a malformed job must fail at the HTTP/RPC boundary with a
+named error, not steps later inside a jitted train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict, deque
+
+from mamba_distributed_tpu.serving.adapters import split_adapter_version
+
+
+class TuneError(RuntimeError):
+    """Named failure for the online-tuning plane: malformed job
+    payloads, unknown job ids, generation traffic submitted to a
+    trainer replica.  RuntimeError (not ValueError) on purpose — the
+    wire layer's ``retriable`` flag keys on ValueError, and none of
+    these are retriable as-is."""
+
+
+# job lifecycle: queued -> running -> completed | failed; the queue
+# only ever moves a job forward (status polls see a monotone state)
+JOB_STATES = ("queued", "running", "completed", "failed")
+
+
+@dataclasses.dataclass
+class TuneJob:
+    """One tenant's fine-tune request and its live state."""
+
+    job_id: str
+    adapter: str  # BARE base name; the deploy mints adapter@v(N+1)
+    examples: list  # list of token-id sequences (list[list[int]])
+    steps: int  # train-step budget (cfg.tune_steps default)
+    state: str = "queued"
+    step: int = 0  # train steps completed so far
+    losses: list = dataclasses.field(default_factory=list)
+    deployed: str | None = None  # canonical registered key once live
+    error: str | None = None
+
+    def status(self) -> dict:
+        """The ``/v1/tune/<id>`` status payload (wire-encodable: plain
+        ints/floats/strings only)."""
+        out = {
+            "job_id": self.job_id,
+            "adapter": self.adapter,
+            "state": self.state,
+            "step": self.step,
+            "steps": self.steps,
+            "examples": len(self.examples),
+        }
+        if self.losses:
+            out["loss"] = self.losses[-1]
+        if self.deployed is not None:
+            out["deployed"] = self.deployed
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+class TuneJobQueue:
+    """FIFO of :class:`TuneJob` with full-history status lookup.
+
+    Completed/failed jobs stay in the table (bounded by ``keep`` — a
+    long-lived fabric's status surface must not grow without bound),
+    only queued jobs occupy the FIFO.
+    """
+
+    def __init__(self, keep: int = 256):
+        self._jobs: "OrderedDict[str, TuneJob]" = OrderedDict()
+        self._fifo: deque[TuneJob] = deque()
+        self._minted = 0
+        self.keep = keep
+
+    # ----------------------------------------------------------- submit
+
+    def submit(self, adapter: str, examples, steps: int) -> TuneJob:
+        """Validate and enqueue one job; returns it (the caller reads
+        ``job_id`` off for the status surface)."""
+        if not adapter or not isinstance(adapter, str):
+            raise TuneError("tune job needs a non-empty adapter name")
+        base, ver = split_adapter_version(adapter)
+        if ver is not None:
+            raise TuneError(
+                f"tune jobs target a BARE adapter name; got "
+                f"{adapter!r} — versions are minted by the fabric at "
+                f"deploy time ({base}@v{ver + 1} next), never pinned "
+                f"by the tenant"
+            )
+        if not examples:
+            raise TuneError("tune job needs at least one example")
+        cleaned = []
+        for i, ex in enumerate(examples):
+            try:
+                toks = [int(t) for t in ex]
+            except (TypeError, ValueError):
+                raise TuneError(
+                    f"tune example {i} is not a token-id sequence"
+                ) from None
+            if len(toks) < 2:
+                raise TuneError(
+                    f"tune example {i} needs >= 2 tokens (next-token "
+                    f"loss has nothing to predict from {len(toks)})"
+                )
+            cleaned.append(toks)
+        if steps < 1:
+            raise TuneError(f"tune steps must be >= 1, got {steps}")
+        self._minted += 1
+        job = TuneJob(job_id=f"tune-{self._minted}", adapter=adapter,
+                      examples=cleaned, steps=int(steps))
+        self._jobs[job.job_id] = job
+        self._fifo.append(job)
+        self._prune()
+        return job
+
+    def _prune(self) -> None:
+        # only terminal jobs are evictable; queued/running ones are the
+        # fabric's live obligations
+        while len(self._jobs) > self.keep:
+            for jid, job in self._jobs.items():
+                if job.state in ("completed", "failed"):
+                    del self._jobs[jid]
+                    break
+            else:
+                return
+
+    # ----------------------------------------------------------- lookup
+
+    def get(self, job_id: str) -> TuneJob:
+        try:
+            return self._jobs[job_id]
+        except KeyError:
+            raise TuneError(
+                f"unknown tune job {job_id!r} (completed jobs age out "
+                f"after {self.keep} entries)"
+            ) from None
+
+    def status(self, job_id: str) -> dict:
+        return self.get(job_id).status()
+
+    def next_queued(self) -> TuneJob | None:
+        """Pop the oldest queued job (None when the FIFO is dry)."""
+        while self._fifo:
+            job = self._fifo.popleft()
+            if job.state == "queued":
+                return job
+        return None
+
+    @property
+    def depth(self) -> int:
+        """Queued-but-unstarted jobs — the trainer tier's autoscale
+        pressure signal (mirrors the scheduler-depth shape)."""
+        return sum(1 for j in self._fifo if j.state == "queued")
+
+    def summary(self) -> dict:
+        states = {s: 0 for s in JOB_STATES}
+        for job in self._jobs.values():
+            states[job.state] += 1
+        return {"depth": self.depth, "jobs": states}

@@ -1,0 +1,322 @@
+"""The tuning plane's fabric face: service, trainer replica, provisioner.
+
+:class:`TuningService` binds the job queue to one :class:`LoraTrainer`
+and the PR-18 SLO monitor: every ``tick()`` advances the active job by
+ONE train step — unless the shared monitor is in breach, in which case
+the lane YIELDS (records ``tune_yields``, runs nothing) and serving
+reclaims the iteration.  On a job's last step the trained factors
+hot-register as the tenant's next version and the ``deploy`` callback
+(the fabric controller's ``ensure_adapter`` push) propagates the new
+key fabric-wide — zero offline steps between "tenant POSTs examples"
+and "new version takes traffic".
+
+:class:`TrainerReplica` is the router/autoscale-visible face of a
+tuning lane: it duck-types ``EngineReplica`` (role ``"trainer"``,
+pending = tune-queue depth, ``step()`` = one service tick) so the
+autoscaler sizes the trainer tier with the exact machinery that sizes
+prefill/decode — but the router's placement paths EXCLUDE the role, so
+generation traffic can never land on a lane (``submit`` raises as a
+hard backstop).
+
+:class:`TrainerProvisioner` mints lanes for autoscale scale-ups and
+delegates serving roles to a wrapped base provisioner.
+"""
+
+from __future__ import annotations
+
+import time
+
+from mamba_distributed_tpu.obs import NULL_TRACER
+from mamba_distributed_tpu.serving.autoscale.provisioner import (
+    ReplicaProvisioner,
+)
+from mamba_distributed_tpu.serving.replica import ReplicaState
+from mamba_distributed_tpu.serving.tuning.jobs import (
+    TuneError,
+    TuneJob,
+    TuneJobQueue,
+)
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+
+class TuningService:
+    """One fabric's online-tuning plane: queue + trainer + SLO yield.
+
+    Jobs serialize through the single trainer (static shapes — the
+    train step compiles once); more trainer replicas mean more
+    ``tick()`` calls per fabric iteration, not concurrent jobs.
+
+    Args:
+      trainer: the :class:`LoraTrainer` lane.
+      queue: shared :class:`TuneJobQueue` (fresh one by default).
+      slo: optional shared ``obs.SLOMonitor`` — ``any_breach()`` gates
+        every tick (training yields while serving latency is burning).
+      metrics: optional ``ServingMetrics`` for the ``tuning`` summary
+        block (the first attached :class:`TrainerReplica` installs its
+        own when None).
+      deploy: optional ``(canonical_key) -> None`` called after a
+        version registers — the controller wires
+        ``FabricController.ensure_adapter`` here so every worker's
+        registry learns the new version before it takes traffic.
+    """
+
+    def __init__(self, trainer, *, queue=None, slo=None, metrics=None,
+                 deploy=None):
+        self.trainer = trainer
+        self.queue = queue if queue is not None else TuneJobQueue()
+        self.slo = slo
+        self.metrics = metrics
+        self.deploy = deploy
+        self._active: TuneJob | None = None
+
+    # ------------------------------------------------------------ intake
+
+    @property
+    def depth(self) -> int:
+        """Unfinished jobs (active + queued) — the trainer tier's
+        pressure signal."""
+        return (1 if self._active is not None else 0) + self.queue.depth
+
+    def submit(self, adapter: str, examples, steps: int | None = None
+               ) -> TuneJob:
+        """Enqueue one tune job (the ``/v1/tune`` POST body lands
+        here); validation failures raise the named :class:`TuneError`
+        at the boundary."""
+        if steps is None:
+            steps = self.trainer.cfg.tune_steps
+        job = self.queue.submit(adapter, examples, steps)
+        if self.metrics is not None:
+            self.metrics.record_tune_job("submitted", job.status())
+        return job
+
+    def status(self, job_id: str) -> dict:
+        return self.queue.status(job_id)
+
+    # -------------------------------------------------------------- tick
+
+    def tick(self) -> bool:
+        """Advance the tuning plane by at most ONE train step; returns
+        True when device work ran (False: idle queue or SLO yield).
+
+        The yield check runs BEFORE the step, every tick — a job that
+        converges over N steps re-checks serving pressure N times, so
+        a breach mid-job pauses training within one iteration and the
+        job resumes (state intact — params and optimizer state live on
+        the trainer) once the p95s clear."""
+        job = self._active
+        if job is None:
+            job = self.queue.next_queued()
+            if job is None:
+                return False
+            self._active = job
+        if self.slo is not None and self.slo.any_breach():
+            if self.metrics is not None:
+                self.metrics.record_tune_yield()
+            return False
+        t0 = time.perf_counter()
+        try:
+            if job.state == "queued":
+                self.trainer.start_job(job)
+                job.state = "running"
+            loss = self.trainer.train_step(job)
+        except Exception as e:  # noqa: BLE001 — job-scoped failure
+            self._fail(job, e)
+            return True
+        job.step += 1
+        job.losses.append(loss)
+        if self.metrics is not None:
+            self.metrics.record_tune_step(
+                (time.perf_counter() - t0) * 1000.0, loss
+            )
+        if job.step >= job.steps:
+            self._finish(job)
+        return True
+
+    def _finish(self, job: TuneJob) -> None:
+        try:
+            key = self.trainer.finish_job(job)
+        except Exception as e:  # noqa: BLE001 — registration failed
+            self._fail(job, e)
+            return
+        job.deployed = key
+        job.state = "completed"
+        self._active = None
+        if self.metrics is not None:
+            self.metrics.record_tune_job("completed", job.status())
+            self.metrics.record_tune_deploy()
+        if self.deploy is not None:
+            try:
+                self.deploy(key)
+            except Exception as e:  # noqa: BLE001 — push is best-effort
+                # the version IS registered (a shared-registry fabric
+                # already resolves it); surface the push failure on the
+                # job instead of un-completing it
+                job.error = f"deploy push: {type(e).__name__}: {e}"
+
+    def _fail(self, job: TuneJob, e: Exception) -> None:
+        job.state = "failed"
+        job.error = f"{type(e).__name__}: {e}"
+        self._active = None
+        if self.metrics is not None:
+            self.metrics.record_tune_job("failed", job.status())
+
+    def summary(self) -> dict:
+        out = self.queue.summary()
+        out["active"] = (self._active.job_id
+                         if self._active is not None else None)
+        return out
+
+
+# --------------------------------------------------- router-facing lane
+
+
+class _TrainerScheduler:
+    """Depth-only scheduler façade (autoscale's ``_queued`` fallback
+    reads ``engine.scheduler.depth``)."""
+
+    def __init__(self, service: TuningService):
+        self._service = service
+
+    @property
+    def depth(self) -> int:
+        return self._service.depth
+
+
+class _TrainerEngine:
+    """Duck-typed engine façade for the router's and worker's
+    non-placement reads (``summary()`` takes ``engine.metrics``,
+    autoscale takes ``engine.scheduler.depth``, the wire worker's
+    ``_stats``/``obs_pull`` take capacity/slots/tracer).  Placement
+    never sees a trainer — the router excludes the role — so none of
+    the engine's serving surface exists here."""
+
+    hybrid = False
+    migrate_hook = None
+    capacity = 0
+    _slots = ()  # no slot pool: a lane holds jobs, not streams
+
+    def __init__(self, service: TuningService, metrics: ServingMetrics,
+                 tracer=NULL_TRACER):
+        self.scheduler = _TrainerScheduler(service)
+        self.metrics = metrics
+        self.tracer = tracer
+
+
+class TrainerReplica:
+    """One tuning lane as a fabric replica (role ``"trainer"``).
+
+    ``accepting`` stays True while active — to the AUTOSCALER it means
+    "counts toward the tier" (an all-``accepting=False`` tier would
+    read as empty, i.e. infinite pressure); generation traffic is kept
+    out by the router's role exclusion, with ``submit`` raising as the
+    backstop.  ``step()`` runs one service tick, so a router-driven
+    fabric trains exactly when it steps — and yields exactly when the
+    SLO monitor says serving needs the iteration back.
+
+    Trainer death mid-job (the failure matrix in docs/SERVING.md): the
+    lane dies, the SERVICE survives — jobs and trainer state are
+    fabric-owned, so a controller-driven fabric keeps ticking and a
+    replacement lane (autoscale re-provision) resumes the same queue.
+    """
+
+    role = "trainer"
+
+    def __init__(self, replica_id: int, service: TuningService, *,
+                 metrics: ServingMetrics | None = None,
+                 tracer=NULL_TRACER):
+        self.replica_id = replica_id
+        self.service = service
+        if metrics is None:
+            metrics = ServingMetrics(1, replica=replica_id)
+        metrics.replica = replica_id
+        metrics.configure_tuning()
+        self.metrics = metrics
+        if service.metrics is None:
+            # first lane installs the service's counter sink, so tune
+            # steps/deploys/yields land in a replica-stamped summary
+            service.metrics = metrics
+        self.engine = _TrainerEngine(service, metrics, tracer)
+        self.state = ReplicaState.ACTIVE
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def accepting(self) -> bool:
+        return self.state is ReplicaState.ACTIVE
+
+    @property
+    def alive(self) -> bool:
+        return self.state is not ReplicaState.DEAD
+
+    @property
+    def pending(self) -> int:
+        """Tune jobs this lane would work (0 once draining/dead: the
+        queue is fabric-owned, a retiring lane holds nothing — so the
+        autoscaler's retire sweep releases it immediately)."""
+        return self.service.depth if self.accepting else 0
+
+    def drain(self, requeue: bool = False) -> list[int]:
+        if self.state is ReplicaState.ACTIVE:
+            self.state = ReplicaState.DRAINING
+        return []
+
+    def mark_dead(self) -> None:
+        self.state = ReplicaState.DEAD
+
+    # ---------------------------------------------------------- placement
+
+    def place_cost(self, request=None) -> float:
+        return float("inf")
+
+    def submit(self, request, force: bool = False) -> int:
+        raise TuneError(
+            f"replica {self.replica_id} is a trainer lane — it takes "
+            f"tune jobs, never generation traffic (router placement "
+            f"excludes the role; this is the backstop)"
+        )
+
+    def step(self):
+        """One tuning tick; no token events (the router appends
+        nothing for this replica)."""
+        if self.alive and self.accepting:
+            self.service.tick()
+        return []
+
+    def replay(self, local_id: int, from_index: int = 0):
+        return None
+
+
+class TrainerProvisioner(ReplicaProvisioner):
+    """Autoscale provisioner for the trainer tier.
+
+    ``"trainer"`` provisions a fresh :class:`TrainerReplica` over the
+    SHARED :class:`TuningService` (lanes multiply tick rate, not
+    state); every other role delegates to ``base`` — wrap the fabric's
+    existing ``EngineProvisioner``/``ProcessProvisioner`` so one
+    controller sizes serving and training tiers together.
+    """
+
+    def __init__(self, service: TuningService, base=None):
+        self.service = service
+        self.base = base
+        self.provisioned = 0
+        self.retired = 0
+
+    def provision(self, replica_id: int, role: str):
+        if role == "trainer":
+            self.provisioned += 1
+            return TrainerReplica(replica_id, self.service)
+        if self.base is None:
+            raise ValueError(
+                f"TrainerProvisioner has no base provisioner for "
+                f"role {role!r}"
+            )
+        return self.base.provision(replica_id, role)
+
+    def retire(self, replica) -> None:
+        if getattr(replica, "role", None) == "trainer":
+            # nothing backs a lane beyond the shared service
+            self.retired += 1
+            return
+        if self.base is not None:
+            self.base.retire(replica)

@@ -1,0 +1,313 @@
+"""Frozen-base LoRA fine-tuning for the online tuning plane.
+
+The trainer reuses the training stack end to end instead of growing a
+second one: :func:`training.train_step.make_train_step` provides the
+jitted loss/accum/clip/update machinery, and the serving LoRA delta
+path (models/common.linear — live whenever a ``"lora"`` subtree with
+bound ids sits on a projection) provides the forward.  Factor pools
+are attached to a PRIVATE copy of the base params exactly the way the
+serving engine attaches its device cache
+(serving/adapters.attach_adapter_pools), with ONE slot row per target
+— row 0 IS the tenant's factors — and the ids bound to zeros at trace
+time via ``make_train_step``'s ``params_map`` hook, so the compiled
+step differentiates straight through the segmented delta to the pool
+leaves.
+
+Base weights stay BIT-identical: gradients on them are zeroed before
+the clip (so the clipped norm is the factors' norm, not the model's),
+the masked Adam holds state only for factor leaves, and the step's
+``freeze`` splice puts the original frozen arrays back after
+``apply_updates`` (adding a literal 0.0 would flip ``-0.0`` sign
+bits).
+
+Sharding follows the serving rules (parallel/sharding.
+serving_param_specs): A row-parallel on d_in, B column-parallel on
+d_out — translated onto the training mesh's axis names ("model" ->
+"tensor", "stage" folded away) so one factor layout serves both the
+fabric's decode ticks and its train steps.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mamba_distributed_tpu.config import TrainConfig
+from mamba_distributed_tpu.parallel.mesh import single_device_mesh
+from mamba_distributed_tpu.parallel.sharding import serving_param_specs
+from mamba_distributed_tpu.serving.adapters import (
+    UnknownAdapterError,
+    attach_adapter_pools,
+    bind_adapter_ids,
+    split_adapter_version,
+)
+from mamba_distributed_tpu.serving.tuning.jobs import TuneError, TuneJob
+from mamba_distributed_tpu.training.train_step import make_train_step
+
+# fresh-tenant init: A ~ N(0, INIT_SCALE / rank), B = 0 — the first
+# version starts AT the base model (zero delta) and only the B grads
+# are nonzero on step one (dL/dA = dL/dy @ B^T = 0 at B=0), the
+# conventional LoRA warmup; a zero A too would leave BOTH grads zero
+# and the job permanently stuck
+INIT_SCALE = 0.05
+
+
+def lora_freeze_tree(params: dict):
+    """Pytree of bools matching ``params``: True (frozen) everywhere
+    except under a ``"lora"`` key — the trainable factor leaves."""
+
+    def walk(tree, in_lora):
+        if isinstance(tree, dict):
+            return {k: walk(v, in_lora or k == "lora")
+                    for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(walk(v, in_lora) for v in tree)
+        return not in_lora
+
+    return walk(params, False)
+
+
+def lora_optimizer(freeze, lr: float,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    """Masked optimizer over a frozen-base tree.
+
+    Order matters: frozen grads are zeroed FIRST (the base weights DO
+    receive real gradients — they are differentiated arguments — and
+    must not pollute the clip norm), then the global-norm clip sees
+    only the factor gradients, then a masked Adam holds first/second
+    moments for the factor leaves alone (``optax.masked`` stores
+    ``MaskedNode`` placeholders elsewhere — no shadow copy of the
+    model in optimizer state, unlike ``multi_transform``)."""
+    train = jax.tree.map(lambda f: not f, freeze)
+    return optax.chain(
+        optax.masked(optax.set_to_zero(), freeze),
+        optax.clip_by_global_norm(grad_clip),
+        optax.masked(optax.adam(lr), train),
+    )
+
+
+def pack_examples(examples, batch: int, seq_len: int):
+    """Pack token-id example sequences into one ``(1, B, T)`` x/y pair
+    (the train step's ``(accum, B_global, T)`` layout, accum=1).
+
+    Standard LM packing: the examples concatenate into one stream,
+    cycled until it covers ``B*T + 1`` tokens, then split into
+    next-token-shifted x/y — no padding tokens, so every position
+    trains on tenant data."""
+    stream = [t for ex in examples for t in ex]
+    if len(stream) < 2:
+        raise TuneError("tune examples pack to fewer than 2 tokens")
+    need = batch * seq_len + 1
+    reps = -(-need // len(stream))
+    arr = np.asarray((stream * reps)[:need], np.int32)
+    x = arr[:-1].reshape(1, batch, seq_len)
+    y = arr[1:].reshape(1, batch, seq_len)
+    return x, y
+
+
+# ------------------------------------------------------- mesh plumbing
+
+
+def _training_mesh_from(mesh) -> Mesh:
+    """Normalize any fabric mesh to training axis names.
+
+    A serving mesh (``("data", "model")`` or ``("data", "stage",
+    "model")``) re-labels onto the training mesh's 6 axes: its data
+    (and stage) extent becomes pure data parallel, its model extent
+    becomes ``tensor`` — same devices, training-side names, so
+    ``batch_spec``/TP rules resolve.  A mesh that already has the
+    training axes passes through."""
+    names = mesh.axis_names
+    if "fsdp" in names:
+        return mesh
+    shape = dict(mesh.shape)
+    data = shape.get("data", 1) * shape.get("stage", 1)
+    model = shape.get("model", 1)
+    devs = np.asarray(mesh.devices).reshape(data, 1, 1, model, 1, 1)
+    return Mesh(devs, ("data", "fsdp", "seq", "tensor", "pipe", "expert"))
+
+
+def _to_training_spec(spec: P) -> P:
+    """Translate one serving PartitionSpec onto training axis names:
+    ``"model"`` -> ``"tensor"`` (the TP axis under either name),
+    ``"stage"`` -> replicated (the trainer folds stages into data)."""
+
+    def one(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(one(e) for e in entry if e != "stage")
+            kept = tuple(e for e in kept if e is not None)
+            return kept if kept else None
+        if entry == "model":
+            return "tensor"
+        if entry == "stage":
+            return None
+        return entry
+
+    return P(*(one(e) for e in spec))
+
+
+# -------------------------------------------------------------- trainer
+
+
+class LoraTrainer:
+    """Fine-tunes one tenant's {A, B} factors against a frozen base.
+
+    One trainer serves the whole tuning lane: it holds a private copy
+    of the base params (the compiled step DONATES its buffers — the
+    serving engines' shared read-only tree must never be donated) with
+    zero factor pools attached once at construction; each job splices
+    its warm-start factors into the pools, re-inits the masked
+    optimizer state, and steps the one compiled train step.  Jobs
+    serialize — static shapes mean the jit traces once, ever.
+
+    Deploy path: the finished factors register under the job's BARE
+    name — :meth:`AdapterRegistry.register` mints ``v(N+1)`` — with
+    ``alpha=rank`` so the stored (scaled) B is the trained B
+    bit-exactly (the trainer optimizes the EFFECTIVE factors; warm
+    starts read the stored ones back symmetrically).
+    """
+
+    def __init__(self, params: dict, cfg, registry, *, mesh=None):
+        self.cfg = cfg
+        self.registry = registry
+        self.rank = registry.rank
+        self.mesh = (_training_mesh_from(mesh) if mesh is not None
+                     else single_device_mesh())
+        self.tcfg = TrainConfig(
+            model=cfg,
+            micro_batch_size=cfg.tune_batch_size,
+            seq_len=cfg.tune_seq_len,
+            total_batch_size=cfg.tune_batch_size * cfg.tune_seq_len,
+        )
+        pools = {}
+        for path, (n, d_in, d_out) in registry.targets.items():
+            pools[path] = {
+                "A": jnp.zeros((n, 1, d_in, self.rank), jnp.float32),
+                "B": jnp.zeros((n, 1, self.rank, d_out), jnp.float32),
+            }
+        # private copy: jnp.array copies even for committed jax arrays,
+        # so later donation can't invalidate the fabric's shared tree
+        tree = attach_adapter_pools(
+            jax.tree.map(lambda a: jnp.array(a), params), pools
+        )
+        # serving-rule placement translated onto the training mesh
+        # (identity on one device): factors and kernels shard the same
+        # axes whether a decode tick or a train step reads them
+        specs = jax.tree.map(
+            _to_training_spec,
+            serving_param_specs(tree, dict(self.mesh.shape)["tensor"]),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._tree = jax.device_put(
+            tree, jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        )
+        self.freeze = lora_freeze_tree(self._tree)
+        self.optimizer = lora_optimizer(
+            self.freeze, cfg.tune_lr, self.tcfg.grad_clip
+        )
+        self._opt_state = self.optimizer.init(self._tree)
+        self._step = None
+        self._batch = None
+        self.steps_run = 0
+
+    # -------------------------------------------------------- job setup
+
+    def _warm_factors(self, base: str) -> dict:
+        """Stored (effective) factors of the tenant's latest version —
+        the warm start — or a fresh A-random/B-zero init for a tenant
+        the registry has never seen."""
+        try:
+            return self.registry.factors(base)
+        except UnknownAdapterError:
+            pass
+        rng = np.random.default_rng(zlib.crc32(base.encode("utf-8")))
+        fac = {}
+        for path, (n, d_in, d_out) in self.registry.targets.items():
+            fac[path] = {
+                "A": rng.normal(0.0, INIT_SCALE / self.rank,
+                                (n, d_in, self.rank)).astype(np.float32),
+                "B": np.zeros((n, self.rank, d_out), np.float32),
+            }
+        return fac
+
+    def start_job(self, job: TuneJob) -> None:
+        """Splice the job's warm-start factors into the pools, pack its
+        examples, reset optimizer state, and (first job only) compile
+        the masked train step."""
+        base, ver = split_adapter_version(job.adapter)
+        if ver is not None:
+            raise TuneError(
+                f"tune jobs target a BARE adapter name, got {job.adapter!r}"
+            )
+        warm = self._warm_factors(base)
+        pools = {}
+        for path, (n, d_in, d_out) in self.registry.targets.items():
+            fac = warm.get(path)
+            if fac is not None:
+                a = jnp.asarray(fac["A"], jnp.float32)[:, None]
+                b = jnp.asarray(fac["B"], jnp.float32)[:, None]
+            else:
+                a = jnp.zeros((n, 1, d_in, self.rank), jnp.float32)
+                b = jnp.zeros((n, 1, self.rank, d_out), jnp.float32)
+            pools[path] = {"A": a, "B": b}
+        self._tree = attach_adapter_pools(self._tree, pools)
+        self._opt_state = self.optimizer.init(self._tree)
+        self._batch = pack_examples(
+            job.examples, self.cfg.tune_batch_size, self.cfg.tune_seq_len
+        )
+        if self._step is None:
+            bsz = self.cfg.tune_batch_size
+            self._step = make_train_step(
+                self.tcfg, self.optimizer, self.mesh,
+                self._tree, self._opt_state,
+                freeze=self.freeze,
+                # every batch row reads pool row 0 — the tenant's
+                # factors; bound at trace time so the ids are jit
+                # constants, not (integer) differentiated arguments
+                params_map=lambda p: bind_adapter_ids(
+                    p, jnp.zeros((bsz,), jnp.int32)
+                ),
+            )
+
+    # -------------------------------------------------------- train/fin
+
+    def train_step(self, job: TuneJob) -> float:
+        """One masked step on the job's packed batch; returns the mean
+        next-token loss (a host float — the one sync per step)."""
+        if self._batch is None:
+            raise TuneError(f"job {job.job_id} was never started")
+        x, y = self._batch
+        self._tree, self._opt_state, loss, _ = self._step(
+            self._tree, self._opt_state, x, y
+        )
+        self.steps_run += 1
+        return float(loss)
+
+    def finish_job(self, job: TuneJob) -> str:
+        """Register the trained factors as the tenant's next version;
+        returns the canonical ``name@v(N+1)`` key (``name`` for a
+        first-ever version — the PR-15 fast path)."""
+        base, _ = split_adapter_version(job.adapter)
+        fac = {}
+        for path in self.registry.targets:
+            node = self._tree
+            for name in path.split("/"):
+                node = node[name]
+            pool = node["lora"]
+            fac[path] = {
+                "A": np.asarray(pool["A"][:, 0], np.float32),
+                "B": np.asarray(pool["B"][:, 0], np.float32),
+            }
+        # alpha=rank => scale 1.0: the trainer optimizes the EFFECTIVE
+        # factors, so the stored B must be the trained B bit-exactly
+        return self.registry.register(base, fac, alpha=self.rank)

@@ -34,6 +34,8 @@ def make_train_step(
     opt_state,
     seq_ctx=None,
     overflow_threshold: float | None = None,
+    freeze=None,
+    params_map=None,
 ):
     """Build the compiled train step.
 
@@ -49,10 +51,30 @@ def make_train_step(
     the sentinel's on-device half costs no extra trace and no extra
     launch; the host accumulates the flags into a counter
     (obs/sentinel.py).
+
+    ``freeze`` (a pytree of bools matching ``params``; None = train
+    everything, the exact status quo) splices the ORIGINAL frozen
+    leaves back after ``apply_updates`` — the partial-fine-tune path
+    (online LoRA tuning, serving/tuning/trainer.py).  The caller's
+    masked optimizer (``optax.multi_transform`` + ``set_to_zero``)
+    already produces zero updates for frozen leaves; the splice turns
+    "adds 0.0" into "bit-identical" (a +0.0 rewrite would flip any
+    -0.0 base weight's sign bit, breaking the frozen-base contract).
+
+    ``params_map`` (pure tree->tree function; None = identity) is
+    applied to the param tree INSIDE the loss, at trace time, before
+    the forward.  Gradients flow through it to the original leaves,
+    while anything it splices in (e.g. the constant adapter-id vector
+    ``bind_adapter_ids`` adds for the LoRA delta path) stays a closed-
+    over constant rather than a differentiated — and int-dtype —
+    argument leaf.  Non-pipelined losses only (tuning never runs with
+    ``mesh.pipe > 1``).
     """
     model_cfg = cfg.model
 
     def loss_fn(p, x, y):
+        if params_map is not None:
+            p = params_map(p)
         return lm_loss(p, model_cfg, x, y, seq_ctx=seq_ctx)
 
     pipe = cfg.mesh.pipe
@@ -93,7 +115,13 @@ def make_train_step(
             loss = lsum / accum
         grad_norm = optax.global_norm(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        new_params = optax.apply_updates(params, updates)
+        if freeze is not None:
+            new_params = jax.tree.map(
+                lambda frozen, new, old: old if frozen else new,
+                freeze, new_params, params,
+            )
+        params = new_params
         if overflow_threshold is not None:
             overflow = jnp.int32(
                 ~jnp.isfinite(grad_norm) | (grad_norm > overflow_threshold)

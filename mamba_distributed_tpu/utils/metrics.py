@@ -265,6 +265,24 @@ class ServingMetrics:
         self._adapter_ticks = 0
         # priority preemptions (serving/engine.py swap-out/resume)
         self.preemptions = 0
+        # online per-tenant adapter tuning plane (serving/tuning/ plus
+        # the engine's fairness quota and mid-stream hot swaps): the
+        # owner calls configure_tuning() when any of those features is
+        # live — tenant_max_slots > 0 at engine construction, or
+        # lazily on the first hot swap / tune job — unlocking
+        # summary()["tuning"].  Off by default so tuning-less
+        # summaries and records stay byte-stable.
+        self._tuning_on = False
+        self.tenant_quota_stalls = 0
+        self.adapter_hot_swaps = 0
+        self.tune_jobs_submitted = 0
+        self.tune_jobs_completed = 0
+        self.tune_jobs_failed = 0
+        self.tune_train_steps = 0
+        self.tune_deploys = 0
+        self.tune_yields = 0
+        self.tune_step_ms = StreamingHistogram()
+        self.tune_last_loss: float | None = None
         # durable sessions (serving/sessions/store.py): the engine
         # calls configure_sessions() when a session store is attached,
         # unlocking summary()["sessions"] — park/resume/expire totals,
@@ -417,6 +435,70 @@ class ServingMetrics:
         self.lora_rank = int(rank)
         self.lora_cache_slots = int(cache_slots)
 
+    # ------------------------------------------- online adapter tuning
+
+    def configure_tuning(self) -> None:
+        """Mark the online-tuning plane live: ``summary()`` gains its
+        ``tuning`` section and tick records their quota-stall /
+        hot-swap stamps.  Idempotent; the ``record_*`` methods below
+        call it lazily, so the section appears exactly when the first
+        tuning-plane event happens (byte-stable until then)."""
+        self._tuning_on = True
+
+    def record_quota_stall(self) -> None:
+        """One admission deferred by the per-tenant fairness quota
+        (serving/scheduler.TenantQuotaExceeded — requeued, not shed)."""
+        self.configure_tuning()
+        self.tenant_quota_stalls += 1
+
+    def record_hot_swap(self) -> None:
+        """One live stream switched adapter versions mid-flight
+        (serving/engine.hot_swap_adapter)."""
+        self.configure_tuning()
+        self.adapter_hot_swaps += 1
+
+    def record_tune_job(self, state: str,
+                        job: dict | None = None) -> None:
+        """One tune-job lifecycle transition: ``state`` is "submitted",
+        "completed" or "failed" (serving/tuning/jobs.py).  ``job`` is
+        the job's status dict; with a jsonl stream configured it lands
+        as one ``"kind": "tune_job"`` record per transition (the
+        docs/OBSERVABILITY.md event schema)."""
+        self.configure_tuning()
+        if state == "submitted":
+            self.tune_jobs_submitted += 1
+        elif state == "completed":
+            self.tune_jobs_completed += 1
+        else:
+            self.tune_jobs_failed += 1
+        if self.jsonl_path and job is not None:
+            rec = {"kind": "tune_job", **job}
+            if self.replica is not None:
+                rec.setdefault("replica", self.replica)
+            self._write_jsonl(rec)
+
+    def record_tune_step(self, dt_ms: float,
+                         loss: float | None = None) -> None:
+        """One masked LoRA train step on a trainer-role replica:
+        host wall ms and (when finite) the step's mean loss."""
+        self.configure_tuning()
+        self.tune_train_steps += 1
+        self.tune_step_ms.record(dt_ms)
+        if loss is not None:
+            self.tune_last_loss = float(loss)
+
+    def record_tune_deploy(self) -> None:
+        """One converged job's ``name@v(N+1)`` hot-registered
+        fabric-wide (serving/tuning/jobs.py deploy)."""
+        self.configure_tuning()
+        self.tune_deploys += 1
+
+    def record_tune_yield(self) -> None:
+        """One training slice skipped because serving pressure (SLO
+        breach / queue depth) reclaimed the lane."""
+        self.configure_tuning()
+        self.tune_yields += 1
+
     # --------------------------------------------------- quantized serving
 
     def configure_memory(self, weight_bytes: int, page_pool_bytes: int,
@@ -546,6 +628,8 @@ class ServingMetrics:
         preemptions: int = 0,
         migrations_out: int = 0,
         migrations_in: int = 0,
+        tenant_quota_stalls: int = 0,
+        adapter_hot_swaps: int = 0,
         prefix_hits: int | None = None,
         prefix_misses: int | None = None,
         prefix_saved_tokens: int | None = None,
@@ -687,6 +771,15 @@ class ServingMetrics:
                 self._pipeline_slot_lanes += slot_lanes
         if preemptions:
             record["preemptions"] = preemptions
+        if tenant_quota_stalls:
+            # fairness-quota deferrals in the window (stamped only when
+            # nonzero — quota-off engines' records stay byte-stable;
+            # the cumulative total rides record_quota_stall)
+            record["tenant_quota_stalls"] = tenant_quota_stalls
+        if adapter_hot_swaps:
+            # mid-stream adapter version swaps in the window (stamped
+            # only when nonzero — swap-free records stay byte-stable)
+            record["adapter_hot_swaps"] = adapter_hot_swaps
         if migrations_out:
             # disaggregated-tier handoffs in the window (stamped only
             # when live, so non-disagg streams stay byte-stable)
@@ -946,6 +1039,18 @@ class ServingMetrics:
                     if self._adapter_ticks else None
                 ),
             }),
+            "tuning": (None if not self._tuning_on else {
+                "quota_stalls": self.tenant_quota_stalls,
+                "hot_swaps": self.adapter_hot_swaps,
+                "jobs_submitted": self.tune_jobs_submitted,
+                "jobs_completed": self.tune_jobs_completed,
+                "jobs_failed": self.tune_jobs_failed,
+                "train_steps": self.tune_train_steps,
+                "deploys": self.tune_deploys,
+                "yields": self.tune_yields,
+                "step_ms": self.tune_step_ms.summary(),
+                "last_loss": self.tune_last_loss,
+            }),
             "admission": (None if not self._admission_on else {
                 "sheds": self.sheds,
                 "sheds_cap": self.sheds_cap,
@@ -995,8 +1100,13 @@ class ServingMetrics:
         exposition needs (``summary()`` carries only the p50/p95/p99
         roll-ups; bucket lines need the counts).  Shipped next to the
         summary in the worker ``summary`` RPC payload."""
-        return {
+        out = {
             "queue_wait_ms": self.queue_wait_ms.to_dict(),
             "ttft_ms": self.ttft_ms.to_dict(),
             "itl_ms": self.itl_ms.to_dict(),
         }
+        if self._tuning_on:
+            # gated like summary()["tuning"]: a tuning-less fabric's
+            # exposition stays byte-identical (no empty histogram)
+            out["tune_step_ms"] = self.tune_step_ms.to_dict()
+        return out

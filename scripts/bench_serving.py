@@ -937,6 +937,22 @@ def main() -> None:
     ap.add_argument("--lora-rank", type=int, default=8, metavar="R",
                     help="low-rank dimension for --lora-adapters "
                          "(cfg.lora_rank)")
+    ap.add_argument("--online-lora", action="store_true",
+                    help="online per-tenant LoRA tuning headline "
+                         "(docs/SERVING.md 'Online adapter tuning'): a "
+                         "trainer-role replica fine-tunes a tenant's "
+                         "factors against the frozen base WHILE the "
+                         "same fabric (one router) serves the default "
+                         "mixed workload; reports serving-SLO "
+                         "attainment during training (TTFT <= "
+                         "SERVE_SLO_TTFT_MS, default 1.5x the "
+                         "no-training p95) and time-to-deployed-"
+                         "adapter (job submit -> version registered "
+                         "and servable), with the serving streams "
+                         "asserted token-identical to a fabric that "
+                         "never trains — the BENCH_SERVING.json "
+                         "online_lora row.  SERVE_TUNE_STEPS (8) sets "
+                         "the job length; --lora-rank sets the rank")
     ap.add_argument("--park", action="store_true",
                     help="durable-session park/resume headline "
                          "(docs/SERVING.md 'Durable sessions'): "
@@ -997,6 +1013,7 @@ def main() -> None:
                               args.quant_kv_capacity),
                              ("--spec-tokens", bool(args.spec_tokens)),
                              ("--lora-adapters", bool(args.lora_adapters)),
+                             ("--online-lora", args.online_lora),
                              ("--service", args.service),
                              ("--park", args.park),
                              ("--open-loop", args.open_loop),
@@ -1251,6 +1268,192 @@ def main() -> None:
             "park_wall_s": round(t_park, 3),
             "total_wall_s": round(t_total, 3),
             "parity": "token-identical vs never-parked engine",
+            "prompt_len_range": [pmin, pmax],
+            "max_new_tokens": max_new,
+            "tokens_per_tick": tokens_per_tick,
+            "device": dev.device_kind,
+        }
+        emit_bench_record(record, args.json)
+        return
+
+    if args.online_lora:
+        # online LoRA tuning headline: ONE fabric — a serving replica
+        # plus a trainer lane behind one router — serves the default
+        # mixed workload while a tune job trains a tenant's factors on
+        # the lane, then the trained version deploys with zero offline
+        # steps (docs/SERVING.md "Online adapter tuning").  The
+        # frozen-base contract makes a hard oracle: serving streams
+        # must be TOKEN-IDENTICAL to a fabric that never trains (base
+        # weights stay bit-identical and adapter-less requests never
+        # read the factor pools), so concurrent training may cost
+        # latency — that cost is the SLO-attainment number — but never
+        # correctness.
+        import dataclasses as _dc
+
+        from mamba_distributed_tpu.serving import GenerationRequest
+        from mamba_distributed_tpu.serving.adapters import AdapterRegistry
+        from mamba_distributed_tpu.serving.replica import EngineReplica
+        from mamba_distributed_tpu.serving.router import RequestRouter
+        from mamba_distributed_tpu.serving.tuning import (
+            LoraTrainer,
+            TrainerReplica,
+            TuningService,
+        )
+
+        tune_steps = int(os.environ.get("SERVE_TUNE_STEPS", "8"))
+        lcfg = _dc.replace(
+            cfg, lora_max_adapters=4, lora_rank=args.lora_rank,
+            tune_steps=tune_steps, tune_batch_size=2,
+            tune_seq_len=min(64, max(16, pmax)),
+        )
+        requests = _workload(rng, n_requests, pmin, pmax, max_new,
+                             cfg.vocab_size)
+        tenant = "tenant-0"
+        examples = [rng.integers(0, cfg.vocab_size, size=48).tolist()
+                    for _ in range(4)]
+
+        def fresh(rs):
+            return [GenerationRequest(
+                prompt_ids=np.asarray(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens, seed=r.seed,
+            ) for r in rs]
+
+        def drive(router, reqs, svc=None, lane=None):
+            """Submit ``reqs`` and step the fabric until they finish —
+            the trainer lane (pending = tune-queue depth) trains inside
+            the SAME router.step() loop, which is the whole point —
+            then keep ticking the lane until the tune queue drains.
+            Returns per-seed client-side TTFTs (ms), per-seed token
+            streams, and the absolute perf_counter at which the tune
+            queue emptied (None without a service)."""
+            sub, first, toks, seed_of = {}, {}, {}, {}
+            for r in reqs:
+                gid = router.submit(r)
+                seed_of[gid] = r.seed
+                toks[gid] = []
+                sub[gid] = time.perf_counter()
+            t_tuned_out = None
+            while router.pending or (svc is not None and svc.depth):
+                if router.pending:
+                    evs = router.step()
+                else:
+                    lane.step()  # serving drained; finish the job
+                    evs = []
+                now = time.perf_counter()
+                for ev in evs:
+                    first.setdefault(ev.request_id, now)
+                    toks[ev.request_id].append(int(ev.token))
+                if (svc is not None and t_tuned_out is None
+                        and svc.depth == 0):
+                    t_tuned_out = now
+            ttft = {seed_of[g]: (first[g] - sub[g]) * 1e3 for g in sub}
+            streams = {seed_of[g]: toks[g] for g in sub}
+            return ttft, streams, t_tuned_out
+
+        # --- baseline fabric: serving only, never trains -------------
+        reg_a = AdapterRegistry(lcfg, params)
+        rep_a = EngineReplica(0, params, lcfg, capacity=capacity,
+                              tokens_per_tick=tokens_per_tick,
+                              retain_results=False, adapters=reg_a)
+        router_a = RequestRouter(None, lcfg, replicas=[rep_a],
+                                 retain_results=False)
+        drive(router_a, fresh(requests))  # warm every shape off the clock
+        ttft_base, streams_base, _ = drive(router_a, fresh(requests))
+        _progress(f"baseline (no training) done: "
+                  f"{len(streams_base)} streams")
+
+        # --- online fabric: same serving shape + one trainer lane ----
+        reg_b = AdapterRegistry(lcfg, params)
+        rep_b = EngineReplica(0, params, lcfg, capacity=capacity,
+                              tokens_per_tick=tokens_per_tick,
+                              retain_results=False, adapters=reg_b)
+        trainer = LoraTrainer(params, lcfg, reg_b)
+        svc = TuningService(trainer)
+        lane = TrainerReplica(1, svc)
+        router_b = RequestRouter(None, lcfg, replicas=[rep_b, lane],
+                                 retain_results=False)
+        # warm off the clock: the serving signatures AND the masked
+        # train step's one-time compile (a 1-step job on a scratch
+        # tenant), so the timed run measures steady-state interleaving
+        svc.submit("bench-warmup", examples, steps=1)
+        while svc.depth:
+            lane.step()
+        drive(router_b, fresh(requests))
+        _progress("online fabric warmed (serving + train step compiled)")
+
+        t_job = time.perf_counter()
+        job = svc.submit(tenant, examples, steps=tune_steps)
+        ttft_tune, streams_tune, t_done = drive(
+            router_b, fresh(requests), svc=svc, lane=lane
+        )
+        status = svc.status(job.job_id)
+        if status["state"] != "completed":
+            raise SystemExit(f"tune job failed during the bench: {status}")
+        time_to_deploy = t_done - t_job
+        deployed = status["deployed"]
+
+        if streams_tune != streams_base:
+            bad = sorted(s for s in streams_base
+                         if streams_tune.get(s) != streams_base[s])
+            raise SystemExit(
+                f"frozen-base parity broke for seeds {bad}: serving "
+                f"streams must be token-identical with and without "
+                f"concurrent training"
+            )
+        _progress(f"parity OK: {len(streams_base)} streams "
+                  f"token-identical under concurrent training; "
+                  f"{deployed!r} deployed in {time_to_deploy:.2f}s")
+
+        # the deployed version must actually serve on the same fabric
+        areq = GenerationRequest(
+            prompt_ids=rng.integers(0, cfg.vocab_size,
+                                    size=16).astype(np.int32),
+            max_new_tokens=8, seed=31337, adapter=tenant,
+        )
+        _, astreams, _ = drive(router_b, [areq])
+        if not astreams[31337]:
+            raise SystemExit(
+                f"deployed adapter {deployed!r} served no tokens"
+            )
+
+        slo_ms = float(os.environ.get("SERVE_SLO_TTFT_MS", "0"))
+        base_vals = list(ttft_base.values())
+        tune_vals = list(ttft_tune.values())
+        if not slo_ms:
+            slo_ms = 1.5 * float(np.percentile(base_vals, 95))
+        attain_tune = sum(v <= slo_ms for v in tune_vals) / len(tune_vals)
+        attain_base = sum(v <= slo_ms for v in base_vals) / len(base_vals)
+
+        tun = lane.metrics.summary().get("tuning", {})
+        step_ms = tun.get("step_ms") or {}
+        record = {
+            "metric": (f"serving_online_lora_slo_attainment_"
+                       f"{preset.replace('-', '_')}"),
+            "value": round(attain_tune, 3),
+            "unit": ("fraction of mixed-workload requests meeting the "
+                     "TTFT SLO while a tune job trains on the same "
+                     "fabric"),
+            "slo_ttft_ms": round(slo_ms, 3),
+            "baseline_attainment": round(attain_base, 3),
+            "ttft_p50_ms_baseline":
+                round(float(np.percentile(base_vals, 50)), 3),
+            "ttft_p95_ms_baseline": _p95(base_vals),
+            "ttft_p50_ms_tuning":
+                round(float(np.percentile(tune_vals, 50)), 3),
+            "ttft_p95_ms_tuning": _p95(tune_vals),
+            "time_to_deployed_s": round(time_to_deploy, 3),
+            "deployed": deployed,
+            "tune_steps": tune_steps,
+            "train_steps_total": tun.get("train_steps"),
+            "tune_step_ms_p50": step_ms.get("p50"),
+            "final_loss": tun.get("last_loss"),
+            "parity": ("serving streams token-identical with and "
+                       "without concurrent training (frozen base)"),
+            "adapter_serve": (f"post-deploy stream under {deployed!r} "
+                              f"completed on the same fabric"),
+            "requests": n_requests,
+            "capacity": capacity,
+            "lora_rank": args.lora_rank,
             "prompt_len_range": [pmin, pmax],
             "max_new_tokens": max_new,
             "tokens_per_tick": tokens_per_tick,

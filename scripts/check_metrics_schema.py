@@ -55,6 +55,9 @@ _FULL_SUMMARY = {
     "goodput": {"useful_fraction": 0.9, "goodput_tokens_per_sec": 90.0,
                 "serving_mfu": 0.1},
     "compile": {"compiles": 2, "compile_ms": 120.0},
+    "tuning": {"quota_stalls": 1, "hot_swaps": 1, "jobs_submitted": 2,
+               "jobs_completed": 1, "jobs_failed": 1, "train_steps": 20,
+               "deploys": 1, "yields": 3, "last_loss": 4.2},
 }
 
 
@@ -64,7 +67,7 @@ def emitted_families() -> set[str]:
     snapshot = {
         "replica": 0, "role": "mixed", "summary": _FULL_SUMMARY,
         "histograms": {"queue_wait_ms": _HIST, "ttft_ms": _HIST,
-                       "itl_ms": _HIST},
+                       "itl_ms": _HIST, "tune_step_ms": _HIST},
         "stats": {"depth": 2, "resident": 3, "capacity": 4},
     }
     text = prom.render_fabric(
@@ -73,6 +76,7 @@ def emitted_families() -> set[str]:
         queue_depth=3,
         sheds={"queue_cap": 2, "queue_deadline": 5},
         autoscale={"scale_ups": 1, "scale_downs": 1},
+        tune_queue_depth=2,
     )
     return set(prom.parse_exposition(text))
 

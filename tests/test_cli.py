@@ -690,6 +690,42 @@ def test_bench_serving_park_smoke(tmp_path):
 
 
 @pytest.mark.serving
+@pytest.mark.tuning
+def test_bench_serving_online_lora_smoke(tmp_path):
+    """CI smoke for the online-tuning bench (ISSUE 20 satellite):
+    ``--online-lora`` must train a tenant's factors on a trainer lane
+    WHILE the same router serves the mixed workload (frozen-base
+    parity vs a never-training fabric asserted inside the bench),
+    deploy the trained version, serve a post-deploy stream under it,
+    and report the SLO-attainment + time-to-deployed pair."""
+    import json
+
+    json_out = str(tmp_path / "ol.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_REQUESTS="4", SERVE_CAPACITY="2",
+               SERVE_PROMPT_MIN="4", SERVE_PROMPT_MAX="12",
+               SERVE_MAX_NEW="8", SERVE_TOKENS_PER_TICK="4",
+               SERVE_TUNE_STEPS="2")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_serving.py"),
+         "--online-lora", "--lora-rank", "4", "--json", json_out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["metric"].startswith("serving_online_lora_slo_attainment")
+    assert 0.0 <= rec["value"] <= 1.0
+    assert rec["deployed"] == "tenant-0"
+    assert rec["time_to_deployed_s"] > 0
+    assert rec["tune_steps"] == 2
+    # warmup job (1 step) + the timed job's 2 steps, all on one lane
+    assert rec["train_steps_total"] == 3
+    assert rec["final_loss"] > 0
+    assert "token-identical" in rec["parity"]
+    assert "post-deploy stream" in rec["adapter_serve"]
+
+
+@pytest.mark.serving
 @pytest.mark.autoscale
 def test_bench_serving_open_loop_smoke(tmp_path):
     """CI smoke for the open-loop overload bench (ISSUE 18): the

@@ -1,0 +1,596 @@
+"""Online per-tenant LoRA training on the serving fabric
+(serving/tuning/ + the engine/wire/HTTP surfaces it grew).
+
+The contract under test, per ISSUE 20's acceptance criteria:
+
+  * FROZEN BASE — the masked train step updates ONLY the factor
+    leaves: every non-LoRA leaf of the trainer's tree is BIT-identical
+    (uint32 view) after training, and the loss on the tenant's packed
+    batch actually falls.
+  * DEPLOY — a finished job hot-registers the trained factors as the
+    tenant's next version (``alice`` then ``alice@v2``; the tenant can
+    never pin ``@vN`` itself), warm-starting each job from the last
+    deployed version; a stream served under the tuned adapter matches
+    solo ``generate()`` on the MERGED weights via
+    ``assert_stream_close``.
+  * HOT SWAP — a live decoding stream moves to the freshly deployed
+    version mid-flight with its carry invalidated EXACTLY once and no
+    token lost: the pre-swap prefix matches the v1 merged reference,
+    the post-swap suffix matches the v2 merged continuation, and the
+    finish record counts the full budget.
+  * SLO YIELD — the tuning lane yields (no train step, ``yields``
+    counted) while the shared SLOMonitor is in breach, and the SAME
+    job resumes to completion once the p95s clear.
+  * WIRE v6 — ``submit_tune``/``tune_status`` frames round-trip, and a
+    v5 peer fails loudly through the NAMED UnknownWireVersionError.
+  * FAIRNESS — ``cfg.tenant_max_slots`` caps one tenant's concurrent
+    resident slots (versions share the cap): over-quota admissions
+    requeue (counted, never shed) and every stream still finishes.
+  * A/B — with ``cfg.lora_ab_fraction < 1`` a bare-name submit routes
+    across the last two versions; the default 1.0 always pins latest.
+  * BYTE-STABILITY — a fabric that never tunes emits no tuning block,
+    no tune histogram, and no ``mamba_tune_*``/quota/hot-swap
+    families.
+  * END TO END — POST /v1/tune on a live fabric (serving replica +
+    trainer lane + controller) trains, deploys, versions, and serves
+    the tuned adapter with zero offline steps.
+
+Runnable standalone: ``pytest -m tuning``.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mamba_distributed_tpu.config import ModelConfig
+from mamba_distributed_tpu.inference import generate
+from mamba_distributed_tpu.models import init_lm_params
+from mamba_distributed_tpu.obs import prom
+from mamba_distributed_tpu.obs.slo import SLOMonitor
+from mamba_distributed_tpu.ops.quant import assert_stream_close
+from mamba_distributed_tpu.serving import (
+    AdapterRegistry,
+    GenerationRequest,
+    ServingEngine,
+    TenantQuotaExceeded,
+    TuneError,
+    TuningService,
+)
+from mamba_distributed_tpu.serving.adapters import split_adapter_version
+from mamba_distributed_tpu.serving.scheduler import check_tenant_quota
+from mamba_distributed_tpu.serving.service import wire
+from mamba_distributed_tpu.serving.tuning import (
+    LoraTrainer,
+    TrainerReplica,
+    TuneJobQueue,
+)
+from mamba_distributed_tpu.utils.metrics import ServingMetrics
+
+pytestmark = [pytest.mark.tuning, pytest.mark.serving]
+
+CHUNK = 16
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("prefill_chunk_tokens", CHUNK)
+    kw.setdefault("prefill_tokens_per_tick", CHUNK)
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("lora_max_adapters", 4)
+    kw.setdefault("lora_rank", 4)
+    kw.setdefault("tune_steps", 3)
+    kw.setdefault("tune_batch_size", 2)
+    kw.setdefault("tune_seq_len", 16)
+    return ModelConfig(d_model=32, n_layer=2, ssm_layer="mamba2",
+                       headdim=8, chunk_size=16, d_state=16, **kw)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_cfg()
+    params = init_lm_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def examples_for(seed=0, n=4, length=12, vocab=64):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(1, vocab - 1, size=length)]
+            for _ in range(n)]
+
+
+def rand_prompt(n, seed=1, vocab=64):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab),
+        np.int32,
+    )
+
+
+def run_jobs(svc, lane=None):
+    """Tick the tuning plane dry (the controller/router loop's job)."""
+    stepper = lane.step if lane is not None else svc.tick
+    for _ in range(10_000):
+        if svc.depth == 0:
+            return
+        stepper()
+    raise AssertionError("tuning queue never drained")
+
+
+def base_leaves(tree):
+    """(path, leaf) for every non-LoRA leaf — the frozen base."""
+    out = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "lora":
+                    continue
+                walk(v, path + (k,))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(v, path + (i,))
+        else:
+            out.append((path, node))
+
+    walk(tree, ())
+    return out
+
+
+# ------------------------------------------------------- frozen base
+
+
+def test_masked_step_trains_only_factors(setup):
+    """The tentpole invariant: training moves ONLY the factor leaves —
+    the base stays BIT-identical (a serving fabric must be able to
+    trust that online tuning can never corrupt the model every other
+    tenant is being served from) — and the loss actually falls."""
+    cfg, params = setup
+    reg = AdapterRegistry(cfg, params)
+    trainer = LoraTrainer(params, cfg, reg)
+    svc = TuningService(trainer)
+
+    before = {p: np.asarray(leaf).copy()
+              for p, leaf in base_leaves(trainer._tree)}
+    job = svc.submit("alice", examples_for(), steps=6)
+    run_jobs(svc)
+    assert job.state == "completed", job.status()
+    assert len(job.losses) == 6
+    assert all(np.isfinite(job.losses))
+    assert job.losses[-1] < job.losses[0]
+
+    after = dict(base_leaves(trainer._tree))
+    assert set(after) == set(before)
+    for path, arr in after.items():
+        got = np.asarray(arr)
+        # uint32 view: -0.0 vs 0.0 or any rounding splice would show
+        assert (got.view(np.uint32) ==
+                before[path].view(np.uint32)).all(), path
+    # ... while the tenant's factors moved (B leaves start at zero and
+    # receive the only nonzero step-1 gradients)
+    fac = reg.factors("alice")
+    assert any(np.abs(f["B"]).max() > 0 for f in fac.values())
+
+
+def test_deploy_versions_and_warm_start(setup):
+    """Deploys mint name -> name@v2 -> ... (the tenant can never pin a
+    version), job 2 warm-starts from the deployed factors, and the
+    queue's lifecycle surface stays truthful along the way."""
+    cfg, params = setup
+    reg = AdapterRegistry(cfg, params)
+    trainer = LoraTrainer(params, cfg, reg)
+    svc = TuningService(trainer)
+
+    job1 = svc.submit("alice", examples_for(), steps=2)
+    st = svc.status(job1.job_id)
+    assert st["state"] == "queued" and st["step"] == 0
+    run_jobs(svc)
+    assert svc.status(job1.job_id)["deployed"] == "alice"
+    assert reg.version_of("alice") == 1
+    v1 = {p: {k: np.asarray(v).copy() for k, v in f.items()}
+          for p, f in reg.factors("alice").items()}
+
+    job2 = svc.submit("alice", examples_for(seed=1), steps=2)
+    run_jobs(svc)
+    assert svc.status(job2.job_id)["deployed"] == "alice@v2"
+    assert reg.latest("alice") == "alice@v2"
+    # v2 moved on FROM v1 (warm start), and v1's stored bytes survived
+    v2 = reg.factors("alice@v2")
+    assert any(not np.array_equal(v2[p]["B"], v1[p]["B"]) for p in v1)
+    v1_again = reg.factors("alice@v1")
+    for p in v1:
+        assert np.array_equal(v1_again[p]["A"], v1[p]["A"])
+        assert np.array_equal(v1_again[p]["B"], v1[p]["B"])
+
+
+def test_job_queue_validation():
+    """Malformed jobs fail at the intake boundary with the NAMED
+    TuneError — never steps later inside the jitted train step."""
+    q = TuneJobQueue()
+    with pytest.raises(TuneError, match="minted by the fabric"):
+        q.submit("alice@v3", [[1, 2, 3]], 2)
+    with pytest.raises(TuneError, match="at least one example"):
+        q.submit("alice", [], 2)
+    with pytest.raises(TuneError, match=">= 2 tokens"):
+        q.submit("alice", [[7]], 2)
+    with pytest.raises(TuneError, match="steps must be >= 1"):
+        q.submit("alice", [[1, 2, 3]], 0)
+    with pytest.raises(TuneError, match="not a token-id sequence"):
+        q.submit("alice", [["x", "y"]], 2)
+    with pytest.raises(TuneError, match="unknown tune job"):
+        q.status("tune-999")
+    job = q.submit("alice", [[1, 2, 3]], 2)
+    assert q.status(job.job_id)["state"] == "queued"
+    assert q.depth == 1
+
+
+# ----------------------------------------------------- serving parity
+
+
+def test_tuned_stream_matches_merged_reference(setup):
+    """A stream served under the freshly tuned adapter matches solo
+    ``generate()`` on the merged weights ``W + A@B`` — the deploy path
+    produced REAL factors, not metadata."""
+    cfg, params = setup
+    reg = AdapterRegistry(cfg, params)
+    trainer = LoraTrainer(params, cfg, reg)
+    svc = TuningService(trainer)
+    svc.submit("alice", examples_for(), steps=4)
+    run_jobs(svc)
+
+    prompt = rand_prompt(9, seed=3)
+    engine = ServingEngine(params, cfg, capacity=2, adapters=reg)
+    res = engine.run([GenerationRequest(
+        prompt_ids=prompt, max_new_tokens=6, top_k=1,
+        key=jax.random.PRNGKey(7), adapter="alice")])[0]
+    merged = reg.merge(params, "alice")
+    want = np.asarray(generate(
+        merged, cfg, jnp.asarray(prompt, jnp.int32)[None],
+        jax.random.PRNGKey(7), max_new_tokens=6, top_k=1,
+    ))[0, len(prompt):]
+    assert_stream_close(res.new_tokens, want, label="tuned-v1")
+
+
+def test_hot_swap_mid_stream(setup):
+    """A live stream hot-swaps to the just-deployed version: carry
+    invalidated exactly once, zero tokens lost — prefix matches the v1
+    merged reference, suffix matches the v2 merged continuation."""
+    cfg, params = setup
+    reg = AdapterRegistry(cfg, params)
+    trainer = LoraTrainer(params, cfg, reg)
+    svc = TuningService(trainer)
+    svc.submit("alice", examples_for(), steps=2)
+    run_jobs(svc)
+    merged_v1 = reg.merge(params, "alice@v1")
+
+    engine = ServingEngine(params, cfg, capacity=2, tokens_per_tick=1,
+                           adapters=reg)
+    prompt = rand_prompt(7, seed=5)
+    rid = engine.submit(GenerationRequest(
+        prompt_ids=prompt, max_new_tokens=8, top_k=1,
+        key=jax.random.PRNGKey(11), adapter="alice"))
+    # decode a few tokens under the v1 pin (one token per tick, so the
+    # stream is guaranteed mid-flight when the deploy lands)
+    while True:
+        engine.step()
+        t = next(tr for tr in engine._slots.values()
+                 if tr.request_id == rid)
+        if len(t.new_tokens) >= 2:
+            break
+    pre = [int(x) for x in t.new_tokens]
+
+    # the online deploy lands mid-stream...
+    svc.submit("alice", examples_for(seed=2), steps=2)
+    run_jobs(svc)
+    assert reg.latest("alice") == "alice@v2"
+    # ...and the stream opts in: swapped to latest, exactly once (the
+    # freshly-requeued continuation is NOT swappable — the carry was
+    # already invalidated, there is nothing to invalidate twice)
+    assert engine.hot_swap_adapter(rid) == "alice@v2"
+    assert engine._hot_swaps == 1
+    with pytest.raises(ValueError, match="not swappable"):
+        engine.hot_swap_adapter(rid)
+    assert engine._hot_swaps == 1
+    for _ in engine.serve():
+        pass
+    final = [int(x) for x in engine.results[rid].new_tokens]
+
+    # no token loss: the budget finished across the swap, prefix intact
+    assert len(final) == 8
+    assert final[:len(pre)] == pre
+    want_pre = np.asarray(generate(
+        merged_v1, cfg, jnp.asarray(prompt, jnp.int32)[None],
+        jax.random.PRNGKey(11), max_new_tokens=len(pre), top_k=1,
+    ))[0, len(prompt):]
+    assert_stream_close(pre, want_pre, label="hot-swap-prefix")
+    # the suffix decodes under v2 from (prompt + prefix) — the carry
+    # was rebuilt, not patched
+    merged_v2 = reg.merge(params, "alice@v2")
+    cont = np.concatenate([prompt, np.asarray(pre, np.int32)])
+    want_suffix = np.asarray(generate(
+        merged_v2, cfg, jnp.asarray(cont, jnp.int32)[None],
+        jax.random.PRNGKey(11), max_new_tokens=8 - len(pre), top_k=1,
+    ))[0, len(cont):]
+    assert_stream_close(final[len(pre):], want_suffix,
+                        label="hot-swap-suffix")
+    assert engine.metrics.summary()["tuning"]["hot_swaps"] == 1
+
+
+# --------------------------------------------------------- SLO yield
+
+
+def test_lane_yields_under_slo_breach(setup):
+    """Serving pressure preempts training: while the shared monitor is
+    in breach every lane tick yields (no train step, counted), and the
+    SAME job — state intact on the trainer — resumes once it clears."""
+    cfg, params = setup
+    reg = AdapterRegistry(cfg, params)
+    trainer = LoraTrainer(params, cfg, reg)
+    mon = SLOMonitor(ttft_p95_ms=1.0, window=4)
+    svc = TuningService(trainer, slo=mon)
+    lane = TrainerReplica(0, svc)
+
+    job = svc.submit("alice", examples_for(), steps=2)
+    for _ in range(4):  # drive the rolling p95 into breach
+        mon.observe_request({"ttft_ms": 50.0})
+    assert mon.any_breach()
+    for _ in range(3):
+        lane.step()
+    assert job.step == 0  # not one train step ran
+    assert lane.metrics.summary()["tuning"]["yields"] == 3
+    assert svc.depth == 1  # the job is still the fabric's obligation
+
+    for _ in range(8):  # p95 recovers
+        mon.observe_request({"ttft_ms": 0.1})
+    assert not mon.any_breach()
+    run_jobs(svc, lane)
+    assert job.state == "completed"
+    assert job.deployed == "alice"
+
+
+# ------------------------------------------------------------ wire v6
+
+
+def test_wire_v6_tune_roundtrip_and_v5_skew():
+    """The v6 frames round-trip through the codec, and a v5 peer fails
+    through the NAMED UnknownWireVersionError instead of half-working
+    against a tuning-era fabric."""
+    assert wire.WIRE_VERSION == 6
+    for mtype, payload in [
+        ("submit_tune", {"adapter": "alice",
+                         "examples": [[1, 2, 3], [4, 5]], "steps": 2}),
+        ("tune_ack", {"job_id": "tune-1",
+                      "status": {"job_id": "tune-1", "adapter": "alice",
+                                 "state": "queued", "step": 0,
+                                 "steps": 2, "examples": 2}}),
+        ("tune_status", {"job_id": "tune-1"}),
+        ("tune_status_result", {"status": {"state": "completed",
+                                           "deployed": "alice@v2"}}),
+    ]:
+        frame = wire.encode_msg(mtype, payload)
+        got_type, got_payload = wire.decode_msg(frame[4:])
+        assert got_type == mtype
+        assert got_payload == payload
+
+    v5 = json.dumps({"v": 5, "type": "submit_tune",
+                     "payload": {"adapter": "alice"}}).encode()
+    with pytest.raises(wire.UnknownWireVersionError, match="version 5"):
+        wire.decode_msg(v5)
+
+
+# ----------------------------------------------------------- fairness
+
+
+def test_tenant_quota_unit():
+    """The quota primitive: versions count against their base, base
+    streams never count, 0 disables."""
+    check_tenant_quota(None, ["alice", "alice"], 1)  # base stream: free
+    check_tenant_quota("bob", ["alice", None], 1)
+    check_tenant_quota("alice", ["alice", "bob"], 2)
+    check_tenant_quota("alice@v2", ["bob"], 1)
+    with pytest.raises(TenantQuotaExceeded):
+        check_tenant_quota("alice", ["alice"], 1)
+    with pytest.raises(TenantQuotaExceeded):
+        # a new version cannot dodge the base's quota
+        check_tenant_quota("alice@v2", ["alice", "alice@v3"], 2)
+    check_tenant_quota("alice", ["alice"] * 10, 0)  # 0 = no quota
+
+
+def test_tenant_quota_backpressure(setup):
+    """Over-quota admissions REQUEUE (counted) and finish later —
+    fairness is backpressure, never shedding: one tenant cannot occupy
+    the whole slot pool while others wait."""
+    cfg, params = setup
+    qcfg = tiny_cfg(tenant_max_slots=1)
+    reg = AdapterRegistry(qcfg, params)
+    reg.register_random("alice", seed=10)
+    engine = ServingEngine(params, qcfg, capacity=4, tokens_per_tick=1,
+                           adapters=reg)
+    rids = [engine.submit(GenerationRequest(
+        prompt_ids=rand_prompt(5 + i, seed=20 + i), max_new_tokens=4,
+        top_k=1, key=jax.random.PRNGKey(i),
+        adapter="alice" if i < 3 else None)) for i in range(4)]
+    peak = 0
+    while len(engine.results) < 4:
+        engine.step()
+        resident = [tr.request.adapter
+                    for tr in engine._slots.values()]
+        peak = max(peak, sum(
+            1 for a in resident
+            if a and split_adapter_version(a)[0] == "alice"))
+    assert peak == 1  # the cap held on every step
+    assert all(len(engine.results[r].new_tokens) == 4 for r in rids)
+    assert engine.metrics.summary()["tuning"]["quota_stalls"] >= 2
+
+
+# ---------------------------------------------------------- A/B route
+
+
+def test_ab_routing_splits_versions(setup):
+    """With lora_ab_fraction < 1 a bare-name submit pins SOME streams
+    to the previous version (the control arm); the default 1.0 always
+    pins latest."""
+    cfg, params = setup
+    ab_cfg = tiny_cfg(lora_ab_fraction=0.5)
+    reg = AdapterRegistry(ab_cfg, params)
+    reg.register_random("alice", seed=1)
+    reg.register_random("alice", seed=2)  # mints alice@v2
+    engine = ServingEngine(params, ab_cfg, capacity=2, adapters=reg)
+    reqs = [GenerationRequest(
+        prompt_ids=rand_prompt(6 + (i % 5), seed=100 + i),
+        max_new_tokens=2, top_k=1, key=jax.random.PRNGKey(i),
+        adapter="alice") for i in range(24)]
+    for r in reqs:
+        engine.submit(r)  # the pin happens AT submit
+    arms = {r.adapter for r in reqs}
+    assert arms == {"alice", "alice@v2"}  # both arms took traffic
+
+    engine_all = ServingEngine(params, tiny_cfg(), capacity=2,
+                               adapters=reg)
+    reqs2 = [GenerationRequest(
+        prompt_ids=rand_prompt(6 + (i % 5), seed=100 + i),
+        max_new_tokens=2, top_k=1, key=jax.random.PRNGKey(i),
+        adapter="alice") for i in range(8)]
+    for r in reqs2:
+        engine_all.submit(r)
+    assert {r.adapter for r in reqs2} == {"alice@v2"}  # default: latest
+
+
+# ----------------------------------------------------- byte stability
+
+
+def test_tuning_off_byte_stability(setup):
+    """A fabric that never tunes exposes NOTHING of the tuning plane:
+    no summary block, no tune histogram, no prom families — the
+    tuning_off exposition is byte-identical to the pre-tuning one."""
+    m = ServingMetrics(4)
+    assert m.summary()["tuning"] is None
+    assert "tune_step_ms" not in m.histogram_dicts()
+
+    snapshot = {"replica": 0, "role": "mixed", "summary": m.summary(),
+                "histograms": m.histogram_dicts(),
+                "stats": {"depth": 0, "resident": 0, "capacity": 4}}
+    text = prom.render_fabric([snapshot], replicas=1, accepting=1,
+                              ready=True)
+    for needle in ("mamba_tune", "mamba_tenant_quota",
+                   "mamba_adapter_hot_swaps",
+                   "mamba_fabric_tune_queue_depth"):
+        assert needle not in text
+
+    # ...and a quota-less engine run stamps none of it either
+    cfg, params = setup
+    engine = ServingEngine(params, cfg, capacity=2)
+    engine.run([GenerationRequest(prompt_ids=rand_prompt(5),
+                                  max_new_tokens=2, top_k=1,
+                                  key=jax.random.PRNGKey(0))])
+    assert engine.metrics.summary()["tuning"] is None
+
+
+# ----------------------------------------------------------- fabric e2e
+
+
+def test_http_tune_end_to_end(setup, tmp_path):
+    """Zero offline steps, over the real surfaces: POST /v1/tune on a
+    live fabric (serving replica + trainer lane + controller) -> the
+    lane trains -> the version hot-registers -> /v1/generate serves
+    the tuned adapter -> a second job mints @v2 — and the status/error
+    surface (404 unknown job, 400 pinned version) holds."""
+    cfg, params = setup
+    from mamba_distributed_tpu.serving.replica import EngineReplica
+    from mamba_distributed_tpu.serving.router import RequestRouter
+    from mamba_distributed_tpu.serving.service.server import (
+        FabricController,
+        FabricHTTPServer,
+    )
+
+    ab_cfg = tiny_cfg(lora_ab_fraction=0.5, tune_steps=2)
+    reg = AdapterRegistry(ab_cfg, params)
+    rep = EngineReplica(0, params, ab_cfg, capacity=2,
+                        retain_results=False, adapters=reg)
+    trainer = LoraTrainer(params, ab_cfg, reg)
+    svc = TuningService(trainer)
+    lane = TrainerReplica(1, svc)
+    router = RequestRouter(None, ab_cfg, replicas=[rep, lane],
+                           retain_results=False)
+    ctrl = FabricController(router, tuning=svc)
+    ctrl.start()
+    http = FabricHTTPServer(ctrl)
+    port = http.start_background()
+    base = f"http://127.0.0.1:{port}"
+
+    def post(path, obj):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+
+    def get(path):
+        with urllib.request.urlopen(base + path) as r:
+            return r.status, json.loads(r.read())
+
+    def wait_done(job_id, deadline_s=120):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            _, snap = get(f"/v1/tune/{job_id}")
+            if snap["state"] in ("completed", "failed"):
+                return snap
+            time.sleep(0.05)
+        raise AssertionError(f"job {job_id} never finished")
+
+    try:
+        st, job = post("/v1/tune", {"adapter": "alice",
+                                    "examples": examples_for()})
+        assert st == 202 and job["state"] in ("queued", "running")
+        snap = wait_done(job["job_id"])
+        assert snap["state"] == "completed", snap
+        assert snap["deployed"] == "alice"
+        assert "alice" in reg
+
+        st, job2 = post("/v1/tune", {"adapter": "alice",
+                                     "examples": examples_for(seed=1),
+                                     "steps": 2})
+        assert st == 202
+        snap2 = wait_done(job2["job_id"])
+        assert snap2["deployed"] == "alice@v2", snap2
+        assert reg.latest("alice") == "alice@v2"
+
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            get("/v1/tune/tune-999")
+        assert e404.value.code == 404
+        assert json.loads(e404.value.read())["error_type"] == "TuneError"
+        with pytest.raises(urllib.error.HTTPError) as e400:
+            post("/v1/tune", {"adapter": "bob@v3",
+                              "examples": examples_for()})
+        assert e400.value.code == 400
+        assert json.loads(e400.value.read())["error_type"] == "TuneError"
+
+        # the tuned tenant takes generation traffic on the same fabric
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            data=json.dumps({
+                "prompt_ids": [int(t) for t in rand_prompt(6, seed=9)],
+                "max_new_tokens": 3, "top_k": 1, "adapter": "alice",
+                "seed": 7,
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            events = [json.loads(ln[6:])
+                      for ln in r.read().decode().splitlines()
+                      if ln.startswith("data: ")]
+        assert events and events[-1]["done"]
+
+        _, summ = get("/metrics-summary")
+        tun = summ["1"]["tuning"]
+        assert tun["jobs_completed"] == 2
+        assert tun["deploys"] == 2
+        assert tun["train_steps"] == 4
+    finally:
+        http.stop()
+        ctrl.stop()
+        ctrl.join(timeout=10)
